@@ -1,0 +1,24 @@
+"""k8s_tpu — a TPU-native distributed-training job framework.
+
+A brand-new framework with the capabilities of the early ``tensorflow/k8s``
+TfJob operator (reference: ``/root/reference``), re-designed TPU-first:
+
+- **Control plane**: a CRD-style ``TpuJob`` spec + operator (controller,
+  per-job reconciler, replica materializer, leader election, TensorBoard
+  aux, exit-code retry policy) — the analogue of the reference's Go
+  operator (``cmd/tf_operator``, ``pkg/controller``, ``pkg/trainer``,
+  ``pkg/spec``).
+- **Data plane**: JAX/XLA SPMD over `jax.sharding.Mesh` — DP / TP / FSDP /
+  sequence(context) / expert / pipeline parallelism via ``pjit`` and
+  ``shard_map`` with XLA collectives over ICI/DCN, replacing the
+  reference's TensorFlow gRPC parameter-server ring
+  (``grpc_tensorflow_server/grpc_tensorflow_server.py``).
+- **Rendezvous contract**: the operator injects ``KTPU_COORDINATOR_ADDRESS``
+  / ``KTPU_PROCESS_ID`` / ``KTPU_NUM_PROCESSES`` (+ megascale env for
+  multi-slice) instead of ``TF_CONFIG`` (reference
+  ``pkg/trainer/replicas.go:188-255``).
+"""
+
+from k8s_tpu.version import VERSION, GIT_SHA  # noqa: F401
+
+__version__ = VERSION

@@ -1,0 +1,4 @@
+"""K8s API plumbing: object model, clients, in-memory cluster, election, retry.
+
+Analogue of reference ``pkg/util/`` + ``pkg/util/k8sutil/``.
+"""

@@ -1,0 +1,46 @@
+"""Spec / types layer: TpuJob CRD schema, validation, defaulting, accelerators.
+
+Analogue of reference ``pkg/spec/`` (``tf_job.go``, ``controller.go``,
+``register.go``, ``tf_job_list.go``).
+"""
+
+from k8s_tpu.spec.topology import TpuTopology, KNOWN_ACCELERATORS  # noqa: F401
+from k8s_tpu.spec.tpu_job import (  # noqa: F401
+    CRD_GROUP,
+    CRD_KIND,
+    CRD_KIND_PLURAL,
+    CRD_VERSION,
+    APP_LABEL,
+    DEFAULT_PORT,
+    COORDINATOR,
+    WORKER,
+    TENSORBOARD,
+    CONTAINER_NAME,
+    DEFAULT_IMAGE,
+    DEFAULT_REPLICAS,
+    TPU_RESOURCE,
+    GKE_TPU_ACCEL_LABEL,
+    GKE_TPU_TOPO_LABEL,
+    VALID_REPLICA_TYPES,
+    ChiefSpec,
+    ReplicaState,
+    ReplicaStatus,
+    TensorBoardSpec,
+    TerminationPolicySpec,
+    TpuJob,
+    TpuJobCondition,
+    TpuJobPhase,
+    TpuJobSpec,
+    TpuJobState,
+    TpuJobStatus,
+    TpuReplicaSpec,
+    TpuSpec,
+    ValidationError,
+    crd_name,
+)
+from k8s_tpu.spec.controller_config import (  # noqa: F401
+    AcceleratorConfig,
+    AcceleratorVolume,
+    ControllerConfig,
+    EnvironmentVariableConfig,
+)

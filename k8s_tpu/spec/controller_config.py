@@ -1,0 +1,64 @@
+"""Operator controller config.
+
+Analogue of reference ``pkg/spec/controller.go`` (``ControllerConfig``
+with the ``accelerators:`` map and ``grpcServerFilePath``). The TPU
+build keeps the accelerator map (arbitrary resource-name → volumes/env)
+and replaces the gRPC-server source path with the SPMD launcher module
+path that gets shipped to default-launcher workers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+from k8s_tpu.api.objects import K8sObject, register_type
+
+
+@register_type
+@dataclass
+class AcceleratorVolume(K8sObject):
+    name: str = ""
+    host_path: str = ""
+    mount_path: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_type
+@dataclass
+class EnvironmentVariableConfig(K8sObject):
+    name: str = ""
+    value: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_type
+@dataclass
+class AcceleratorConfig(K8sObject):
+    volumes: List[AcceleratorVolume] = field(default_factory=list)
+    env_vars: List[EnvironmentVariableConfig] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_type
+@dataclass
+class ControllerConfig(K8sObject):
+    accelerators: Dict[str, AcceleratorConfig] = field(default_factory=dict)
+    # Python module executed by default-launcher workers (analogue of
+    # GrpcServerFilePath, reference controller.go:9-16 + replicas.go:126-150).
+    launcher_module: str = "k8s_tpu.launcher.spmd_launcher"
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ControllerConfig":
+        import yaml
+
+        raw = yaml.safe_load(text) or {}
+        accels = {
+            name: AcceleratorConfig.from_dict(cfg)
+            for name, cfg in (raw.get("accelerators") or {}).items()
+        }
+        return cls(
+            accelerators=accels,
+            launcher_module=raw.get("launcherModule", cls.launcher_module),
+        )

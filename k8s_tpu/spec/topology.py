@@ -1,0 +1,112 @@
+"""TPU accelerator topology model.
+
+The reference has no topology notion — GPUs are requested one
+resource-limit at a time (``examples/tf_job_gpu.yaml:15``) and wired by
+hostPath mounts (``pkg/spec/tf_job.go:179-233``). TPU slices are
+all-or-nothing gangs of hosts wired by ICI, so the spec needs a
+first-class topology model: an accelerator type names a slice shape,
+the slice shape fixes the number of hosts (= worker pods), chips per
+host, and the ICI mesh the data plane can build.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TpuTopology:
+    """Shape of one TPU slice.
+
+    ``chips``: total chips in the slice.
+    ``chips_per_host``: chips attached to one host VM (= one worker pod).
+    ``mesh_shape``: physical ICI mesh (x, y, z); z=1 for 2D-torus parts.
+    ``cores_per_chip``: TensorCores per chip (v5p=2, v5e/v6e=1).
+    """
+
+    accelerator: str
+    chips: int
+    chips_per_host: int
+    mesh_shape: Tuple[int, int, int]
+    cores_per_chip: int = 1
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.chips // self.chips_per_host)
+
+    @property
+    def gke_accelerator(self) -> str:
+        """GKE node-selector value, e.g. ``tpu-v5p-slice``."""
+        fam = self.accelerator.split("-")[0]
+        return {
+            "v4": "tpu-v4-podslice",
+            "v5e": "tpu-v5-lite-podslice",
+            "v5p": "tpu-v5p-slice",
+            "v6e": "tpu-v6e-slice",
+        }.get(fam, f"tpu-{fam}-slice")
+
+    @property
+    def topology_label(self) -> str:
+        """GKE ``cloud.google.com/gke-tpu-topology`` value, e.g. ``2x2x2``."""
+        x, y, z = self.mesh_shape
+        if z == 1 and self.accelerator.split("-")[0] in ("v5e", "v6e"):
+            return f"{x}x{y}"
+        return f"{x}x{y}x{z}"
+
+
+def _t(acc: str, chips: int, cph: int, mesh: Tuple[int, int, int], cpc: int) -> TpuTopology:
+    return TpuTopology(acc, chips, cph, mesh, cpc)
+
+
+# accelerator-type string → topology. v5p sizes are named by TensorCore
+# count (v5p-16 = 8 chips × 2 cores); v5e/v6e by chip count.
+KNOWN_ACCELERATORS: Dict[str, TpuTopology] = {
+    # v5e (1 core/chip, up to 8 chips/host, 2D torus)
+    "v5e-1": _t("v5e-1", 1, 1, (1, 1, 1), 1),
+    "v5e-4": _t("v5e-4", 4, 4, (2, 2, 1), 1),
+    "v5e-8": _t("v5e-8", 8, 8, (2, 4, 1), 1),
+    "v5e-16": _t("v5e-16", 16, 4, (4, 4, 1), 1),
+    "v5e-32": _t("v5e-32", 32, 4, (4, 8, 1), 1),
+    "v5e-64": _t("v5e-64", 64, 4, (8, 8, 1), 1),
+    "v5e-128": _t("v5e-128", 128, 4, (8, 16, 1), 1),
+    "v5e-256": _t("v5e-256", 256, 4, (16, 16, 1), 1),
+    # v6e
+    "v6e-1": _t("v6e-1", 1, 1, (1, 1, 1), 1),
+    "v6e-4": _t("v6e-4", 4, 4, (2, 2, 1), 1),
+    "v6e-8": _t("v6e-8", 8, 8, (2, 4, 1), 1),
+    "v6e-16": _t("v6e-16", 16, 4, (4, 4, 1), 1),
+    "v6e-32": _t("v6e-32", 32, 4, (4, 8, 1), 1),
+    "v6e-64": _t("v6e-64", 64, 4, (8, 8, 1), 1),
+    "v6e-256": _t("v6e-256", 256, 4, (16, 16, 1), 1),
+    # v5p (2 cores/chip, 4 chips/host, 3D torus) — named by core count
+    "v5p-8": _t("v5p-8", 4, 4, (2, 2, 1), 2),
+    "v5p-16": _t("v5p-16", 8, 4, (2, 2, 2), 2),
+    "v5p-32": _t("v5p-32", 16, 4, (2, 2, 4), 2),
+    "v5p-64": _t("v5p-64", 32, 4, (2, 4, 4), 2),
+    "v5p-128": _t("v5p-128", 64, 4, (4, 4, 4), 2),
+    "v5p-256": _t("v5p-256", 128, 4, (4, 4, 8), 2),
+    "v5p-512": _t("v5p-512", 256, 4, (4, 8, 8), 2),
+    # v4 (2 cores/chip, 4 chips/host, 3D torus)
+    "v4-8": _t("v4-8", 4, 4, (2, 2, 1), 2),
+    "v4-16": _t("v4-16", 8, 4, (2, 2, 2), 2),
+    "v4-32": _t("v4-32", 16, 4, (2, 2, 4), 2),
+    # CPU pseudo-accelerator for smoke tests (reference config #1:
+    # "CPU-only smoke", BASELINE.md). N virtual devices on one host.
+    "cpu-1": _t("cpu-1", 1, 1, (1, 1, 1), 1),
+    "cpu-8": _t("cpu-8", 8, 8, (2, 4, 1), 1),
+}
+
+
+def lookup(accelerator: str) -> Optional[TpuTopology]:
+    return KNOWN_ACCELERATORS.get(accelerator)
+
+
+def parse(accelerator: str) -> TpuTopology:
+    t = lookup(accelerator)
+    if t is None:
+        raise ValueError(
+            f"unknown accelerator type {accelerator!r}; known: "
+            f"{sorted(KNOWN_ACCELERATORS)}"
+        )
+    return t

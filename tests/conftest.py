@@ -1,0 +1,14 @@
+"""Test harness config: force a virtual 8-device CPU mesh before JAX loads.
+
+This is the capability the reference lacked (SURVEY §4): distributed
+logic testable without real accelerators. All tests run on
+``JAX_PLATFORMS=cpu`` with ``--xla_force_host_platform_device_count=8``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,294 @@
+"""Spec-layer tests — the analogue of reference ``pkg/spec/tf_job_test.go``
+(table tests for accelerator injection :13-233 and defaulting incl. the
+auto default-template :235-339), extended with TPU topology coverage.
+"""
+
+import pytest
+
+from k8s_tpu.api.objects import (
+    Container,
+    EnvVar,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from k8s_tpu import spec as S
+
+
+def pod_template(container_name="jax", resources=None):
+    return PodTemplateSpec(
+        spec=PodSpec(
+            containers=[Container(name=container_name, image="img", resources=resources)]
+        )
+    )
+
+
+def minimal_job(accelerator="", worker_replicas=None):
+    spec = S.TpuJobSpec(
+        replica_specs=[
+            S.TpuReplicaSpec(replica_type="COORDINATOR", template=pod_template()),
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=worker_replicas),
+        ]
+    )
+    if accelerator:
+        spec.tpu = S.TpuSpec(accelerator=accelerator)
+    return S.TpuJob(spec=spec)
+
+
+class TestDefaults:
+    def test_basic_defaults(self):
+        j = minimal_job()
+        j.spec.set_defaults()
+        assert j.spec.image == S.DEFAULT_IMAGE
+        coord = j.spec.replica_spec(S.COORDINATOR)
+        assert coord.replicas == 1
+        assert coord.port == S.DEFAULT_PORT
+        w = j.spec.replica_spec(S.WORKER)
+        assert w.replicas == 1
+        assert w.is_default_launcher
+        assert w.template.spec.containers[0].name == S.CONTAINER_NAME
+        assert w.template.spec.restart_policy == "OnFailure"
+        # default launcher command points at the in-repo SPMD launcher
+        assert "k8s_tpu.launcher.spmd_launcher" in " ".join(
+            w.template.spec.containers[0].command
+        )
+        # default termination policy: chief = COORDINATOR[0]
+        assert j.spec.termination_policy.chief.replica_name == S.COORDINATOR
+        assert j.spec.termination_policy.chief.replica_index == 0
+
+    def test_worker_count_derived_from_topology(self):
+        j = minimal_job(accelerator="v5p-16")
+        j.spec.set_defaults()
+        # v5p-16 = 8 chips, 4 chips/host → 2 hosts → 2 worker pods
+        assert j.spec.replica_spec(S.WORKER).replicas == 2
+
+    def test_multislice_worker_count(self):
+        j = minimal_job(accelerator="v5p-16")
+        j.spec.tpu.num_slices = 2
+        j.spec.set_defaults()
+        assert j.spec.replica_spec(S.WORKER).replicas == 4
+
+    def test_master_alias_normalized(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[S.TpuReplicaSpec(replica_type="MASTER", template=pod_template())]
+        )
+        spec.set_defaults()
+        assert spec.replica_specs[0].replica_type == S.COORDINATOR
+
+    def test_empty_type_defaults_to_coordinator(self):
+        spec = S.TpuJobSpec(replica_specs=[S.TpuReplicaSpec(template=pod_template())])
+        spec.set_defaults()
+        assert spec.replica_specs[0].replica_type == S.COORDINATOR
+
+
+class TestValidate:
+    def test_valid(self):
+        j = minimal_job(accelerator="v5e-8")
+        j.spec.set_defaults()
+        j.spec.validate()
+
+    def test_coordinator_must_have_one_replica(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[
+                S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=2, template=pod_template())
+            ]
+        )
+        spec.set_defaults()
+        with pytest.raises(S.ValidationError, match="COORDINATOR must have replicas = 1"):
+            spec.validate()
+
+    def test_missing_template_non_worker(self):
+        spec = S.TpuJobSpec(replica_specs=[S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=1, port=2222)])
+        with pytest.raises(S.ValidationError, match="missing a template"):
+            spec.validate()
+
+    def test_missing_port(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=1, template=pod_template())]
+        )
+        with pytest.raises(S.ValidationError, match="port"):
+            spec.validate()
+
+    def test_invalid_replica_type(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[S.TpuReplicaSpec(replica_type="PS", replicas=1, port=1, template=pod_template())]
+        )
+        with pytest.raises(S.ValidationError, match="replicaType"):
+            spec.validate()
+
+    def test_missing_jax_container(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[
+                S.TpuReplicaSpec(
+                    replica_type="COORDINATOR", replicas=1, port=1,
+                    template=pod_template(container_name="other"),
+                )
+            ]
+        )
+        with pytest.raises(S.ValidationError, match="container named"):
+            spec.validate()
+
+    def test_bad_chief(self):
+        j = minimal_job()
+        j.spec.set_defaults()
+        j.spec.termination_policy.chief.replica_index = 1
+        with pytest.raises(S.ValidationError, match="termination policy"):
+            j.spec.validate()
+
+    def test_unknown_accelerator(self):
+        j = minimal_job(accelerator="v5e-8")
+        j.spec.set_defaults()
+        j.spec.tpu.accelerator = "v99-3"
+        with pytest.raises(S.ValidationError, match="unknown tpu.accelerator"):
+            j.spec.validate()
+
+    def test_gang_worker_count_enforced(self):
+        j = minimal_job(accelerator="v5p-16", worker_replicas=3)
+        j.spec.set_defaults()
+        with pytest.raises(S.ValidationError, match="gang"):
+            j.spec.validate()
+
+
+class TestConfigureAccelerators:
+    """Mirrors the reference's table tests (tf_job_test.go:13-233):
+    config-map-driven volume/env injection keyed on resource names."""
+
+    def _accels(self):
+        return {
+            "custom.dev/chip": S.AcceleratorConfig(
+                volumes=[
+                    S.AcceleratorVolume(name="lib", host_path="/h/lib", mount_path="/c/lib")
+                ],
+                env_vars=[S.EnvironmentVariableConfig(name="LD_LIBRARY_PATH", value="/c/lib")],
+            )
+        }
+
+    def test_injects_on_limits(self):
+        res = ResourceRequirements(limits={"custom.dev/chip": 1})
+        spec = S.TpuJobSpec(
+            replica_specs=[
+                S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=1, port=1,
+                                 template=pod_template(resources=res))
+            ]
+        )
+        spec.configure_accelerators(self._accels())
+        c = spec.replica_specs[0].template.spec.containers[0]
+        assert c.volume_mounts[0].mount_path == "/c/lib"
+        assert spec.replica_specs[0].template.spec.volumes[0].host_path.path == "/h/lib"
+        assert c.env_dict()["LD_LIBRARY_PATH"] == "/c/lib"
+
+    def test_injects_on_requests(self):
+        res = ResourceRequirements(requests={"custom.dev/chip": 1})
+        spec = S.TpuJobSpec(
+            replica_specs=[
+                S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=1, port=1,
+                                 template=pod_template(resources=res))
+            ]
+        )
+        spec.configure_accelerators(self._accels())
+        assert spec.replica_specs[0].template.spec.containers[0].volume_mounts
+
+    def test_no_injection_without_match(self):
+        spec = S.TpuJobSpec(
+            replica_specs=[
+                S.TpuReplicaSpec(replica_type="COORDINATOR", replicas=1, port=1, template=pod_template())
+            ]
+        )
+        spec.configure_accelerators(self._accels())
+        c = spec.replica_specs[0].template.spec.containers[0]
+        assert not c.volume_mounts and not c.env
+
+    def test_tpu_native_injection(self):
+        j = minimal_job(accelerator="v5e-8")
+        j.spec.set_defaults()
+        j.spec.configure_accelerators({})
+        w = j.spec.replica_spec(S.WORKER)
+        ps = w.template.spec
+        assert ps.node_selector[S.GKE_TPU_ACCEL_LABEL] == "tpu-v5-lite-podslice"
+        assert ps.node_selector[S.GKE_TPU_TOPO_LABEL] == "2x4"
+        c = ps.containers[0]
+        assert c.resources.limits[S.TPU_RESOURCE] == 8
+        assert c.env_dict()["TPU_ACCELERATOR_TYPE"] == "v5e-8"
+
+
+class TestTopology:
+    def test_v5p_16(self):
+        t = S.KNOWN_ACCELERATORS["v5p-16"]
+        assert t.chips == 8 and t.num_hosts == 2 and t.cores_per_chip == 2
+        assert t.topology_label == "2x2x2"
+
+    def test_v5e_8_single_host(self):
+        t = S.KNOWN_ACCELERATORS["v5e-8"]
+        assert t.num_hosts == 1
+
+    def test_unknown_raises(self):
+        from k8s_tpu.spec import topology
+
+        with pytest.raises(ValueError, match="unknown accelerator"):
+            topology.parse("v9-bogus")
+
+
+class TestStatus:
+    def test_condition_ring_capped_at_10(self):
+        st = S.TpuJobStatus()
+        for i in range(15):
+            st.append_condition("Ready", reason=str(i))
+        assert len(st.conditions) == 10
+        assert st.conditions[-1].reason == "14"
+        assert st.conditions[0].reason == "5"
+
+    def test_ready_dedup(self):
+        st = S.TpuJobStatus()
+        st.set_ready_condition()
+        st.set_ready_condition()
+        assert len(st.conditions) == 1
+
+    def test_owner_ref(self):
+        j = S.TpuJob()
+        j.metadata.name = "j1"
+        j.metadata.uid = "u-123"
+        o = j.as_owner()
+        assert o.kind == "TpuJob" and o.uid == "u-123" and o.controller
+
+
+class TestSerde:
+    def test_roundtrip(self):
+        j = minimal_job(accelerator="v5p-16")
+        j.metadata.name = "mnist"
+        j.metadata.namespace = "default"
+        j.spec.set_defaults()
+        d = j.to_dict()
+        j2 = S.TpuJob.from_dict(d)
+        assert j2.metadata.name == "mnist"
+        assert j2.spec.tpu.accelerator == "v5p-16"
+        assert j2.spec.replica_spec(S.WORKER).replicas == 2
+        assert j2.to_dict() == d
+
+    def test_deepcopy_independent(self):
+        j = minimal_job()
+        j.spec.set_defaults()
+        j2 = j.deepcopy()
+        j2.spec.replica_specs[0].replicas = 99
+        assert j.spec.replica_specs[0].replicas == 1
+
+
+class TestControllerConfig:
+    def test_from_yaml(self):
+        cfg = S.ControllerConfig.from_yaml(
+            """
+accelerators:
+  custom.dev/chip:
+    volumes:
+      - name: lib
+        hostPath: /h
+        mountPath: /c
+    envVars:
+      - name: A
+        value: b
+launcherModule: my.launcher
+"""
+        )
+        assert cfg.launcher_module == "my.launcher"
+        acc = cfg.accelerators["custom.dev/chip"]
+        assert acc.volumes[0].host_path == "/h"
+        assert acc.env_vars[0].name == "A"
