@@ -1,0 +1,43 @@
+"""API error taxonomy.
+
+Analogue of reference ``pkg/util/k8sutil/k8sutil.go`` error classifiers
+(IsKubernetesResourceAlreadyExistError / NotFoundError) and the watch
+staleness error ``ErrVersionOutdated`` (``pkg/controller/controller.go``).
+"""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch on update (optimistic-concurrency CAS)."""
+
+    code = 409
+
+
+class OutdatedVersionError(ApiError):
+    """Watch resourceVersion fell out of the history window — the
+    analogue of HTTP 410 Gone, which the reference maps to
+    ``ErrVersionOutdated`` and recovers from by relisting
+    (``controller.go:331-344``)."""
+
+    code = 410
+
+
+def is_not_found(e: Exception) -> bool:
+    return isinstance(e, NotFoundError)
+
+
+def is_already_exists(e: Exception) -> bool:
+    return isinstance(e, AlreadyExistsError)

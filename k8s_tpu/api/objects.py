@@ -34,7 +34,7 @@ class K8sObject:
             if f.name == "extra":
                 continue
             v = getattr(self, f.name)
-            if v is None or v == [] or v == {}:
+            if v is None or v == [] or v == {} or v == "":
                 continue
             key = f.metadata.get("json", _camel(f.name))
             out[key] = _ser(v)

@@ -1,0 +1,226 @@
+"""API-plumbing tests: in-memory cluster semantics, typed clients,
+leader election, retry — the tier the reference covered with client-go
+fakes (SURVEY §4 tier 1), plus watch/410/GC semantics its fakes could
+not simulate."""
+
+import threading
+
+import pytest
+
+from k8s_tpu import utils
+from k8s_tpu.api import errors
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.election import LeaderElector
+from k8s_tpu.api.objects import Pod, Service
+from k8s_tpu.spec import TpuJob
+
+
+def mkpod(name, ns="default", labels=None, owner_uid=None):
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = ns
+    p.metadata.labels = labels or {}
+    if owner_uid:
+        from k8s_tpu.api.objects import OwnerReference
+
+        p.metadata.owner_references = [OwnerReference(uid=owner_uid, name="own")]
+    return p
+
+
+class TestCluster:
+    def test_create_get_update_delete(self):
+        c = KubeClient()
+        c.pods.create(mkpod("a"))
+        got = c.pods.get("default", "a")
+        assert got.metadata.uid
+        rv0 = got.metadata.resource_version
+        got.status.phase = "Running"
+        c.pods.update(got)
+        got2 = c.pods.get("default", "a")
+        assert got2.status.phase == "Running"
+        assert got2.metadata.resource_version != rv0
+        c.pods.delete("default", "a")
+        with pytest.raises(errors.NotFoundError):
+            c.pods.get("default", "a")
+
+    def test_already_exists(self):
+        c = KubeClient()
+        c.pods.create(mkpod("a"))
+        with pytest.raises(errors.AlreadyExistsError):
+            c.pods.create(mkpod("a"))
+
+    def test_list_label_selector(self):
+        c = KubeClient()
+        c.pods.create(mkpod("a", labels={"app": "x", "idx": "0"}))
+        c.pods.create(mkpod("b", labels={"app": "x", "idx": "1"}))
+        c.pods.create(mkpod("c", labels={"app": "y"}))
+        assert len(c.pods.list("default", {"app": "x"})) == 2
+        assert len(c.pods.list("default", {"app": "x", "idx": "1"})) == 1
+
+    def test_delete_collection(self):
+        c = KubeClient()
+        for i in range(3):
+            c.pods.create(mkpod(f"p{i}", labels={"app": "x"}))
+        n = c.pods.delete_collection("default", {"app": "x"})
+        assert n == 3
+        assert c.pods.list("default") == []
+
+    def test_owner_gc_cascade(self):
+        c = KubeClient()
+        svc = Service()
+        svc.metadata.name = "owner"
+        svc.metadata.namespace = "default"
+        created = c.services.create(svc)
+        c.pods.create(mkpod("dep", owner_uid=created.metadata.uid))
+        c.services.delete("default", "owner")
+        with pytest.raises(errors.NotFoundError):
+            c.pods.get("default", "dep")
+
+    def test_optimistic_concurrency(self):
+        cl = InMemoryCluster()
+        cl.create("Pod", {"metadata": {"name": "a", "namespace": "d"}})
+        stale = cl.get("Pod", "d", "a")
+        cl.update("Pod", cl.get("Pod", "d", "a"))
+        with pytest.raises(errors.ConflictError):
+            cl.update("Pod", stale, check_version=True)
+
+
+class TestWatch:
+    def test_stream_and_replay(self):
+        cl = InMemoryCluster()
+        rv0 = cl.resource_version
+        cl.create("Pod", {"metadata": {"name": "a", "namespace": "d"}})
+        w = cl.watch("Pod", resource_version=rv0)
+        ev = w.next(timeout=1)
+        assert ev.type == "ADDED" and ev.name == "a"
+        cl.delete("Pod", "d", "a")
+        ev = w.next(timeout=1)
+        assert ev.type == "DELETED"
+        w.stop()
+
+    def test_live_events(self):
+        cl = InMemoryCluster()
+        w = cl.watch("Pod")
+        cl.create("Pod", {"metadata": {"name": "x", "namespace": "d"}})
+        assert w.next(timeout=1).type == "ADDED"
+        w.stop()
+
+    def test_kind_filtering(self):
+        cl = InMemoryCluster()
+        w = cl.watch("Service")
+        cl.create("Pod", {"metadata": {"name": "x", "namespace": "d"}})
+        assert w.next(timeout=0.05) is None
+        w.stop()
+
+    def test_outdated_version_410(self):
+        cl = InMemoryCluster()
+        import k8s_tpu.api.cluster as cluster_mod
+
+        old = cluster_mod._WATCH_HISTORY
+        cluster_mod._WATCH_HISTORY = 4
+        try:
+            for i in range(10):
+                cl.create("Pod", {"metadata": {"name": f"p{i}", "namespace": "d"}})
+            with pytest.raises(errors.OutdatedVersionError):
+                cl.watch("Pod", resource_version=1)
+        finally:
+            cluster_mod._WATCH_HISTORY = old
+
+
+class TestCrdClient:
+    def test_crd_lifecycle(self):
+        cl = InMemoryCluster()
+        jc = TpuJobClient(cl)
+        assert not jc.crd_established()
+        jc.create_crd_definition()
+        assert jc.crd_established()
+
+    def test_job_crud_and_watch(self):
+        cl = InMemoryCluster()
+        jc = TpuJobClient(cl)
+        j = TpuJob()
+        j.metadata.name = "j1"
+        j.metadata.namespace = "default"
+        w = jc.watch()
+        jc.create(j)
+        ev = w.next(timeout=1)
+        assert ev.type == "ADDED" and ev.name == "j1"
+        got = jc.get("default", "j1")
+        got.status.phase = "Creating"
+        jc.update(got)
+        assert jc.get("default", "j1").status.phase == "Creating"
+        assert len(jc.list()) == 1
+        jc.delete("default", "j1")
+        assert jc.list() == []
+        w.stop()
+
+
+class TestElection:
+    def test_single_acquires(self):
+        cl = InMemoryCluster()
+        e = LeaderElector(cl, "kube-system", "tpu-operator", "op-1")
+        assert e.try_acquire_or_renew()
+        assert e.is_leader()
+
+    def test_second_blocked_until_lease_expiry(self):
+        t = [0.0]
+        clock = lambda: t[0]
+        cl = InMemoryCluster()
+        e1 = LeaderElector(cl, "ns", "lock", "op-1", lease_duration=15, clock=clock)
+        e2 = LeaderElector(cl, "ns", "lock", "op-2", lease_duration=15, clock=clock)
+        assert e1.try_acquire_or_renew()
+        t[0] = 5.0
+        assert not e2.try_acquire_or_renew()
+        # e1 silent past lease → e2 takes over
+        t[0] = 25.0
+        assert e2.try_acquire_or_renew()
+        assert e2.is_leader()
+
+    def test_holder_renews(self):
+        t = [0.0]
+        cl = InMemoryCluster()
+        e1 = LeaderElector(cl, "ns", "lock", "op-1", lease_duration=15, clock=lambda: t[0])
+        assert e1.try_acquire_or_renew()
+        t[0] = 10.0
+        assert e1.try_acquire_or_renew()
+
+    def test_run_loop_leading(self):
+        cl = InMemoryCluster()
+        e = LeaderElector(cl, "ns", "lock", "op-1", retry_period=0.01, renew_deadline=0.01)
+        stop = threading.Event()
+        led = threading.Event()
+
+        def lead(lost):
+            led.set()
+            stop.set()
+
+        e.run(lead, lambda: None, stop=stop)
+        assert led.is_set()
+
+
+class TestUtils:
+    def test_rand_string_dns_safe(self):
+        s = utils.rand_string(4, seed=42)
+        assert len(s) == 4 and s[0].isalpha() and s.islower()
+
+    def test_retry_succeeds(self):
+        calls = []
+        utils.retry(0, 5, lambda: len(calls) >= 2 or (calls.append(1) and False), sleep=lambda _: None)
+        assert len(calls) == 2
+
+    def test_retry_exhausts(self):
+        with pytest.raises(utils.RetryError):
+            utils.retry(0, 3, lambda: False, sleep=lambda _: None)
+
+    def test_pformat(self):
+        assert '"a": 1' in utils.pformat({"a": 1})
+
+
+class TestEvents:
+    def test_record_event(self):
+        c = KubeClient()
+        c.record_event("default", {"kind": "TpuJob", "name": "j"}, "Created", "msg")
+        evs = c.events.list("default")
+        assert len(evs) == 1 and evs[0].reason == "Created"
