@@ -1,0 +1,22 @@
+"""SPMD launcher package — the in-cluster data-plane payload.
+
+Analogue of reference ``grpc_tensorflow_server/grpc_tensorflow_server.py``
+(component 19): where the reference shipped a TF gRPC parameter server
+into pods via ConfigMap, we ship :mod:`k8s_tpu.launcher.spmd_launcher`,
+which brings up `jax.distributed`, builds the device mesh, runs the
+program named by the TpuJob, and emits the exit-code contract the
+operator's retry policy keys on.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+
+def launcher_source(config=None) -> str:
+    """Source text of the standalone launcher, for the default-launcher
+    ConfigMap (the analogue of reading ``GrpcServerFilePath``,
+    reference ``replicas.go:126-150``)."""
+    from k8s_tpu.launcher import spmd_launcher
+
+    return inspect.getsource(spmd_launcher)

@@ -1,0 +1,181 @@
+"""Standalone JAX SPMD launcher.
+
+The TPU-native successor of the reference's default parameter server
+(``grpc_tensorflow_server/grpc_tensorflow_server.py``): the one program
+the operator ships into pods. Instead of parsing ``--cluster_spec``
+into a TF ``ServerDef`` and blocking on a gRPC server (reference
+:46-115), it
+
+1. reads the rendezvous env the operator injected
+   (``KTPU_COORDINATOR_ADDRESS`` / ``KTPU_PROCESS_ID`` /
+   ``KTPU_NUM_PROCESSES`` — the ``TF_CONFIG`` successor),
+2. calls ``jax.distributed.initialize`` (the JAX coordination service
+   replaces the gRPC session layer; XLA collectives over ICI/DCN
+   replace the PS ring),
+3. runs the program named by ``KTPU_PROGRAM`` (``module:function``), or
+   the built-in mesh smoke check, and
+4. exits with the operator's retry contract (reference
+   ``training.go:201-238``): 0 success, 1 permanent user error,
+   EX_RETRYABLE (143) for coordination/bring-up failures that a gang
+   restart can fix.
+
+This file must stay self-contained (stdlib + jax only): it is mounted
+into arbitrary JAX images from a ConfigMap.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import time
+
+EX_OK = 0
+EX_PERMANENT = 1
+EX_RETRYABLE = 143  # SIGTERM-class: operator treats 128-255 as retryable
+
+
+class Rendezvous:
+    """Parsed rendezvous env (operator contract)."""
+
+    def __init__(self, env=None):
+        env = env if env is not None else os.environ
+        self.coordinator_address = env.get("KTPU_COORDINATOR_ADDRESS", "")
+        self.process_id = int(env.get("KTPU_PROCESS_ID", "0"))
+        self.num_processes = int(env.get("KTPU_NUM_PROCESSES", "1"))
+        self.replica_type = env.get("KTPU_REPLICA_TYPE", "worker")
+        self.task_index = int(env.get("KTPU_TASK_INDEX", "0"))
+        self.num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1"))
+        self.slice_id = int(env.get("MEGASCALE_SLICE_ID", "0"))
+        try:
+            self.cluster = json.loads(env.get("KTPU_CLUSTER_SPEC", "{}"))
+        except ValueError:
+            self.cluster = {}
+        self.program = env.get("KTPU_PROGRAM", "")
+        self.program_args = env.get("KTPU_PROGRAM_ARGS", "")
+        self.init_timeout = float(env.get("KTPU_INIT_TIMEOUT", "300"))
+
+    @property
+    def is_distributed(self):
+        return self.num_processes > 1
+
+    @property
+    def is_control_replica(self):
+        return self.process_id < 0
+
+
+def initialize_distributed(rdzv):
+    """Join the JAX coordination service. Raises on timeout — mapped to
+    the retryable exit code by main()."""
+    import jax
+
+    if not rdzv.is_distributed:
+        return
+    jax.distributed.initialize(
+        coordinator_address=rdzv.coordinator_address,
+        num_processes=rdzv.num_processes,
+        process_id=rdzv.process_id,
+        initialization_timeout=int(rdzv.init_timeout),
+    )
+
+
+def mesh_smoke_check(rdzv):
+    """Built-in workload: every process contributes a matmul shard and a
+    global psum verifies every process/device joined — the SPMD version
+    of the reference's master-places-a-matmul-on-every-task check
+    (``examples/tf_sample/tf_sample/tf_smoke.py:52-60``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    n = devices.size
+
+    @jax.jit
+    def step(x, w):
+        y = x @ w
+        return y.sum()
+
+    x = jax.device_put(
+        jnp.ones((8 * n, 16), jnp.float32),
+        NamedSharding(mesh, P("data", None)),
+    )
+    w = jax.device_put(jnp.full((16, 4), 0.5, jnp.float32), NamedSharding(mesh, P()))
+    total = float(step(x, w))
+    expected = 8.0 * n * 16 * 0.5 * 4
+    if abs(total - expected) > 1e-3:
+        raise RuntimeError(
+            f"mesh smoke check mismatch: got {total}, want {expected} "
+            f"across {n} devices"
+        )
+    if rdzv.process_id <= 0:
+        print(
+            json.dumps(
+                {
+                    "event": "smoke_ok",
+                    "devices": n,
+                    "processes": rdzv.num_processes,
+                    "result": total,
+                }
+            ),
+            flush=True,
+        )
+
+
+def run_program(rdzv):
+    """Import and call ``module:function(rdzv)`` named by KTPU_PROGRAM."""
+    mod_name, _, fn_name = rdzv.program.partition(":")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name or "main")
+    return fn(rdzv)
+
+
+def main(argv=None):
+    rdzv = Rendezvous()
+    t0 = time.time()
+    if rdzv.is_control_replica:
+        # Control-plane replica (COORDINATOR role): it is not part of
+        # the SPMD mesh; it succeeds immediately unless given a program.
+        if rdzv.program:
+            try:
+                run_program(rdzv)
+            except Exception as e:  # user code error → permanent
+                print(f"control program failed: {e}", file=sys.stderr, flush=True)
+                return EX_PERMANENT
+        return EX_OK
+    try:
+        initialize_distributed(rdzv)
+    except Exception as e:
+        # Coordination bring-up failure (peer missing, DNS not yet
+        # live, heartbeat loss): a whole-gang restart can fix it.
+        print(f"distributed init failed (retryable): {e}", file=sys.stderr, flush=True)
+        return EX_RETRYABLE
+    try:
+        if rdzv.program:
+            run_program(rdzv)
+        else:
+            mesh_smoke_check(rdzv)
+        if rdzv.process_id <= 0:
+            print(
+                json.dumps({"event": "done", "elapsed_s": round(time.time() - t0, 3)}),
+                flush=True,
+            )
+        return EX_OK
+    except Exception as e:
+        print(f"program failed: {e}", file=sys.stderr, flush=True)
+        return EX_PERMANENT
+    finally:
+        try:
+            import jax
+
+            if rdzv.is_distributed:
+                jax.distributed.shutdown()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

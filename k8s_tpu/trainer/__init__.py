@@ -1,0 +1,11 @@
+"""Trainer runtime: per-job reconciler, replica sets, rendezvous
+generation, TensorBoard, status aggregation.
+
+Analogue of reference ``pkg/trainer/`` (``training.go``, ``replicas.go``,
+``tensorboard.go``, ``labels.go``).
+"""
+
+from k8s_tpu.trainer.labels import KubernetesLabels  # noqa: F401
+from k8s_tpu.trainer.replicas import TpuReplicaSet, RendezvousSpec  # noqa: F401
+from k8s_tpu.trainer.training import TrainingJob, is_retryable_termination_state  # noqa: F401
+from k8s_tpu.trainer.tensorboard import TensorBoardReplicaSet  # noqa: F401

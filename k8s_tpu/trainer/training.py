@@ -1,0 +1,287 @@
+"""TrainingJob: the per-job reconciler and state machine.
+
+Analogue of reference ``pkg/trainer/training.go``: one worker thread
+per TpuJob with an event queue (cap 100) and an 8s reconcile ticker
+(:23,412-456); ``setup()`` = defaults → validate → replica sets →
+TensorBoard → accelerators → 4-char RuntimeId (:245-301);
+``cluster_spec()`` (:114-128); chief-decides-job ``get_status``
+(:163-199); the exit-code retry policy (:201-238) is ported as
+*policy*, verbatim semantics: OOMKilled ⇒ permanent, exit 0 ⇒ success,
+1–127 ⇒ permanent, 128–255 ⇒ retryable; ``reconcile`` (:350-409) with
+status written back only on change (:331-347).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.objects import ContainerStateTerminated
+from k8s_tpu.spec import (
+    COORDINATOR,
+    ControllerConfig,
+    ReplicaState,
+    ReplicaStatus,
+    TpuJob,
+    TpuJobPhase,
+    TpuJobState,
+    TpuJobStatus,
+    WORKER,
+)
+from k8s_tpu import utils
+from k8s_tpu.trainer.replicas import TpuReplicaSet
+from k8s_tpu.trainer.tensorboard import TensorBoardReplicaSet, init_tensorboard
+
+log = logging.getLogger(__name__)
+
+RECONCILE_INTERVAL = 8.0  # reference training.go:23
+EVENT_QUEUE_CAP = 100  # reference training.go:412
+
+_EVENT_DELETE = "delete"
+_EVENT_MODIFY = "modify"
+
+
+def is_retryable_termination_state(s: ContainerStateTerminated) -> bool:
+    """Ported policy of reference ``isRetryableTerminationState``
+    (training.go:201-238)."""
+    if s.reason == "OOMKilled":
+        return False
+    if 0 <= s.exit_code <= 127:
+        # 0 success; 1–127 permanent user error — neither is retried.
+        return False
+    # 128–255 (137=SIGKILL, 143=SIGTERM, …) → internal error, retryable.
+    return True
+
+
+class TrainingJob:
+    """Reconciles one TpuJob to completion."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        job_client: TpuJobClient,
+        job: TpuJob,
+    ):
+        self.client = client
+        self.job_client = job_client
+        self.job = job
+        self.status: TpuJobStatus = job.status.deepcopy()
+        self.replicas: List[TpuReplicaSet] = []
+        self.tensorboard: Optional[TensorBoardReplicaSet] = None
+        self._events: "queue.Queue[Tuple[str, Optional[TpuJob]]]" = queue.Queue(
+            maxsize=EVENT_QUEUE_CAP
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ identity
+
+    @property
+    def name(self) -> str:
+        return self.job.metadata.name
+
+    @property
+    def fullname(self) -> str:
+        return f"{self.job.metadata.namespace}:{self.job.metadata.name}"
+
+    def chief(self) -> Tuple[str, int]:
+        tp = self.job.spec.termination_policy
+        if tp is not None and tp.chief is not None:
+            return tp.chief.replica_name, tp.chief.replica_index
+        return COORDINATOR, 0
+
+    # ------------------------------------------------------------ cluster map
+
+    def cluster_spec(self) -> Dict[str, List[str]]:
+        """``{role.lower(): ["<dns>:<port>", ...]}`` from replica naming
+        (reference ClusterSpec, training.go:114-128). The per-index
+        Service gives each name stable DNS."""
+        out: Dict[str, List[str]] = {}
+        for r in self.replicas:
+            names = [
+                f"{r.job_name(i)}:{r.spec.port}" for i in range(r.spec.replicas or 0)
+            ]
+            out[r.spec.replica_type.lower()] = names
+        return out
+
+    # ------------------------------------------------------------ setup
+
+    def setup(self, config: ControllerConfig) -> None:
+        """Reference setup() (training.go:245-301)."""
+        if self.status.phase != TpuJobPhase.NONE:
+            log.warning("job %s has already been set up", self.name)
+            return
+        try:
+            self.job.spec.set_defaults()
+            self.job.spec.validate()
+            self.replicas = [
+                TpuReplicaSet(self.client, rs, self) for rs in self.job.spec.replica_specs
+            ]
+            self.tensorboard = init_tensorboard(self.client, self)
+            self.job.spec.configure_accelerators(config.accelerators)
+            if not self.job.spec.runtime_id:
+                self.job.spec.runtime_id = utils.rand_string(4)
+        except Exception as e:  # invalid spec → Failed, quarantined
+            self.status.reason = str(e)
+            self.status.phase = TpuJobPhase.FAILED
+            self.status.state = TpuJobState.FAILED
+            log.error("setup of job %s failed: %s", self.fullname, e)
+            return
+        self.status.phase = TpuJobPhase.CREATING
+        self.status.state = TpuJobState.RUNNING
+
+    # ------------------------------------------------------------ resources
+
+    def create_resources(self, config: ControllerConfig) -> None:
+        for r in self.replicas:
+            r.create(config)
+        if self.tensorboard is not None:
+            self.tensorboard.create()
+
+    def delete_resources(self) -> None:
+        for r in self.replicas:
+            r.delete()
+        if self.tensorboard is not None:
+            self.tensorboard.delete()
+
+    # ------------------------------------------------------------ status
+
+    def get_status(self) -> Tuple[str, List[ReplicaStatus]]:
+        """Chief-decides-job aggregation (reference GetStatus,
+        training.go:163-199): any failed replica ⇒ Failed tentatively;
+        the chief replica's Succeeded/Failed is authoritative."""
+        state = TpuJobState.UNKNOWN
+        statuses: List[ReplicaStatus] = []
+        set_states: Dict[str, str] = {}
+        for r in self.replicas:
+            rs = r.get_status()
+            set_states[r.spec.replica_type] = rs.state
+            statuses.append(rs)
+            if rs.state == ReplicaState.FAILED:
+                state = TpuJobState.FAILED
+        chief_name, _ = self.chief()
+        if chief_name not in set_states and WORKER in set_states:
+            chief_name = WORKER  # no control replica → the gang decides
+        chief_state = set_states.get(chief_name)
+        if chief_state == ReplicaState.SUCCEEDED:
+            return TpuJobState.SUCCEEDED, statuses
+        if chief_state == ReplicaState.FAILED:
+            return TpuJobState.FAILED, statuses
+        if state == TpuJobState.FAILED:
+            return state, statuses
+        return TpuJobState.RUNNING, statuses
+
+    def update_crd_status(self) -> None:
+        """Write status back iff changed (reference updateTPRStatus,
+        training.go:331-347)."""
+        if self.job.status.to_dict() == self.status.to_dict():
+            return
+        self.job.status = self.status.deepcopy()
+        try:
+            self.job = self.job_client.update(self.job)
+        except Exception as e:
+            log.warning("job %s: failed to update CRD status: %s", self.fullname, e)
+
+    # ------------------------------------------------------------ reconcile
+
+    def reconcile(self, config: ControllerConfig) -> None:
+        """Reference reconcile (training.go:350-409)."""
+        if self.status.phase == TpuJobPhase.NONE:
+            self.setup(config)
+            self.update_crd_status()
+
+        if self.job.status.phase in (TpuJobPhase.CREATING, TpuJobPhase.RUNNING):
+            try:
+                self.create_resources(config)
+            except Exception as e:
+                log.error("job %s: create resources: %s", self.fullname, e)
+            state, replica_statuses = self.get_status()
+            self.status.replica_statuses = replica_statuses
+            if state == TpuJobState.FAILED:
+                self.status.phase = TpuJobPhase.DONE
+                self.status.state = TpuJobState.FAILED
+            elif state == TpuJobState.SUCCEEDED:
+                self.status.phase = TpuJobPhase.DONE
+                self.status.state = TpuJobState.SUCCEEDED
+            elif self.status.phase == TpuJobPhase.CREATING and state == TpuJobState.RUNNING:
+                running = any(
+                    rs.state == ReplicaState.RUNNING for rs in replica_statuses
+                )
+                if running:
+                    self.status.phase = TpuJobPhase.RUNNING
+
+        self.update_crd_status()
+
+        if self.job.status.phase == TpuJobPhase.CLEANUP:
+            try:
+                self.delete_resources()
+            except Exception as e:
+                log.error("job %s: delete resources: %s", self.fullname, e)
+            return
+
+        self.update_crd_status()
+
+    # ------------------------------------------------------------ events
+
+    def send(self, typ: str, job: Optional[TpuJob] = None) -> None:
+        try:
+            self._events.put_nowait((typ, job))
+            if self._events.qsize() > int(EVENT_QUEUE_CAP * 0.8):
+                log.warning("job %s: event queue almost full", self.fullname)
+        except queue.Full:
+            log.error("job %s: event queue full, dropping %s", self.fullname, typ)
+
+    def delete(self) -> None:
+        """External request to delete (reference Delete, training.go:303-320):
+        just queues an event; the run loop does the work."""
+        self.send(_EVENT_DELETE)
+
+    def update(self, new_job: TpuJob) -> None:
+        self.send(_EVENT_MODIFY, new_job)
+
+    # ------------------------------------------------------------ run loop
+
+    def start(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
+        self._thread = threading.Thread(
+            target=self.run, args=(config, reconcile_interval), daemon=True,
+            name=f"trainingjob-{self.name}",
+        )
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def run(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
+        """Reference run loop (training.go:412-456): select over
+        {event queue, stop, ticker}."""
+        self.reconcile(config)
+        while not self._stop.is_set():
+            try:
+                typ, _new = self._events.get(timeout=reconcile_interval)
+            except queue.Empty:
+                self.reconcile(config)
+                continue
+            if typ == _EVENT_DELETE:
+                log.info("TpuJob %s deleted by the user", self.fullname)
+                if self.job.status.phase != TpuJobPhase.CLEANUP:
+                    self.status.phase = TpuJobPhase.CLEANUP
+                try:
+                    self.delete_resources()
+                except Exception as e:
+                    log.error("job %s: deleteResources error: %s", self.fullname, e)
+                return
+            # modify events are accepted but, like the reference
+            # (controller.go:154-159), spec mutation is not acted on.
+
+    @property
+    def finished(self) -> bool:
+        return self.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED)

@@ -1,0 +1,389 @@
+"""Trainer-layer tests — analogue of reference ``pkg/trainer/*_test.go``:
+replica materialization asserts (replicas_test.go:22-182), pod-list →
+state classification (:184-340), exit-code retryability table
+(training_test.go:17-73), cluster-spec naming (:75-172), setup paths
+(:174-327), TensorBoard asserts (tensorboard_test.go:19-146)."""
+
+import json
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.objects import (
+    Container,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+)
+from k8s_tpu import spec as S
+from k8s_tpu.trainer import labels as L
+from k8s_tpu.trainer.replicas import replica_status_from_pod_list
+from k8s_tpu.trainer.training import TrainingJob, is_retryable_termination_state
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    return KubeClient(cluster), TpuJobClient(cluster)
+
+
+def make_job(client, job_client, accelerator="", worker_replicas=None, tensorboard=False,
+             name="myjob", runtime_id="abcd"):
+    j = S.TpuJob()
+    j.metadata.name = name
+    j.metadata.namespace = "default"
+    j.metadata.uid = "uid-1"
+    j.spec.runtime_id = runtime_id
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(
+            replica_type="COORDINATOR",
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="jax", image="i")])),
+        ),
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=worker_replicas),
+    ]
+    if accelerator:
+        j.spec.tpu = S.TpuSpec(accelerator=accelerator)
+    if tensorboard:
+        j.spec.tensorboard = S.TensorBoardSpec(log_dir="/tmp/logs")
+    return TrainingJob(client, job_client, j)
+
+
+class TestRetryPolicy:
+    """Exit-code table (reference training_test.go:17-73)."""
+
+    @pytest.mark.parametrize(
+        "exit_code,reason,retryable",
+        [
+            (0, "", False),
+            (1, "", False),
+            (2, "", False),
+            (127, "", False),
+            (128, "", True),
+            (137, "", True),  # SIGKILL
+            (143, "", True),  # SIGTERM
+            (255, "", True),
+            (137, "OOMKilled", False),  # OOM is permanent even at 137
+        ],
+    )
+    def test_table(self, exit_code, reason, retryable):
+        s = ContainerStateTerminated(exit_code=exit_code, reason=reason)
+        assert is_retryable_termination_state(s) == retryable
+
+
+class TestClusterSpec:
+    def test_names_and_ports(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, worker_replicas=2)
+        tj.setup(S.ControllerConfig())
+        cs = tj.cluster_spec()
+        assert cs["coordinator"] == ["myjob-coordinator-abcd-0:2222"]
+        assert cs["worker"] == [
+            "myjob-worker-abcd-0:2222",
+            "myjob-worker-abcd-1:2222",
+        ]
+
+    def test_long_names_truncated_to_40(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, name="x" * 60)
+        tj.setup(S.ControllerConfig())
+        for names in tj.cluster_spec().values():
+            for n in names:
+                host = n.split(":")[0]
+                assert len(host) <= 63  # DNS label limit
+
+
+class TestSetup:
+    def test_happy_path(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, accelerator="v5e-8")
+        tj.setup(S.ControllerConfig())
+        assert tj.status.phase == S.TpuJobPhase.CREATING
+        assert tj.status.state == S.TpuJobState.RUNNING
+        assert len(tj.replicas) == 2
+        assert tj.job.spec.runtime_id  # assigned
+
+    def test_runtime_id_assigned_when_missing(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, runtime_id="")
+        tj.setup(S.ControllerConfig())
+        assert len(tj.job.spec.runtime_id) == 4
+
+    def test_invalid_spec_fails(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        tj.job.spec.replica_specs[0].replicas = 3  # COORDINATOR must be 1
+        tj.setup(S.ControllerConfig())
+        assert tj.status.phase == S.TpuJobPhase.FAILED
+        assert tj.status.state == S.TpuJobState.FAILED
+        assert "COORDINATOR" in tj.status.reason
+
+    def test_setup_idempotent(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        tj.setup(S.ControllerConfig())
+        phase = tj.status.phase
+        tj.setup(S.ControllerConfig())
+        assert tj.status.phase == phase
+
+
+class TestReplicaSetMaterialization:
+    """Reference TestTFReplicaSet (replicas_test.go:22-182)."""
+
+    def _created(self, accelerator="", worker_replicas=2):
+        client, jc = make_env()
+        tj = make_job(client, jc, accelerator=accelerator, worker_replicas=worker_replicas)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        return client, tj
+
+    def test_services_and_jobs_created(self):
+        client, tj = self._created()
+        svcs = client.services.list("default")
+        jobs = client.jobs.list("default")
+        assert len(svcs) == 3  # 1 coordinator + 2 workers
+        assert len(jobs) == 3
+        names = sorted(s.metadata.name for s in svcs)
+        assert names == [
+            "myjob-coordinator-abcd-0",
+            "myjob-worker-abcd-0",
+            "myjob-worker-abcd-1",
+        ]
+
+    def test_labels_and_owner_refs(self):
+        client, tj = self._created()
+        for job in client.jobs.list("default"):
+            assert job.metadata.owner_references[0].uid == "uid-1"
+            assert job.metadata.labels[L.RUNTIME_ID_LABEL] == "abcd"
+            assert job.metadata.labels[L.JOB_NAME_LABEL] == "myjob"
+            assert L.TASK_INDEX_LABEL in job.metadata.labels
+
+    def test_rendezvous_env_injected(self):
+        client, tj = self._created()
+        w1 = client.jobs.get("default", "myjob-worker-abcd-1")
+        env = w1.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_COORDINATOR_ADDRESS"] == "myjob-worker-abcd-0:2222"
+        assert env["KTPU_PROCESS_ID"] == "1"
+        assert env["KTPU_NUM_PROCESSES"] == "2"
+        cluster = json.loads(env["KTPU_CLUSTER_SPEC"])
+        assert cluster["worker"] == [
+            "myjob-worker-abcd-0:2222",
+            "myjob-worker-abcd-1:2222",
+        ]
+        assert env["TPU_WORKER_ID"] == "1"
+        assert "myjob-worker-abcd-0" in env["TPU_WORKER_HOSTNAMES"]
+        # single-slice: no megascale env
+        assert "MEGASCALE_NUM_SLICES" not in env
+
+    def test_coordinator_not_in_mesh(self):
+        client, tj = self._created()
+        c0 = client.jobs.get("default", "myjob-coordinator-abcd-0")
+        env = c0.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_PROCESS_ID"] == "-1"
+
+    def test_multislice_megascale_env(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, accelerator="v5p-16")
+        tj.job.spec.tpu.num_slices = 2
+        tj.setup(S.ControllerConfig())  # 2 hosts/slice × 2 slices = 4 workers
+        tj.create_resources(S.ControllerConfig())
+        w3 = client.jobs.get("default", "myjob-worker-abcd-3")
+        env = w3.spec.template.spec.containers[0].env_dict()
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"  # second host within slice 1
+        hostnames = env["TPU_WORKER_HOSTNAMES"].split(",")
+        assert hostnames == ["myjob-worker-abcd-2", "myjob-worker-abcd-3"]
+
+    def test_default_launcher_config_map(self):
+        client, jc = make_env()
+        j = S.TpuJob()
+        j.metadata.name = "defjob"
+        j.metadata.namespace = "default"
+        j.spec.runtime_id = "abcd"
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER")]
+        tj = TrainingJob(client, jc, j)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        cm = client.config_maps.get("default", "cm-launcher-abcd")
+        assert "jax.distributed" in cm.data["spmd_launcher.py"]
+        w0 = client.jobs.get("default", "defjob-worker-abcd-0")
+        c = w0.spec.template.spec.containers[0]
+        assert c.command == ["python", "/ktpu-launcher/spmd_launcher.py"]
+        assert any(v.config_map and v.config_map.name == "cm-launcher-abcd"
+                   for v in w0.spec.template.spec.volumes)
+
+    def test_create_idempotent(self):
+        client, tj = self._created()
+        tj.create_resources(S.ControllerConfig())  # second call no error
+        assert len(client.jobs.list("default")) == 3
+
+    def test_delete_removes_everything(self):
+        client, tj = self._created()
+        tj.delete_resources()
+        assert client.jobs.list("default") == []
+        assert client.services.list("default") == []
+
+
+class TestPodListClassification:
+    """Reference replicaStatusFromPodList tests (replicas_test.go:184-340)."""
+
+    def _pod(self, created, state=None, last_state=None, name="jax"):
+        p = Pod()
+        p.metadata.name = f"p{created}"
+        p.metadata.creation_timestamp = created
+        p.status = PodStatus(
+            container_statuses=[
+                ContainerStatus(name=name, state=state, last_state=last_state)
+            ]
+        )
+        return p
+
+    def test_empty_is_starting(self):
+        assert replica_status_from_pod_list([], "jax") == S.ReplicaState.STARTING
+
+    def test_running(self):
+        p = self._pod(1, state=ContainerState(running={}))
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.RUNNING
+
+    def test_succeeded(self):
+        p = self._pod(1, state=ContainerState(terminated=ContainerStateTerminated(exit_code=0)))
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.SUCCEEDED
+
+    def test_failed(self):
+        p = self._pod(1, state=ContainerState(terminated=ContainerStateTerminated(exit_code=2)))
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.FAILED
+
+    def test_last_state_counts(self):
+        # crash seen after restart still marks the replica failed
+        p = self._pod(
+            1,
+            state=ContainerState(running={}),
+            last_state=ContainerState(terminated=ContainerStateTerminated(exit_code=137)),
+        )
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.FAILED
+
+    def test_newest_pod_wins(self):
+        old = self._pod(1, state=ContainerState(terminated=ContainerStateTerminated(exit_code=1)))
+        new = self._pod(2, state=ContainerState(running={}))
+        assert replica_status_from_pod_list([old, new], "jax") == S.ReplicaState.RUNNING
+
+    def test_wrong_container_name_is_starting(self):
+        p = self._pod(1, state=ContainerState(running={}), name="other")
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.STARTING
+
+
+class TestGetStatus:
+    def _with_status(self, worker_exit=None, coord_exit=None):
+        client, jc = make_env()
+        tj = make_job(client, jc, worker_replicas=1)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        for rtype, exit_code in (("coordinator", coord_exit), ("worker", worker_exit)):
+            if exit_code is None:
+                continue
+            name = f"myjob-{rtype}-abcd-0"
+            job = tj.client.jobs.get("default", name)
+            if exit_code == 0:
+                job.status.succeeded = 1
+                tj.client.jobs.update(job)
+            else:
+                pod = Pod()
+                pod.metadata.name = name + "-pod"
+                pod.metadata.namespace = "default"
+                pod.metadata.labels = dict(job.metadata.labels)
+                pod.metadata.creation_timestamp = 1.0
+                pod.status = PodStatus(
+                    container_statuses=[
+                        ContainerStatus(
+                            name="jax",
+                            state=ContainerState(
+                                terminated=ContainerStateTerminated(exit_code=exit_code)
+                            ),
+                        )
+                    ]
+                )
+                tj.client.pods.create(pod)
+        return tj
+
+    def test_chief_succeeded_job_succeeds(self):
+        tj = self._with_status(coord_exit=0)
+        state, _ = tj.get_status()
+        assert state == S.TpuJobState.SUCCEEDED
+
+    def test_chief_failed_job_fails(self):
+        tj = self._with_status(coord_exit=1)
+        state, _ = tj.get_status()
+        assert state == S.TpuJobState.FAILED
+
+    def test_worker_failed_job_fails(self):
+        tj = self._with_status(worker_exit=2)
+        state, _ = tj.get_status()
+        assert state == S.TpuJobState.FAILED
+
+    def test_still_running(self):
+        tj = self._with_status()
+        state, _ = tj.get_status()
+        assert state == S.TpuJobState.RUNNING
+
+
+class TestReconcileLifecycle:
+    def test_full_lifecycle_to_done(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        assert tj.status.phase == S.TpuJobPhase.CREATING
+        assert client.jobs.list("default")
+        # simulate chief success
+        chief = client.jobs.get("default", "myjob-coordinator-abcd-0")
+        chief.status.succeeded = 1
+        client.jobs.update(chief)
+        tj.reconcile(cfg)
+        assert tj.status.phase == S.TpuJobPhase.DONE
+        assert tj.status.state == S.TpuJobState.SUCCEEDED
+        # status written back to the CRD
+        assert jc.get("default", "myjob").status.phase == S.TpuJobPhase.DONE
+
+    def test_delete_event_cleans_up(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        assert client.jobs.list("default")
+        tj.delete()
+        tj.run(cfg, reconcile_interval=0.01)  # processes the delete event and returns
+        assert client.jobs.list("default") == []
+        assert client.services.list("default") == []
+
+
+class TestTensorBoard:
+    """Reference tensorboard_test.go:19-146."""
+
+    def test_created_with_service_and_deployment(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, tensorboard=True)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        dep = client.deployments.get("default", "myjob-tensorboard-abcd")
+        svc = client.services.get("default", "myjob-tensorboard-abcd")
+        c = dep.spec.template.spec.containers[0]
+        assert c.command[:3] == ["tensorboard", "--logdir", "/tmp/logs"]
+        assert "--host" in c.command and "0.0.0.0" in c.command
+        assert svc.spec.ports[0].port == 80
+        assert svc.spec.ports[0].target_port == 6006
+
+    def test_deleted(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, tensorboard=True)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        tj.delete_resources()
+        assert client.deployments.list("default") == []
