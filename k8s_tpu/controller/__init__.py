@@ -1,0 +1,7 @@
+"""Controller: CRD registration, watch loop, event dispatch.
+
+Analogue of reference ``pkg/controller/``.
+"""
+
+from k8s_tpu.controller.controller import Controller  # noqa: F401
+from k8s_tpu.controller.watchdog import PanicTimer  # noqa: F401

@@ -1,0 +1,180 @@
+"""The operator controller: CRD registration, watch loop, dispatch.
+
+Analogue of reference ``pkg/controller/controller.go``: holds the live
+job map (:46-61); ``run()`` = init-resource with retry (:86-96) + the
+event pump with a per-event watchdog (:109-119); Added → new
+TrainingJob thread, Deleted → ``Delete()``, Modified forwarded but not
+acted on (:123-170); ``find_all_jobs`` re-adopts existing jobs on
+startup (:172-201) so an operator crash/restart is seamless; CRD
+create + established wait (:234-286); watch staleness (410 Gone) →
+``OutdatedVersionError`` → relist and re-watch (:292-376).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from k8s_tpu.api import errors
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu import utils
+from k8s_tpu.controller.watchdog import PanicTimer
+from k8s_tpu.spec import ControllerConfig, TpuJob, TpuJobPhase
+from k8s_tpu.trainer.training import TrainingJob
+
+log = logging.getLogger(__name__)
+
+INIT_RETRY_WAIT = 30.0  # reference controller.go:33
+WATCHDOG_DEADLINE = 60.0  # reference controller.go:110
+
+
+class Controller:
+    def __init__(
+        self,
+        client: KubeClient,
+        job_client: TpuJobClient,
+        config: Optional[ControllerConfig] = None,
+        namespace: Optional[str] = None,
+        reconcile_interval: float = 8.0,
+        watchdog_deadline: float = WATCHDOG_DEADLINE,
+    ):
+        self.client = client
+        self.job_client = job_client
+        self.config = config or ControllerConfig()
+        self.namespace = namespace
+        self.reconcile_interval = reconcile_interval
+        self.watchdog_deadline = watchdog_deadline
+        self.jobs: Dict[str, TrainingJob] = {}  # reference jobs map, :46-61
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ bootstrap
+
+    def init_resource(self) -> int:
+        """Create the CRD if needed and wait Established (reference
+        initResource + createCRD, controller.go:213-286). Returns the
+        resourceVersion to start watching from."""
+        try:
+            self.job_client.create_crd_definition()
+        except errors.AlreadyExistsError:
+            pass
+        utils.retry(0.5, 120, self.job_client.crd_established)
+        return self.find_all_jobs()
+
+    def find_all_jobs(self) -> int:
+        """Adopt pre-existing TpuJobs (reference findAllTfJobs,
+        controller.go:172-201): resource creation is idempotent, so
+        re-adopting a live job is safe."""
+        rv = self.client.cluster.resource_version
+        for job in self.job_client.list(self.namespace):
+            if job.status.is_failed():
+                log.warning("ignoring failed job %s", job.key)
+                continue
+            if job.key not in self.jobs:
+                self._start_job(job)
+        return rv
+
+    # ------------------------------------------------------------ dispatch
+
+    def _start_job(self, job: TpuJob) -> None:
+        tj = TrainingJob(self.client, self.job_client, job)
+        self.jobs[job.key] = tj
+        tj.start(self.config, self.reconcile_interval)
+        self.client.record_event(
+            job.metadata.namespace,
+            {"kind": "TpuJob", "name": job.metadata.name},
+            "Started",
+            f"reconciler started for {job.key}",
+        )
+
+    def handle_event(self, ev_type: str, job: TpuJob) -> None:
+        """Reference handleTfJobEvent (controller.go:123-170)."""
+        key = job.key
+        if ev_type == "ADDED":
+            if job.status.is_failed():
+                log.warning("ignoring failed job %s", key)  # quarantine, :126-133
+                return
+            if key in self.jobs:
+                return
+            self._start_job(job)
+        elif ev_type == "DELETED":
+            tj = self.jobs.pop(key, None)
+            if tj is None:
+                log.warning("unsafe state: %s deleted but not tracked", key)
+                return
+            tj.delete()
+        elif ev_type == "MODIFIED":
+            tj = self.jobs.get(key)
+            if tj is not None:
+                tj.update(job)
+
+    # ------------------------------------------------------------ run loop
+
+    def run(self) -> None:
+        """Watch pump (reference Run + watch, controller.go:80-119,292-376)."""
+        while not self._stop.is_set():
+            try:
+                watch_rv = self.init_resource()
+            except Exception as e:
+                log.error("initialization failed: %s; retrying", e)
+                if self._stop.wait(INIT_RETRY_WAIT):
+                    return
+                continue
+            try:
+                self._pump(watch_rv)
+                return
+            except errors.OutdatedVersionError:
+                # 410 Gone → relist and re-watch (reference
+                # ErrVersionOutdated restart path, controller.go:331-344)
+                log.info("watch outdated; relisting")
+                continue
+
+    def _pump(self, watch_rv: int) -> None:
+        watcher = self.job_client.watch(self.namespace, resource_version=watch_rv)
+        try:
+            while not self._stop.is_set():
+                ev = watcher.next(timeout=0.2)
+                if ev is None:
+                    continue
+                job = TpuJob.from_dict(ev.object)
+                with PanicTimer(
+                    self.watchdog_deadline,
+                    msg=f"handling {ev.type} for {job.key}",
+                    hard=False,
+                ) as wd:
+                    self.handle_event(ev.type, job)
+                if wd.fired.is_set():
+                    raise RuntimeError("event handler exceeded watchdog deadline")
+        finally:
+            watcher.stop()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.run, daemon=True, name="controller")
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+        for tj in self.jobs.values():
+            tj.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def wait_for_job(
+        self, namespace: str, name: str, timeout: float = 300.0, poll: float = 0.05
+    ) -> TpuJob:
+        """Poll a job to a terminal phase (the analogue of the e2e
+        binary's wait, reference test/e2e/main.go:111-123)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.job_client.get(namespace, name)
+            if job.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED):
+                return job
+            time.sleep(poll)
+        raise TimeoutError(f"job {namespace}/{name} did not finish in {timeout}s")

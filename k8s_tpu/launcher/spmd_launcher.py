@@ -65,6 +65,22 @@ class Rendezvous:
         return self.process_id < 0
 
 
+def configure_platform(env=None):
+    """Apply platform overrides before first device use. The operator
+    sets ``KTPU_FORCE_PLATFORM=cpu`` (+ ``KTPU_NUM_CPU_DEVICES``) for
+    CPU smoke jobs — config #1 of BASELINE.md — and leaves it unset on
+    TPU nodes where libtpu env selects the real chips."""
+    env = env if env is not None else os.environ
+    import jax
+
+    platform = env.get("KTPU_FORCE_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    n_cpu = env.get("KTPU_NUM_CPU_DEVICES", "")
+    if n_cpu and platform == "cpu":
+        jax.config.update("jax_num_cpu_devices", int(n_cpu))
+
+
 def initialize_distributed(rdzv):
     """Join the JAX coordination service. Raises on timeout — mapped to
     the retryable exit code by main()."""
@@ -136,6 +152,11 @@ def run_program(rdzv):
 def main(argv=None):
     rdzv = Rendezvous()
     t0 = time.time()
+    try:
+        configure_platform()
+    except Exception as e:
+        print(f"platform config failed: {e}", file=sys.stderr, flush=True)
+        return EX_PERMANENT
     if rdzv.is_control_replica:
         # Control-plane replica (COORDINATOR role): it is not part of
         # the SPMD mesh; it succeeds immediately unless given a program.

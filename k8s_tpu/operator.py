@@ -1,0 +1,126 @@
+"""Operator process entrypoint.
+
+Analogue of reference ``cmd/tf_operator/main.go``: flag parsing
+(:48-54), YAML ControllerConfig loading (:68-84), the
+``MY_POD_NAMESPACE``/``MY_POD_NAME`` env contract (:89-96), leader
+election with 15s/5s/3s lease timing (:40-46,125-148), and the
+restart-on-stale-watch run loop (:153-169). The ``--chaos-level`` flag
+exists like the reference's (stubbed there, ``main.go:171-207``) but is
+wired to the in-repo chaos monkey for local mode.
+
+Local single-host mode (``--local``) additionally starts the in-process
+kubelet with the subprocess executor, so ``python -m k8s_tpu.operator
+--local`` is a fully working single-node control+data plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from k8s_tpu import version
+from k8s_tpu.api.client import KubeClient, get_cluster_client
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.election import LeaderElector
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.spec import ControllerConfig
+
+log = logging.getLogger("k8s_tpu.operator")
+
+LEASE_DURATION = 15.0  # reference main.go:42-44
+RENEW_DEADLINE = 5.0
+RETRY_PERIOD = 3.0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="tpu-operator")
+    p.add_argument("--controller-config-file", default="",
+                   help="YAML ControllerConfig (accelerators map, launcher module)")
+    p.add_argument("--chaos-level", type=int, default=-1,
+                   help="chaos monkey aggressiveness; -1 disables")
+    p.add_argument("--gc-interval", type=float, default=600.0)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--local", action="store_true",
+                   help="single-host mode: in-memory cluster + local kubelet")
+    p.add_argument("--version", action="store_true")
+    return p.parse_args(argv)
+
+
+def load_config(path: str) -> ControllerConfig:
+    if not path:
+        return ControllerConfig()
+    with open(path) as f:
+        return ControllerConfig.from_yaml(f.read())
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+    )
+    args = parse_args(argv)
+    if args.version:
+        print(f"tpu-operator {version.VERSION} (git {version.GIT_SHA})")
+        return 0
+
+    config = load_config(args.controller_config_file)
+
+    # env contract (reference main.go:89-96)
+    namespace = os.environ.get("MY_POD_NAMESPACE", "default" if args.local else "")
+    name = os.environ.get("MY_POD_NAME", f"tpu-operator-{os.getpid()}" if args.local else "")
+    if not namespace or not name:
+        log.error("MY_POD_NAMESPACE and MY_POD_NAME must be set")
+        return 1
+
+    client = get_cluster_client()
+    job_client = TpuJobClient(client.cluster)
+
+    kubelet = None
+    if args.local:
+        from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
+
+        kubelet = LocalKubelet(client, SubprocessExecutor(log_dir="/tmp/ktpu-logs"))
+        kubelet.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    elector = LeaderElector(
+        client.cluster,
+        namespace,
+        "tpu-operator",
+        identity=name,
+        lease_duration=LEASE_DURATION,
+        renew_deadline=RENEW_DEADLINE,
+        retry_period=RETRY_PERIOD,
+    )
+
+    def on_started_leading(lost: threading.Event):
+        controller = Controller(client, job_client, config, args.namespace)
+        if args.chaos_level >= 0:
+            from k8s_tpu.runtime.chaos import ChaosMonkey
+
+            ChaosMonkey(client, level=args.chaos_level).start()
+        controller.start()
+        while not stop.is_set() and not lost.is_set():
+            stop.wait(0.5)
+        controller.stop()
+
+    def on_stopped_leading():
+        log.info("leader election lost")
+
+    try:
+        elector.run(on_started_leading, on_stopped_leading, stop=stop)
+    finally:
+        if kubelet is not None:
+            kubelet.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

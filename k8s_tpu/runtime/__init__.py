@@ -1,0 +1,15 @@
+"""Local runtime: kubelet simulator + pod executors.
+
+The reference could only exercise its data plane on a real GKE cluster
+(SURVEY §4 tier 3). This package makes the full path — operator →
+materialized Jobs → running processes → exit codes → job status —
+executable in one machine: an in-process "kubelet" watches the
+in-memory cluster and runs pods either simulated (unit tests) or as
+real local subprocesses (integration tests, single-host local mode).
+"""
+
+from k8s_tpu.runtime.kubelet import (  # noqa: F401
+    LocalKubelet,
+    SimulatedExecutor,
+    SubprocessExecutor,
+)

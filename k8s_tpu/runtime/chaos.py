@@ -1,0 +1,79 @@
+"""Chaos monkey: random pod killing for fault-injection testing.
+
+The reference designed for this but shipped it disabled (commented-out
+monkey + unused ``--chaos-level`` flag, ``cmd/tf_operator/main.go:50,
+171-207``; "TODO add chaos" in ``py/test_runner.py:64``). Here it is a
+working subsystem: at a rate set by the level, it force-fails a random
+running pod with a retryable exit code (137, SIGKILL-class), which
+exercises the gang-restart path end-to-end.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Optional
+
+from k8s_tpu.api import errors
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.objects import ContainerState, ContainerStateTerminated
+
+log = logging.getLogger(__name__)
+
+
+class ChaosMonkey:
+    def __init__(
+        self,
+        client: KubeClient,
+        level: int = 0,
+        interval: float = 30.0,
+        seed: Optional[int] = None,
+    ):
+        self.client = client
+        self.level = level
+        self.interval = interval
+        self.rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def kill_one(self) -> Optional[str]:
+        """Force-fail one random running pod (exit 137 = SIGKILL)."""
+        pods = [
+            p
+            for p in self.client.pods.list()
+            if p.status.phase == "Running"
+        ]
+        if not pods:
+            return None
+        victim = self.rng.choice(pods)
+        victim.status.phase = "Failed"
+        for cs in victim.status.container_statuses:
+            cs.state = ContainerState(
+                terminated=ContainerStateTerminated(exit_code=137, reason="Killed")
+            )
+        try:
+            self.client.pods.update(victim)
+        except errors.NotFoundError:
+            return None
+        self.kills += 1
+        log.info("chaos: killed pod %s", victim.metadata.name)
+        return victim.metadata.name
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(self.interval)
+            if self._stop.is_set():
+                return
+            for _ in range(max(1, self.level)):
+                self.kill_one()
+
+    def start(self):
+        if self.level < 0:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="chaos")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,383 @@
+"""In-process kubelet: runs the pods the operator materializes.
+
+Stands in for the node boundary of reference §3.2 ("[kubelet] schedules
+pod, starts container `tensorflow`"): watches batch Jobs in the
+cluster, creates Pods, executes their ``jax`` container, reflects exit
+codes into pod/job status, and applies the batch-Job restart semantics
+(retryable exits restart the pod up to a backoff limit, with
+``restart_count``/``last_state`` bookkeeping so the operator's
+exit-code policy sees crashes that happened before a restart —
+reference ``replicas.go:386-390``).
+
+Service DNS does not exist locally, so the kubelet resolves per-index
+Service names to loopback ports (`LocalServiceResolver`) before
+spawning — the local analogue of kube-dns for the rendezvous contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from k8s_tpu.api import errors
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import WatchEvent
+from k8s_tpu.api.objects import (
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Job,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodStatus,
+)
+from k8s_tpu.spec import CONTAINER_NAME
+
+log = logging.getLogger(__name__)
+
+DEFAULT_BACKOFF_LIMIT = 3
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LocalServiceResolver:
+    """Maps Service DNS names to loopback endpoints, consistently for
+    all pods of a job."""
+
+    def __init__(self):
+        self._ports: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def port_for(self, service_name: str) -> int:
+        with self._lock:
+            if service_name not in self._ports:
+                self._ports[service_name] = _free_port()
+            return self._ports[service_name]
+
+    def rewrite_env(self, env: Dict[str, str], service_names: List[str]) -> Dict[str, str]:
+        """Replace ``<svc>:<port>`` with ``127.0.0.1:<localport>`` and
+        bare service hostnames with ``127.0.0.1`` in env values."""
+        out = dict(env)
+        for name in sorted(service_names, key=len, reverse=True):
+            local = f"127.0.0.1:{self.port_for(name)}"
+            for k, v in out.items():
+                if name in v:
+                    nv = []
+                    i = 0
+                    while i < len(v):
+                        j = v.find(name, i)
+                        if j < 0:
+                            nv.append(v[i:])
+                            break
+                        nv.append(v[i:j])
+                        rest = v[j + len(name) :]
+                        if rest.startswith(":"):
+                            # swallow the original port digits
+                            m = len(rest) - len(rest[1:].lstrip("0123456789")) - 1
+                            nv.append(local)
+                            i = j + len(name) + 1 + m
+                        else:
+                            nv.append("127.0.0.1")
+                            i = j + len(name)
+                    out[k] = "".join(nv)
+        return out
+
+
+class SimulatedExecutor:
+    """Unit-test executor: returns a scripted exit code per pod."""
+
+    def __init__(
+        self,
+        exit_code: int = 0,
+        delay: float = 0.0,
+        fn: Optional[Callable[[Pod], int]] = None,
+    ):
+        self.exit_code = exit_code
+        self.delay = delay
+        self.fn = fn
+
+    def execute(self, pod: Pod, env: Dict[str, str], stop: threading.Event) -> int:
+        if self.delay:
+            stop.wait(self.delay)
+        if self.fn is not None:
+            return self.fn(pod)
+        return self.exit_code
+
+
+class SubprocessExecutor:
+    """Runs the ``jax`` container's command as a real local subprocess
+    with the injected env — the actual data plane, minus containers."""
+
+    def __init__(self, log_dir: Optional[str] = None, extra_env: Optional[Dict[str, str]] = None):
+        self.log_dir = log_dir
+        self.extra_env = extra_env or {}
+        self._procs: List[subprocess.Popen] = []
+
+    def execute(self, pod: Pod, env: Dict[str, str], stop: threading.Event) -> int:
+        container = next(c for c in pod.spec.containers if c.name == CONTAINER_NAME)
+        cmd = list(container.command) + list(container.args)
+        if cmd and cmd[0] == "python":
+            cmd[0] = sys.executable
+        full_env = {**os.environ, **self.extra_env, **env}
+        stdout = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(os.path.join(self.log_dir, f"{pod.metadata.name}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, env=full_env, stdout=stdout, stderr=subprocess.STDOUT if stdout else None
+            )
+            self._procs.append(proc)
+            while proc.poll() is None:
+                if stop.is_set():
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    return 143
+                time.sleep(0.05)
+            return proc.returncode
+        finally:
+            if stdout:
+                stdout.close()
+
+    def shutdown(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+
+
+class LocalKubelet:
+    """Watches batch Jobs and runs their pods."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        executor=None,
+        resolver: Optional[LocalServiceResolver] = None,
+    ):
+        self.client = client
+        self.executor = executor or SimulatedExecutor()
+        self.resolver = resolver or LocalServiceResolver()
+        self._stops: Dict[Tuple[str, str], threading.Event] = {}
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.client.cluster.hooks.append(self._on_event)
+        # adopt jobs that already exist
+        for job in self.client.jobs.list():
+            self._maybe_launch(job)
+
+    def stop(self) -> None:
+        with self._lock:
+            for ev in self._stops.values():
+                ev.set()
+        if hasattr(self.executor, "shutdown"):
+            self.executor.shutdown()
+        for t in self._threads:
+            t.join(timeout=15)
+
+    def wait_idle(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    # ------------------------------------------------------------ events
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if ev.kind != "Job":
+            return
+        key = (ev.namespace, ev.name)
+        if ev.type == "ADDED":
+            job = Job.from_dict(ev.object)
+            self._maybe_launch(job)
+        elif ev.type == "DELETED":
+            with self._lock:
+                stop = self._stops.get(key)
+            if stop is not None:
+                stop.set()
+
+    def _maybe_launch(self, job: Job) -> None:
+        key = (job.metadata.namespace, job.metadata.name)
+        with self._lock:
+            if key in self._stops:
+                return
+            stop = threading.Event()
+            self._stops[key] = stop
+        t = threading.Thread(
+            target=self._run_job, args=(job, stop), daemon=True,
+            name=f"kubelet-{job.metadata.name}",
+        )
+        self._threads.append(t)
+        t.start()
+
+    # ------------------------------------------------------------ pod runs
+
+    def _run_job(self, job: Job, stop: threading.Event) -> None:
+        ns = job.metadata.namespace
+        backoff = job.spec.backoff_limit or DEFAULT_BACKOFF_LIMIT
+        restarts = 0
+        last_state: Optional[ContainerState] = None
+        while not stop.is_set():
+            pod_name = f"{job.metadata.name}-pod-{restarts}"
+            pod = self._create_pod(job, pod_name, restarts, last_state)
+            if pod is None:
+                return
+            self._materialize_volumes(pod, ns)
+            env = self._pod_env(pod, ns)
+            exit_code = self.executor.execute(pod, env, stop)
+            terminated = ContainerStateTerminated(exit_code=exit_code)
+            self._finish_pod(ns, pod_name, terminated, restarts)
+            if exit_code == 0:
+                self._update_job_status(ns, job.metadata.name, succeeded=True)
+                return
+            retryable = 128 <= exit_code <= 255
+            last_state = ContainerState(terminated=terminated)
+            if not retryable or restarts >= backoff:
+                self._update_job_status(ns, job.metadata.name, succeeded=False)
+                return
+            restarts += 1
+
+    def _create_pod(
+        self, job: Job, pod_name: str, restarts: int, last_state: Optional[ContainerState]
+    ) -> Optional[Pod]:
+        template = job.spec.template
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=pod_name,
+                namespace=job.metadata.namespace,
+                labels=dict((template.metadata.labels if template.metadata else {}) or {}),
+                owner_references=[
+                    # owned by the batch Job → cascade-deleted with it
+                    OwnerReference(
+                        api_version="batch/v1", kind="Job",
+                        name=job.metadata.name, uid=job.metadata.uid,
+                    )
+                ],
+                creation_timestamp=time.time(),
+            ),
+            spec=template.spec.deepcopy() if template and template.spec else None,
+            status=PodStatus(
+                phase="Running",
+                start_time=time.time(),
+                container_statuses=[
+                    ContainerStatus(
+                        name=CONTAINER_NAME,
+                        state=ContainerState(running={"startedAt": time.time()}),
+                        last_state=last_state,
+                        restart_count=restarts,
+                    )
+                ],
+            ),
+        )
+        try:
+            return self.client.pods.create(pod)
+        except errors.AlreadyExistsError:
+            return self.client.pods.get(job.metadata.namespace, pod_name)
+        except errors.ApiError as e:
+            log.error("pod create failed: %s", e)
+            return None
+
+    def _materialize_volumes(self, pod: Pod, namespace: str) -> None:
+        """Write ConfigMap volumes to local temp dirs and rewrite
+        container mount paths — the local stand-in for kubelet volume
+        mounting (needed for the default-launcher ConfigMap of
+        reference replicas.go:126-150)."""
+        import tempfile
+
+        if pod.spec is None:
+            return
+        mount_map: Dict[str, str] = {}
+        for v in pod.spec.volumes:
+            if v.config_map is None:
+                continue
+            try:
+                cm = self.client.config_maps.get(namespace, v.config_map.name)
+            except errors.NotFoundError:
+                continue
+            d = tempfile.mkdtemp(prefix=f"ktpu-vol-{v.name}-")
+            for fname, content in cm.data.items():
+                with open(os.path.join(d, fname), "w") as f:
+                    f.write(content)
+            for c in pod.spec.containers:
+                for m in c.volume_mounts:
+                    if m.name == v.name:
+                        mount_map[m.mount_path] = d
+        if mount_map:
+            for c in pod.spec.containers:
+                c.command = [
+                    self._rewrite_path(x, mount_map) for x in c.command
+                ]
+                c.args = [self._rewrite_path(x, mount_map) for x in c.args]
+
+    @staticmethod
+    def _rewrite_path(arg: str, mount_map: Dict[str, str]) -> str:
+        for mount, local in mount_map.items():
+            if arg.startswith(mount):
+                return local + arg[len(mount):]
+        return arg
+
+    def _pod_env(self, pod: Pod, namespace: str) -> Dict[str, str]:
+        container = next(
+            (c for c in (pod.spec.containers if pod.spec else []) if c.name == CONTAINER_NAME),
+            None,
+        )
+        env = container.env_dict() if container else {}
+        service_names = [
+            s.metadata.name for s in self.client.services.list(namespace)
+        ]
+        return self.resolver.rewrite_env(env, service_names)
+
+    def _finish_pod(
+        self, ns: str, pod_name: str, terminated: ContainerStateTerminated, restarts: int
+    ) -> None:
+        try:
+            pod = self.client.pods.get(ns, pod_name)
+        except errors.NotFoundError:
+            return
+        pod.status.phase = "Succeeded" if terminated.exit_code == 0 else "Failed"
+        for cs in pod.status.container_statuses:
+            if cs.name == CONTAINER_NAME:
+                cs.state = ContainerState(terminated=terminated)
+                cs.restart_count = restarts
+        try:
+            self.client.pods.update(pod)
+        except errors.NotFoundError:
+            pass
+
+    def _update_job_status(self, ns: str, name: str, succeeded: bool) -> None:
+        try:
+            job = self.client.jobs.get(ns, name)
+        except errors.NotFoundError:
+            return
+        if succeeded:
+            job.status.succeeded += 1
+            job.status.active = 0
+        else:
+            job.status.failed += 1
+            job.status.active = 0
+        try:
+            self.client.jobs.update(job)
+        except errors.NotFoundError:
+            pass

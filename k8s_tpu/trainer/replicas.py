@@ -336,13 +336,28 @@ class TpuReplicaSet:
 
 def replica_status_from_pod_list(pods: List[Pod], container_name: str) -> str:
     """Classify the newest pod's named-container state (reference
-    ``replicaStatusFromPodList``, replicas.go:359-412): Running →
-    Running; terminated exit 0 → Succeeded, else Failed;
-    LastTerminationState counts too (a crash seen after restart still
-    marks the replica, replicas.go:386-390); waiting/none → Starting."""
+    ``replicaStatusFromPodList``, replicas.go:359-412). Reference
+    semantics preserved exactly:
+
+    - newest pod (by start time) wins;
+    - ``LastTerminationState`` takes *precedence* over the current
+      state when present (:386-390) — a crash seen after restart still
+      drives the classification;
+    - terminated exit 0 → Succeeded; retryable exit (128–255, per
+      ``is_retryable_termination_state``) → **Running**, because the
+      batch-Job controller will restart the container (:398-404);
+      permanent exit → Failed;
+    - running/waiting → Running; no pods yet → Starting.
+    """
+    from k8s_tpu.trainer.training import is_retryable_termination_state
+
     if not pods:
         return ReplicaState.STARTING
-    newest = max(pods, key=lambda p: float(p.metadata.creation_timestamp or 0))
+
+    def start_key(p: Pod) -> float:
+        return float(p.status.start_time or p.metadata.creation_timestamp or 0)
+
+    newest = max(pods, key=start_key)
     status = None
     for cs in newest.status.container_statuses:
         if cs.name == container_name:
@@ -350,13 +365,17 @@ def replica_status_from_pod_list(pods: List[Pod], container_name: str) -> str:
             break
     if status is None:
         return ReplicaState.STARTING
-    for state in (status.state, status.last_state):
-        if state is None:
-            continue
-        if state.terminated is not None:
-            if state.terminated.exit_code == 0:
-                return ReplicaState.SUCCEEDED
-            return ReplicaState.FAILED
-    if status.state is not None and status.state.running is not None:
+    state = status.state
+    if status.last_state is not None and status.last_state.terminated is not None:
+        state = status.last_state
+    if state is None:
+        return ReplicaState.STARTING
+    if state.running is not None or state.waiting is not None:
         return ReplicaState.RUNNING
+    if state.terminated is not None:
+        if state.terminated.exit_code == 0:
+            return ReplicaState.SUCCEEDED
+        if is_retryable_termination_state(state.terminated):
+            return ReplicaState.RUNNING
+        return ReplicaState.FAILED
     return ReplicaState.STARTING

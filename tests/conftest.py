@@ -1,14 +1,20 @@
-"""Test harness config: force a virtual 8-device CPU mesh before JAX loads.
+"""Test harness config: force a virtual 8-device CPU mesh.
 
 This is the capability the reference lacked (SURVEY §4): distributed
-logic testable without real accelerators. All tests run on
-``JAX_PLATFORMS=cpu`` with ``--xla_force_host_platform_device_count=8``.
+logic testable without real accelerators. The environment's
+sitecustomize imports jax with a TPU-tunnel platform at interpreter
+startup, so env vars alone are too late — we switch the backend via
+jax.config before any test touches a device.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# children spawned by the subprocess executor inherit these:
+os.environ["KTPU_FORCE_PLATFORM"] = "cpu"
+os.environ["KTPU_NUM_CPU_DEVICES"] = "8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,78 @@
+"""Real distributed end-to-end: the operator materializes a 2-worker
+TpuJob, the local kubelet launches actual subprocesses running the
+shipped SPMD launcher, the workers rendezvous through the injected
+env (`jax.distributed` over loopback), run the mesh smoke check across
+4 global CPU devices, and the job goes Succeeded.
+
+This is the CPU smoke config (#1 of BASELINE.md) — the successor of the
+reference's ``tf_smoke.py`` e2e, but runnable on any machine instead of
+an ephemeral GKE cluster (SURVEY §4's identified gap). The smoke check
+itself proves every process joined the mesh (the matmul-on-every-device
+trick of ``tf_smoke.py:52-60``).
+"""
+
+import time
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
+from k8s_tpu import spec as S
+
+
+@pytest.mark.integration
+def test_distributed_smoke_job(tmp_path):
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    controller = Controller(client, jc, S.ControllerConfig(), reconcile_interval=0.1)
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+        },
+    )
+    kubelet = LocalKubelet(client, executor)
+    kubelet.start()
+    controller.start()
+    try:
+        # pure default job: a bare 2-worker spec, no template — the
+        # operator synthesizes the launcher (default-PS analogue)
+        j = S.TpuJob()
+        j.metadata.name = "smoke"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+        t0 = time.monotonic()
+        jc.create(j)
+        job = controller.wait_for_job("default", "smoke", timeout=180)
+        first_step_latency = time.monotonic() - t0
+        assert job.status.state == S.TpuJobState.SUCCEEDED, _logs(tmp_path)
+        # both workers ran and the smoke check passed on worker 0
+        log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0)
+        assert '"event": "smoke_ok"' in log0, log0
+        assert '"devices": 4' in log0  # 2 procs × 2 devices aggregated
+        print(f"create→done latency: {first_step_latency:.1f}s")
+    finally:
+        controller.stop()
+        kubelet.stop()
+
+
+def _read_worker_log(tmp_path, rid, idx):
+    import glob
+
+    pats = glob.glob(str(tmp_path / "logs" / f"smoke-worker-{rid}-{idx}-pod-*.log"))
+    return "\n".join(open(p).read() for p in sorted(pats))
+
+
+def _logs(tmp_path):
+    import glob
+
+    out = []
+    for p in glob.glob(str(tmp_path / "logs" / "*.log")):
+        out.append(f"--- {p} ---\n" + open(p).read())
+    return "\n".join(out)

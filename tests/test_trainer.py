@@ -259,12 +259,30 @@ class TestPodListClassification:
         p = self._pod(1, state=ContainerState(terminated=ContainerStateTerminated(exit_code=2)))
         assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.FAILED
 
-    def test_last_state_counts(self):
-        # crash seen after restart still marks the replica failed
+    def test_last_state_takes_precedence_permanent(self):
+        # a permanent crash seen after restart still fails the replica
         p = self._pod(
             1,
             state=ContainerState(running={}),
-            last_state=ContainerState(terminated=ContainerStateTerminated(exit_code=137)),
+            last_state=ContainerState(terminated=ContainerStateTerminated(exit_code=1)),
+        )
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.FAILED
+
+    def test_retryable_exit_is_running(self):
+        # retryable (SIGKILL-class) exit → Running: the batch-Job
+        # controller restarts it (reference replicas.go:398-404)
+        p = self._pod(
+            1,
+            state=ContainerState(terminated=ContainerStateTerminated(exit_code=137)),
+        )
+        assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.RUNNING
+
+    def test_oom_is_failed_even_at_137(self):
+        p = self._pod(
+            1,
+            state=ContainerState(
+                terminated=ContainerStateTerminated(exit_code=137, reason="OOMKilled")
+            ),
         )
         assert replica_status_from_pod_list([p], "jax") == S.ReplicaState.FAILED
 
