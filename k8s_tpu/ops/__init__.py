@@ -1,0 +1,10 @@
+"""TPU compute ops: pallas kernels for the hot paths + XLA fallbacks.
+
+The reference had no in-repo compute (training ran in user containers
+on TF's C++ runtime, SURVEY §0). Here the compute path is first-class:
+flash attention (pallas, MXU-tiled), fused RMSNorm, and the building
+blocks the model zoo uses.
+"""
+
+from k8s_tpu.ops.attention import flash_attention, mha_reference  # noqa: F401
+from k8s_tpu.ops.norms import rms_norm  # noqa: F401

@@ -1,0 +1,225 @@
+"""Flash attention for TPU.
+
+A blockwise online-softmax attention kernel written with Pallas
+(following the TPU kernel playbook: MXU-aligned 128-tiles, VMEM block
+specs, f32 accumulation, ``preferred_element_type``), plus an XLA
+reference path used (a) off-TPU, (b) for small shapes where kernel
+launch overhead dominates, and (c) as the recompute backward.
+
+Design notes (TPU-first, not a port — the reference has no attention
+anywhere; this is new capability per SURVEY §2.5):
+
+- grid = (batch·q_heads, q_blocks); each program streams KV blocks with
+  ``lax.fori_loop`` keeping running max/sum (online softmax) in VMEM
+  scratch, so the S = QKᵀ matrix is never materialized in HBM.
+- causal masking prunes whole KV blocks past the diagonal.
+- GQA: q_heads may be a multiple of kv_heads; the kv head index is
+  derived from the q head index, no KV duplication in memory.
+- backward = recompute with the XLA path under ``jax.custom_vjp``
+  (flash recompute-backward); trades FLOPs for HBM, the right trade on
+  TPU where attention backward is bandwidth-bound.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (also the recompute backward)
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(
+    q: jax.Array,  # [B, Sq, Hq, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention with GQA broadcast, f32 softmax."""
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    assert hq % hkv == 0, (hq, hkv)
+    groups = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    # fold q heads into kv-head groups: [B, Sq, Hkv, G, D]
+    qf = qf.reshape(b, sq, hkv, groups, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k.astype(jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref,  # [block_q, d]
+    k_ref,  # [Sk, d]
+    v_ref,  # [Sk, d]
+    o_ref,  # [block_q, d]
+    *,
+    scale: float,
+    causal: bool,
+    block_k: int,
+    seq_k: int,
+):
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    qi = pl.program_id(1)  # q-block index
+
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        # KV blocks fully above the diagonal contribute nothing.
+        # query rows for this block span [qi*bq, (qi+1)*bq)
+        last_block = jax.lax.div((qi + 1) * block_q - 1, block_k) + 1
+        num_iters = jnp.minimum(num_k_blocks, last_block)
+    else:
+        num_iters = num_k_blocks
+
+    def body(ki, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_new = acc * correction + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    # [B, S, H, D] → [B·H, S, D] with the kv head index recoverable as
+    # (flat_head // groups) for GQA
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+
+    grid = (b * hq, pl.cdiv(sq, block_q))
+
+    # BlockSpec leading dim 1 hands the kernel [1, ·, d] refs; the 3d
+    # wrapper peels it so the math stays 2D.
+    def kernel_3d(q_ref, k_ref, v_ref, o_ref):
+        _flash_kernel(
+            q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
+            scale=scale, causal=causal, block_k=block_k, seq_k=sk,
+        )
+
+    out = pl.pallas_call(
+        kernel_3d,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda h, i: (h // groups, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda h, i: (h // groups, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-backward through the XLA path
+    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-head attention, [B, S, H, D] layout, GQA-aware.
+
+    ``use_pallas=None`` auto-selects: the pallas kernel on TPU back-
+    ends, the XLA path elsewhere (tests run it with ``interpret=True``
+    to validate the kernel itself on CPU).
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if use_pallas is None:
+        platform = jax.devices()[0].platform
+        use_pallas = platform == "tpu" and sq >= 128 and sk >= 128
+    if not use_pallas and not interpret:
+        return mha_reference(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

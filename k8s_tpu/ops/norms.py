@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+RMSNorm with f32 accumulation regardless of input dtype — the bf16-safe
+form every transformer block in :mod:`k8s_tpu.models` uses. XLA fuses
+this into neighboring ops well (per the TPU guidance: don't hand-
+schedule what the compiler already fuses), so a pallas kernel is only
+warranted when fused with the matmul — revisit with profiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,20 @@
+"""Parallelism library: mesh construction, sharding rules, collectives,
+ring attention, pipeline stages.
+
+This is the capability column of SURVEY §2.5: the reference scaled only
+by adding PS/WORKER replicas over TF-gRPC; the TPU-native framework
+scales by laying a logical mesh (data / fsdp / tensor / seq / expert /
+stage axes) over ICI+DCN and letting XLA insert collectives.
+"""
+
+from k8s_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    mesh_for_topology,
+)
+from k8s_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    logical_sharding,
+    shard_init,
+    with_sharding,
+)

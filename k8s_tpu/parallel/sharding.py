@@ -1,0 +1,126 @@
+"""Logical-axis sharding rules.
+
+Models annotate arrays with *logical* axis names ("batch", "embed",
+"mlp", "heads", "kv", "length", "vocab", "expert", "layers"); a rules
+table maps logical names to mesh axes. Changing the parallelism
+strategy = changing the rules table, not the model — the pjit idiom
+that replaces the reference's PS/worker device placement
+(``tf.train.replica_device_setter``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+class LogicalRules:
+    """Ordered logical-name → mesh-axes mapping."""
+
+    # Standard strategy presets. ("fsdp" shards both batch and params;
+    # "tensor" cuts heads/mlp; "seq" cuts sequence length.)
+    DP = (
+        ("batch", ("data", "fsdp")),
+        ("length", None),
+    )
+    FSDP = (
+        ("batch", ("data", "fsdp")),
+        ("embed", "fsdp"),
+        ("length", None),
+    )
+    TP = (
+        ("batch", ("data", "fsdp")),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("length", None),
+    )
+    FSDP_TP = (
+        ("batch", ("data", "fsdp")),
+        ("embed", "fsdp"),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("length", None),
+    )
+    FSDP_TP_SP = (
+        ("batch", ("data", "fsdp")),
+        ("embed", "fsdp"),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("length", "seq"),
+    )
+    MOE = (
+        ("batch", ("data", "fsdp")),
+        ("embed", "fsdp"),
+        ("expert", "expert"),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("length", "seq"),
+    )
+
+    def __init__(self, rules: Sequence[Tuple[str, MeshAxes]]):
+        self._rules: Dict[str, MeshAxes] = dict(rules)
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        return P(*[self._rules.get(a) if a else None for a in logical_axes])
+
+    def extend(self, rules: Sequence[Tuple[str, MeshAxes]]) -> "LogicalRules":
+        merged = dict(self._rules)
+        merged.update(dict(rules))
+        return LogicalRules(tuple(merged.items()))
+
+    def __getitem__(self, name: str) -> MeshAxes:
+        return self._rules.get(name)
+
+
+def logical_sharding(
+    mesh: Mesh, rules: LogicalRules, logical_axes: Sequence[Optional[str]]
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def with_sharding(mesh: Mesh, rules: LogicalRules, x, logical_axes):
+    """In-jit sharding constraint by logical names."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, rules, logical_axes)
+    )
+
+
+def shard_init(mesh: Mesh, rules: LogicalRules, init_fn, annotations):
+    """Eval-shape ``init_fn`` and produce NamedShardings for its pytree.
+
+    ``annotations`` maps pytree paths (joined by '/') to logical-axes
+    tuples; unmatched leaves are replicated. Returns (shardings pytree
+    shaped like the params, abstract shapes)."""
+    abstract = jax.eval_shape(init_fn)
+
+    def path_str(path) -> str:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return "/".join(parts)
+
+    def leaf_sharding(path, leaf):
+        axes = annotations.get(path_str(path))
+        if axes is None:
+            return NamedSharding(mesh, P())
+        return logical_sharding(mesh, rules, axes)
+
+    shardings = jax.tree_util.tree_map_with_path(leaf_sharding, abstract)
+    return shardings, abstract
