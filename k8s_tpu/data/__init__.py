@@ -1,0 +1,7 @@
+"""Input pipelines: synthetic benchmark data + simple real loaders."""
+
+from k8s_tpu.data.synthetic import (  # noqa: F401
+    synthetic_image_batches,
+    synthetic_mnist,
+    synthetic_token_batches,
+)

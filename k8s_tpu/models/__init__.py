@@ -1,0 +1,16 @@
+"""Model zoo for the five benchmark configs (BASELINE.md):
+
+#1 CPU smoke (mesh check, launcher built-in) · #2 MNIST (v5e-8 DP) ·
+#3 ResNet-50/ImageNet (v5p-16 DP) · #4 BERT-base (v5p-64 TP) ·
+#5 Llama-3-8B (v5p-128 multi-slice FSDP).
+
+All models are flax.linen with logical-axis partitioning metadata, so
+the parallel strategy is a rules table (k8s_tpu.parallel.sharding), not
+a model edit. Compute dtype is bf16 with f32 params/accumulation (MXU-
+native), shapes static, layers scanned where depth warrants it.
+"""
+
+from k8s_tpu.models.mnist import MnistCNN  # noqa: F401
+from k8s_tpu.models.resnet import ResNet, ResNet50  # noqa: F401
+from k8s_tpu.models.bert import BertConfig, BertForPretraining  # noqa: F401
+from k8s_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: F401

@@ -1,0 +1,126 @@
+"""BERT-base encoder + MLM/NSP pretraining heads — benchmark config #4
+(pjit model-parallel on v5p-64).
+
+Bidirectional (non-causal) attention on the same flash-attention
+kernel, GELU MLP, learned positional embeddings, logical partitioning
+identical in spirit to the Llama model so the TP rules table shards
+heads/mlp/vocab over the ``tensor`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from k8s_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                    num_layers=2, num_heads=4, max_seq_len=128)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+def _dense(features, axes, name, dtype, axis=-1):
+    return nn.DenseGeneral(
+        features=features,
+        axis=axis,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), axes
+        ),
+        name=name,
+    )
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h, d = cfg.num_heads, cfg.head_dim
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_attn")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_mlp")
+        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype)(x)
+        k = _dense((h, d), ("embed", "heads", "head_dim"), "k_proj", cfg.dtype)(x)
+        v = _dense((h, d), ("embed", "heads", "head_dim"), "v_proj", cfg.dtype)(x)
+        q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
+        attn = flash_attention(q, k, v, causal=False)
+        attn = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("heads", "head_dim", "embed")
+            ),
+            name="o_proj",
+        )(attn)
+        x = ln1(x + attn)
+        y = _dense(cfg.intermediate_size, ("embed", "mlp"), "fc_in", cfg.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
+        y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc_out", cfg.dtype)(y)
+        return ln2(x + y)
+
+
+class BertForPretraining(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="tok_embed",
+        )(input_ids)
+        pos = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="pos_embed",
+        )(jnp.broadcast_to(jnp.arange(s), (b, s)))
+        x = tok + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name="type_embed",
+            )(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_embed")(x)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x)
+        mlm_logits = nn.DenseGeneral(
+            features=cfg.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+            ),
+            name="mlm_head",
+        )(x)
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_head")(x[:, 0])
+        return mlm_logits, nsp_logits

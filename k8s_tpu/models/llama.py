@@ -1,0 +1,228 @@
+"""Llama-family decoder — benchmark config #5 (Llama-3-8B FSDP on
+multi-slice v5p-128 over DCN).
+
+TPU-first transformer: RMSNorm (f32), rotary embeddings, grouped-query
+attention running on the in-repo flash-attention pallas kernel (or the
+ring-attention path when the ``seq`` mesh axis is >1 — long-context
+context-parallelism, SURVEY §5's "must introduce" item), SwiGLU MLP,
+bf16 compute / f32 params. Layers are ``nn.scan``-stacked (one XLA
+while-loop, O(1) compile time in depth) with ``nn.remat``
+rematerialization to trade FLOPs for HBM.
+
+Every parameter carries logical-axis metadata
+(``nn.with_logical_partitioning``), so DP/FSDP/TP/SP are rule-table
+swaps (k8s_tpu.parallel.sharding.LogicalRules), not model edits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from k8s_tpu.ops.attention import flash_attention
+from k8s_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = True
+    scan_layers: bool = True
+    # "flash" (pallas kernel / XLA fallback) or "ring" (context-parallel
+    # over the `seq` mesh axis; requires mesh)
+    attention: str = "flash"
+    mesh: Optional[object] = dataclasses.field(default=None, hash=False, compare=False)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+            max_seq_len=256, remat=False,
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding, [B, S, H, D] layout, f32 rotation."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _dense(features, axes, name, dtype):
+    return nn.DenseGeneral(
+        features=features,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes
+        ),
+        name=name,
+    )
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        h, kv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype)(x)
+        k = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "k_proj", cfg.dtype)(x)
+        v = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "v_proj", cfg.dtype)(x)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "length", "kv_heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "length", "kv_heads", "head_dim"))
+        if cfg.attention == "ring":
+            from k8s_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, cfg.mesh, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
+            ),
+            name="o_proj",
+        )(out)
+        return out
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj", cfg.dtype)(x)
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.dtype)(x)
+        y = nn.silu(gate) * up
+        y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
+        return _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.dtype)(y)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    axis_name: str = "embed"
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.ones, (self.axis_name,)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        return rms_norm(x, w, self.eps)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
+        x = x + LlamaAttention(cfg, name="attn")(h, positions)
+        h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
+        x = x + LlamaMLP(cfg, name="mlp")(h)
+        return x
+
+
+class _ScannedBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return LlamaBlock(self.config, name="block")(x, positions), None
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):  # [B, S] int32
+        cfg = self.config
+        b, s = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        x = embed(input_ids)
+        if cfg.scan_layers:
+            block_cls = _ScannedBlock
+            if cfg.remat:
+                block_cls = nn.remat(
+                    block_cls,
+                    prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x, positions)
+        else:
+            block = LlamaBlock
+            if cfg.remat:
+                block = nn.remat(block, prevent_cse=False)
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+        return logits

@@ -75,6 +75,16 @@ class LogicalRules:
     def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
         return P(*[self._rules.get(a) if a else None for a in logical_axes])
 
+    def to_flax(self) -> Tuple[Tuple[str, MeshAxes], ...]:
+        """Rules in the shape flax.linen.spmd expects (plus the scan
+        layer axis, always replicated)."""
+        base = tuple(self._rules.items())
+        if "layers" not in self._rules:
+            base = base + (("layers", None),)
+        if "head_dim" not in self._rules:
+            base = base + (("head_dim", None),)
+        return base
+
     def extend(self, rules: Sequence[Tuple[str, MeshAxes]]) -> "LogicalRules":
         merged = dict(self._rules)
         merged.update(dict(rules))

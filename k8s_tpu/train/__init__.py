@@ -1,0 +1,11 @@
+"""Training library: sharded state creation, pjit train steps, losses,
+metrics, checkpointing.
+"""
+
+from k8s_tpu.train.trainer_lib import (  # noqa: F401
+    TrainStepFn,
+    create_sharded_state,
+    cross_entropy_loss,
+    make_train_step,
+    shardings_from_logical,
+)

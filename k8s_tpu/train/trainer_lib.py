@@ -1,0 +1,219 @@
+"""Sharded training: state creation + pjit train-step builder.
+
+The single-controller SPMD replacement for the reference's
+between-graph PS training (SURVEY §2.5): parameters and optimizer
+state are laid out by the logical-rules table over the mesh; the train
+step is one jitted program — XLA inserts the gradient psum over
+``data``, per-layer all-gathers for FSDP, activation all-reduces for
+TP, and ring ppermutes for SP, from the sharding annotations alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_tpu.parallel.sharding import LogicalRules
+
+TrainStepFn = Callable[..., Tuple[Any, Dict[str, jax.Array]]]
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + optional mutable batch stats (BatchNorm)."""
+
+    batch_stats: Optional[Any] = None
+
+
+# ---------------------------------------------------------------------------
+# Sharding derivation
+# ---------------------------------------------------------------------------
+
+
+def shardings_from_logical(init_fn, mesh: Mesh, rules: LogicalRules):
+    """Eval-shape a boxed-variables ``init_fn`` and map its logical-axis
+    metadata to NamedShardings. Returns (shardings, unboxed abstract)."""
+    abstract = jax.eval_shape(init_fn)
+    logical = nn.get_partition_spec(abstract)
+    mesh_specs = nn.logical_to_mesh(logical, rules.to_flax())
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s) if isinstance(s, P) else NamedSharding(mesh, P()),
+        mesh_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return shardings
+
+
+def create_sharded_state(
+    model: nn.Module,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: LogicalRules,
+    rng: jax.Array,
+    example_batch: Any,
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    """Initialize a TrainState with every leaf placed per the rules.
+
+    Params are initialized under jit with explicit out_shardings (no
+    host-side full materialization); optimizer state inherits the
+    params' layout through GSPMD propagation.
+    """
+    init_kwargs = init_kwargs or {}
+
+    def boxed_init():
+        return model.init(rng, example_batch, **init_kwargs)
+
+    shardings = shardings_from_logical(boxed_init, mesh, rules)
+
+    def unboxed_init():
+        return nn.unbox(boxed_init())
+
+    unboxed_shardings = nn.unbox(shardings)
+    with nn.logical_axis_rules(rules.to_flax()):
+        variables = jax.jit(unboxed_init, out_shardings=unboxed_shardings)()
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+    param_shardings = unboxed_shardings["params"]
+
+    def build(params, batch_stats):
+        state = TrainState.create(
+            apply_fn=model.apply,
+            params=params,
+            tx=optimizer,
+            batch_stats=batch_stats,
+        )
+        # ZeRO invariant: optimizer moments live with their params —
+        # constrain every params-shaped subtree of the opt state.
+        opt_state = _constrain_params_like(
+            state.opt_state, params, param_shardings
+        )
+        return state.replace(opt_state=opt_state)
+
+    return jax.jit(build)(params, batch_stats)
+
+
+def _constrain_params_like(tree, params, param_shardings):
+    """Apply params' shardings to any subtree structurally identical to
+    the params tree (adam mu/nu, momentum buffers, …)."""
+    params_treedef = jax.tree_util.tree_structure(params)
+
+    def is_params_like(x):
+        if x is params:
+            return True
+        try:
+            return jax.tree_util.tree_structure(x) == params_treedef
+        except Exception:
+            return False
+
+    def constrain(sub):
+        if not is_params_like(sub):
+            return sub
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(x, s),
+            sub,
+            param_shardings,
+        )
+
+    return jax.tree_util.tree_map(constrain, tree, is_leaf=is_params_like)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [..., V] f32
+    labels: jax.Array,  # [...] int32
+    mask: Optional[jax.Array] = None,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Token-level CE with optional masking and z-loss regularizer
+    (stabilizes the softmax normalizer at scale)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    losses = logz - label_logits
+    if z_loss:
+        losses = losses + z_loss * jnp.square(logz)
+    if mask is not None:
+        maskf = mask.astype(losses.dtype)
+        return jnp.sum(losses * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+    return jnp.mean(losses)
+
+
+# ---------------------------------------------------------------------------
+# Train step
+# ---------------------------------------------------------------------------
+
+
+def make_batch_sharder(mesh: Mesh, rules: LogicalRules):
+    """Rank-aware batch placement: dim 0 of every array leaf is sharded
+    over the ``batch`` logical axis, the rest replicated — the host→
+    device edge of the input pipeline."""
+    axes = rules["batch"]
+
+    def put(x):
+        x = jnp.asarray(x)
+        spec = P(axes) if x.ndim >= 1 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return lambda batch: jax.tree_util.tree_map(put, batch)
+
+
+def make_train_step(
+    loss_fn: Callable[[TrainState, Any, Any, jax.Array], Tuple[jax.Array, Dict]],
+    mesh: Mesh,
+    rules: LogicalRules,
+    donate: bool = True,
+) -> TrainStepFn:
+    """Build the jitted SPMD train step.
+
+    ``loss_fn(state, params, batch, rng) -> (loss, aux)`` where ``aux``
+    may carry mutable collections (e.g. ``{"batch_stats": ...}``) and
+    scalar metrics. The step runs under the logical-rules context so
+    in-model ``with_logical_constraint`` resolve against this mesh.
+    Batches are placed by :func:`make_batch_sharder` before the call,
+    so jit adopts their data-parallel layout.
+    """
+    shard_batch = make_batch_sharder(mesh, rules)
+
+    def step(state: TrainState, batch, rng):
+        def compute(params):
+            return loss_fn(state, params, batch, rng)
+
+        (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if aux and "batch_stats" in aux:
+            new_state = new_state.replace(batch_stats=aux.pop("batch_stats"))
+        metrics = {"loss": loss, **{k: v for k, v in (aux or {}).items()}}
+        return new_state, metrics
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def run(state, batch, rng):
+        with nn.logical_axis_rules(rules.to_flax()):
+            return jitted(state, shard_batch(batch), rng)
+
+    return run
+
+
+def make_eval_step(loss_fn, mesh: Mesh, rules: LogicalRules):
+    shard_batch = make_batch_sharder(mesh, rules)
+
+    def step(state: TrainState, batch, rng):
+        loss, aux = loss_fn(state, state.params, batch, rng)
+        return {"loss": loss, **{k: v for k, v in (aux or {}).items() if k != "batch_stats"}}
+
+    jitted = jax.jit(step)
+
+    def run(state, batch, rng):
+        with nn.logical_axis_rules(rules.to_flax()):
+            return jitted(state, shard_batch(batch), rng)
+
+    return run
