@@ -1,0 +1,102 @@
+"""Benchmark runner — prints ONE JSON line.
+
+North-star metric (BASELINE.json): ResNet-50 training throughput per
+chip. The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is reported as 1.0 by convention against our own
+recorded series.
+
+Runs a full bf16 ResNet-50 train step (fwd+bwd+SGD-momentum+BN stats)
+on synthetic ImageNet-shaped data on whatever accelerator the runtime
+exposes (the driver runs it on one real TPU chip).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.default_backend()
+    if platform not in ("tpu", "gpu"):
+        # keep the CPU path cheap but exercising the same code
+        batch_size, image_size, warmup, iters = 8, 64, 1, 3
+    else:
+        batch_size, image_size, warmup, iters = 256, 224, 5, 20
+
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.data import synthetic_image_batches
+    from k8s_tpu.models import ResNet50
+    from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+    from k8s_tpu.train import (
+        create_sharded_state,
+        cross_entropy_loss,
+        make_batch_sharder,
+        make_train_step,
+    )
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh(MeshConfig(data=n_chips))
+    rules = LogicalRules(LogicalRules.DP)
+    model = ResNet50(num_classes=1000)
+
+    batch = next(synthetic_image_batches(batch_size, image_size))
+    state = create_sharded_state(
+        model,
+        optax.sgd(0.1, momentum=0.9, nesterov=True),
+        mesh,
+        rules,
+        jax.random.PRNGKey(0),
+        batch["images"],
+        init_kwargs={"train": False},
+    )
+
+    def loss_fn(state, params, b, rng):
+        logits, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            b["images"],
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, b["labels"]), {
+            "batch_stats": mutated["batch_stats"]
+        }
+
+    step = make_train_step(loss_fn, mesh, rules)
+    rng = jax.random.PRNGKey(1)
+    # pre-place the batch: steady-state training is compute-bound, the
+    # input pipeline double-buffers ahead; don't measure host transfer
+    batch = make_batch_sharder(mesh, rules)(batch)
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+
+    steps_per_sec = iters / elapsed
+    images_per_sec_per_chip = steps_per_sec * batch_size / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(images_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

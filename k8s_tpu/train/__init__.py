@@ -6,6 +6,8 @@ from k8s_tpu.train.trainer_lib import (  # noqa: F401
     TrainStepFn,
     create_sharded_state,
     cross_entropy_loss,
+    make_batch_sharder,
+    make_eval_step,
     make_train_step,
     shardings_from_logical,
 )
