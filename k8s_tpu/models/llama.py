@@ -46,6 +46,10 @@ class LlamaConfig:
     # over the `seq` mesh axis; requires mesh)
     attention: str = "flash"
     mesh: Optional[object] = dataclasses.field(default=None, hash=False, compare=False)
+    # Mixture-of-Experts: >0 replaces the dense MLP with a top-2 routed
+    # expert MLP sharded over the `expert` mesh axis
+    num_experts: int = 0
+    expert_capacity_factor: float = 2.0
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -161,7 +165,19 @@ class LlamaBlock(nn.Module):
         h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
         x = x + LlamaAttention(cfg, name="attn")(h, positions)
         h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
-        x = x + LlamaMLP(cfg, name="mlp")(h)
+        if cfg.num_experts > 0:
+            from k8s_tpu.models.moe import MoeConfig, MoeMlp
+
+            moe_cfg = MoeConfig(
+                num_experts=cfg.num_experts,
+                expert_capacity_factor=cfg.expert_capacity_factor,
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                dtype=cfg.dtype,
+            )
+            x = x + MoeMlp(moe_cfg, name="moe_mlp")(h)
+        else:
+            x = x + LlamaMLP(cfg, name="mlp")(h)
         return x
 
 

@@ -1,0 +1,120 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Completes the EP row of SURVEY §2.5 (absent in the reference). A
+top-2-gated expert MLP whose expert dimension is sharded over the
+``expert`` mesh axis. The token→expert routing uses the dense
+"einsum dispatch" formulation: dispatch/combine one-hot einsums lower
+to all-to-all-shaped collectives under GSPMD, which is the
+compiler-friendly (static-shape, MXU-dense) way to express MoE on TPU
+— no scatter/gather, no dynamic shapes inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    expert_capacity_factor: float = 2.0
+    top_k: int = 2
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+    router_aux_loss_weight: float = 0.01
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed expert SwiGLU MLP, capacity-bounded."""
+
+    config: MoeConfig
+
+    @nn.compact
+    def __call__(self, x):  # [B, S, E_model]
+        cfg = self.config
+        b, s, d = x.shape
+        n_tok = b * s
+        e = cfg.num_experts
+        capacity = max(
+            1, int(cfg.expert_capacity_factor * n_tok * cfg.top_k / e)
+        )
+
+        tokens = x.reshape(n_tok, d)
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "expert")
+            ),
+            name="router",
+        )(tokens.astype(jnp.float32))  # [T, E]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+
+        # top-k choice per token
+        gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, K]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9
+        )
+
+        # position of each (token, k) within its expert's capacity
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [T, K, E]
+        # priority: k=0 assignments first, then token order
+        flat = onehot.transpose(1, 0, 2).reshape(cfg.top_k * n_tok, e)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat  # [K·T, E]
+        pos = pos_flat.reshape(cfg.top_k, n_tok, e).transpose(1, 0, 2)  # [T,K,E]
+        within_cap = (pos < capacity) & (onehot > 0)
+        slot = jnp.sum(pos * onehot, axis=-1)  # [T, K]
+
+        # dispatch tensor [T, K, E, C] → combine over (K)
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=x.dtype)  # [T,K,C]
+        keep = within_cap.any(-1).astype(x.dtype)  # [T, K]
+        dispatch = (
+            onehot.astype(x.dtype)[..., None]
+            * slot_oh[:, :, None, :]
+            * keep[..., None, None]
+        )  # [T, K, E, C]
+        combine = dispatch * gate_vals[..., None, None].astype(x.dtype)
+
+        # route tokens to expert buffers: [E, C, D]
+        expert_in = jnp.einsum("tkec,td->ecd", dispatch, tokens)
+        expert_in = nn.with_logical_constraint(expert_in, ("expert", None, "embed"))
+
+        # expert MLPs (weights stacked on the expert axis)
+        def pdense(features, axes, name):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(batch_axis=(0,)), axes
+                ),
+                (e, *features),
+                jnp.float32,
+            )
+
+        w_gate = pdense((cfg.hidden_size, cfg.intermediate_size),
+                        ("expert", "embed", "mlp"), "w_gate")
+        w_up = pdense((cfg.hidden_size, cfg.intermediate_size),
+                      ("expert", "embed", "mlp"), "w_up")
+        w_down = pdense((cfg.intermediate_size, cfg.hidden_size),
+                        ("expert", "mlp", "embed"), "w_down")
+        h = jnp.einsum("ecd,edm->ecm", expert_in, w_gate.astype(cfg.dtype))
+        u = jnp.einsum("ecd,edm->ecm", expert_in, w_up.astype(cfg.dtype))
+        h = nn.silu(h) * u
+        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+        expert_out = jnp.einsum("ecm,emd->ecd", h, w_down.astype(cfg.dtype))
+
+        # combine back to tokens
+        out = jnp.einsum("tkec,ecd->td", combine, expert_out)
+        out = out.reshape(b, s, d)
+
+        # load-balancing auxiliary loss (Switch-style): mean prob ×
+        # fraction routed, summed over experts
+        me = probs.mean(axis=0)  # [E]
+        ce = onehot[:, 0, :].astype(jnp.float32).mean(axis=0)  # top-1 fraction
+        aux_loss = cfg.router_aux_loss_weight * e * jnp.sum(me * ce)
+        self.sow("intermediates", "router_aux_loss", aux_loss)
+        return out

@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the
+``stage`` mesh axis.
+
+Completes the PP row of SURVEY §2.5 (absent in the reference). Stage
+weights are stacked on a leading axis sharded over ``stage``; inside
+``shard_map`` each device runs its stage function while activations
+hop stage→stage via ``jax.lax.ppermute``. The steady state keeps every
+stage busy; bubble fraction is (S-1)/(M+S-1) for S stages and M
+microbatches. The schedule is a ``lax.scan`` (reverse-differentiable,
+single compiled loop).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _stage_body(
+    params,  # this stage's params (leading stage axis peeled)
+    microbatches,  # [M, mb, ...] same on every stage (stage 0 consumes)
+    fn: Callable,
+    axis_name: str,
+):
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    steps = m + n - 1
+
+    out_shape = jax.eval_shape(fn, params, microbatches[0])
+    outputs0 = jnp.zeros((m, *out_shape.shape), out_shape.dtype)
+    carry0 = jnp.zeros(out_shape.shape, out_shape.dtype)
+
+    def step(state, t):
+        carry, outputs = state
+        mb_idx = jnp.clip(t, 0, m - 1)
+        x_in = jnp.where(idx == 0, microbatches[mb_idx], carry)
+        y = fn(params, x_in)
+        # send my activation to the next stage (last stage's output
+        # falls off the end of the line)
+        perm = [(i, i + 1) for i in range(n - 1)]
+        carry_next = jax.lax.ppermute(y, axis_name, perm)
+        # the last stage emits microbatch t-(n-1) at step t
+        out_t = t - (n - 1)
+        is_emit = (idx == n - 1) & (out_t >= 0)
+        safe_t = jnp.clip(out_t, 0, m - 1)
+        outputs = jnp.where(
+            is_emit,
+            outputs.at[safe_t].set(y),
+            outputs,
+        )
+        return (carry_next, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(step, (carry0, outputs0), jnp.arange(steps))
+    # only the last stage holds real outputs; share them ring-wide so
+    # the loss is computable anywhere (psum of one-hot contribution)
+    outputs = jax.lax.psum(
+        jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+    return outputs
+
+
+def pipeline_apply(
+    fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,  # leaves [n_stages, ...], sharded on "stage"
+    x: jax.Array,  # [batch, ...] global
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "stage",
+    batch_axes=("data", "fsdp"),
+) -> jax.Array:
+    """Run ``fn`` as a pipeline: ``fn(stage_params, x) -> y`` must be
+    shape-preserving across stages (classic transformer-block stack).
+    Returns fn's output for the full batch, microbatched through the
+    stages."""
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params
+    )
+    x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+
+    def body(params, xs):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)  # peel stage dim
+        mbs = xs.reshape(num_microbatches, -1, *xs.shape[1:])
+        out = _stage_body(params, mbs, fn, axis_name)
+        return out.reshape(-1, *out.shape[2:])
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_rep=False,
+    )(stacked_params, x)

@@ -103,7 +103,7 @@ def ring_attention(
 ):
     """Global-array form: shards length over ``seq``, batch over
     data/fsdp, heads over tensor, and runs the ring body."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec_q = P(batch_axes, axis_name, head_axis, None)
     body = partial(
