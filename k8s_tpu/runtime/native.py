@@ -1,0 +1,96 @@
+"""ctypes bindings for the C++ runtime (``native/ktpu_runtime.cc``).
+
+Builds the shared library on first use (g++, no external deps). The
+native layer owns what the reference delegated to TF's C++ runtime:
+process supervision with the exit-code contract, the liveness probe
+endpoint, and the TCP gang barrier.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+NATIVE_DIR = os.path.join(_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+LIB_PATH = os.path.join(BUILD_DIR, "libktpu_runtime.so")
+SUPERVISOR_PATH = os.path.join(BUILD_DIR, "ktpu_supervisor")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> None:
+    with _lock:
+        if not force and os.path.exists(LIB_PATH) and os.path.exists(SUPERVISOR_PATH):
+            return
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR, "all"],
+            check=True,
+            capture_output=True,
+        )
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.ktpu_health_start.argtypes = [ctypes.c_int]
+    lib.ktpu_health_start.restype = ctypes.c_int
+    lib.ktpu_health_set_phase.argtypes = [ctypes.c_int]
+    lib.ktpu_health_stop.argtypes = []
+    lib.ktpu_wait_for_endpoint.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.ktpu_wait_for_endpoint.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class HealthServer:
+    """Liveness endpoint backed by the native thread (phase:
+    starting/running/done/failed)."""
+
+    PHASES = {"starting": 0, "running": 1, "done": 2, "failed": 3}
+
+    def __init__(self, port: int = 0):
+        self._lib = load()
+        r = self._lib.ktpu_health_start(port)
+        if r < 0:
+            raise OSError(-r, os.strerror(-r))
+        self.port = r
+
+    def set_phase(self, phase: str) -> None:
+        self._lib.ktpu_health_set_phase(self.PHASES[phase])
+
+    def stop(self) -> None:
+        self._lib.ktpu_health_stop()
+
+
+def wait_for_endpoint(host: str, port: int, timeout_s: float = 300.0) -> bool:
+    lib = load()
+    return lib.ktpu_wait_for_endpoint(host.encode(), port, int(timeout_s * 1000)) == 0
+
+
+def supervisor_command(
+    cmd: List[str],
+    health_port: Optional[int] = None,
+    wait_for: Optional[str] = None,
+    wait_timeout_s: float = 300.0,
+) -> List[str]:
+    """Wrap a container command with the native supervisor binary."""
+    build_native()
+    out = [SUPERVISOR_PATH]
+    if health_port is not None:
+        out += ["--health-port", str(health_port)]
+    if wait_for:
+        out += ["--wait-for", wait_for, "--wait-timeout-ms", str(int(wait_timeout_s * 1000))]
+    return out + ["--"] + cmd

@@ -1,0 +1,206 @@
+// ktpu native runtime: process supervisor, health prober, rendezvous
+// barrier.
+//
+// The TPU-native stand-in for the native responsibilities the reference
+// delegated to TensorFlow's C++ gRPC server (reference
+// grpc_tensorflow_server/grpc_tensorflow_server.py:112 starts the TF
+// C++ runtime; liveness == "gRPC port 2222 is bound"). Here:
+//
+//  - run_supervised(): fork/exec the training command, forward
+//    SIGTERM/SIGINT to the child's process group, return the exit code
+//    the operator's retry policy classifies (0 / 1-127 / 128-255).
+//  - health server: a background thread serving a one-line TCP
+//    protocol ("OK <phase>\n") for K8s liveness/readiness probes.
+//  - wait_for_endpoint(): TCP dial with deadline — the gang barrier
+//    that lets workers wait for the coordinator's Service DNS before
+//    burning the JAX init timeout.
+//
+// Exposed as a C ABI for the ctypes bindings in
+// k8s_tpu/runtime/native.py and as the ktpu_supervisor CLI.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <thread>
+#include <unistd.h>
+
+namespace {
+
+std::atomic<int> g_health_phase{0};  // 0=starting 1=running 2=done 3=failed
+std::atomic<int> g_health_fd{-1};
+std::atomic<bool> g_health_stop{false};
+std::thread* g_health_thread = nullptr;
+
+const char* phase_name(int p) {
+  switch (p) {
+    case 1: return "running";
+    case 2: return "done";
+    case 3: return "failed";
+    default: return "starting";
+  }
+}
+
+void health_loop(int listen_fd) {
+  while (!g_health_stop.load()) {
+    struct pollfd pfd;
+    pfd.fd = listen_fd;
+    pfd.events = POLLIN;
+    int r = poll(&pfd, 1, 200 /*ms*/);
+    if (r <= 0) continue;
+    int fd = accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    char buf[64];
+    int n = snprintf(buf, sizeof(buf), "OK %s\n",
+                     phase_name(g_health_phase.load()));
+    (void)!write(fd, buf, n);
+    close(fd);
+  }
+  close(listen_fd);
+}
+
+volatile sig_atomic_t g_child_pid = -1;
+
+void forward_signal(int sig) {
+  pid_t pid = g_child_pid;
+  if (pid > 0) kill(-pid, sig);  // whole process group
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Health server
+// ---------------------------------------------------------------------------
+
+// Returns the bound port (useful with port=0), or -errno on failure.
+int ktpu_health_start(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) < 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  if (listen(fd, 8) < 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, (struct sockaddr*)&addr, &len);
+  g_health_stop.store(false);
+  g_health_fd.store(fd);
+  g_health_thread = new std::thread(health_loop, fd);
+  return ntohs(addr.sin_port);
+}
+
+void ktpu_health_set_phase(int phase) { g_health_phase.store(phase); }
+
+void ktpu_health_stop() {
+  if (g_health_thread) {
+    g_health_stop.store(true);
+    g_health_thread->join();
+    delete g_health_thread;
+    g_health_thread = nullptr;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Rendezvous barrier
+// ---------------------------------------------------------------------------
+
+// Dial host:port until success or timeout_ms. 0 on success, -1 timeout,
+// -2 resolve failure.
+int ktpu_wait_for_endpoint(const char* host, int port, int timeout_ms) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%d", port);
+  while (true) {
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host, port_str, &hints, &res) == 0 && res != nullptr) {
+      int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) {
+        struct timeval tv = {1, 0};
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        int ok = connect(fd, res->ai_addr, res->ai_addrlen);
+        close(fd);
+        if (ok == 0) {
+          freeaddrinfo(res);
+          return 0;
+        }
+      }
+      freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    usleep(250 * 1000);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Supervisor
+// ---------------------------------------------------------------------------
+
+// fork/exec argv (NULL-terminated), put the child in its own process
+// group, forward SIGTERM/SIGINT, and return the operator-contract exit
+// code: child's exit status, or 128+signal if signal-killed.
+int ktpu_run_supervised(char* const argv[]) {
+  pid_t pid = fork();
+  if (pid < 0) return 125;
+  if (pid == 0) {
+    setpgid(0, 0);
+    execvp(argv[0], argv);
+    fprintf(stderr, "ktpu_supervisor: exec %s failed: %s\n", argv[0],
+            strerror(errno));
+    _exit(127);
+  }
+  setpgid(pid, pid);
+  g_child_pid = pid;
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = forward_signal;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  ktpu_health_set_phase(1);
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0) {
+    if (errno != EINTR) return 125;
+  }
+  g_child_pid = -1;
+  int code;
+  if (WIFEXITED(status)) {
+    code = WEXITSTATUS(status);
+  } else if (WIFSIGNALED(status)) {
+    code = 128 + WTERMSIG(status);  // the retryable band of the policy
+  } else {
+    code = 125;
+  }
+  ktpu_health_set_phase(code == 0 ? 2 : 3);
+  return code;
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+// ktpu_supervisor: pod-level entrypoint wrapper.
+//
+//   ktpu_supervisor [--health-port N] [--wait-for host:port]
+//                   [--wait-timeout-ms N] -- cmd args...
+//
+// Runs the health prober, optionally gates on the coordinator endpoint
+// (gang barrier), then supervises the training command and exits with
+// the operator-contract code (0 / 1-127 permanent / 128-255 retryable).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int ktpu_health_start(int port);
+void ktpu_health_stop();
+void ktpu_health_set_phase(int phase);
+int ktpu_wait_for_endpoint(const char* host, int port, int timeout_ms);
+int ktpu_run_supervised(char* const argv[]);
+}
+
+int main(int argc, char** argv) {
+  int health_port = -1;
+  std::string wait_host;
+  int wait_port = 0;
+  int wait_timeout_ms = 300000;
+  int i = 1;
+  for (; i < argc; i++) {
+    if (strcmp(argv[i], "--") == 0) {
+      i++;
+      break;
+    } else if (strcmp(argv[i], "--health-port") == 0 && i + 1 < argc) {
+      health_port = atoi(argv[++i]);
+    } else if (strcmp(argv[i], "--wait-for") == 0 && i + 1 < argc) {
+      std::string hp = argv[++i];
+      auto colon = hp.rfind(':');
+      if (colon == std::string::npos) {
+        fprintf(stderr, "--wait-for needs host:port\n");
+        return 2;
+      }
+      wait_host = hp.substr(0, colon);
+      wait_port = atoi(hp.c_str() + colon + 1);
+    } else if (strcmp(argv[i], "--wait-timeout-ms") == 0 && i + 1 < argc) {
+      wait_timeout_ms = atoi(argv[++i]);
+    } else {
+      fprintf(stderr, "unknown flag %s\n", argv[i]);
+      return 2;
+    }
+  }
+  if (i >= argc) {
+    fprintf(stderr,
+            "usage: ktpu_supervisor [--health-port N] [--wait-for host:port] "
+            "[--wait-timeout-ms N] -- cmd args...\n");
+    return 2;
+  }
+  if (health_port >= 0) {
+    int r = ktpu_health_start(health_port);
+    if (r < 0) {
+      fprintf(stderr, "health server failed: %s\n", strerror(-r));
+      return 2;
+    }
+    fprintf(stderr, "ktpu_supervisor: health on port %d\n", r);
+  }
+  if (!wait_host.empty()) {
+    fprintf(stderr, "ktpu_supervisor: waiting for %s:%d\n", wait_host.c_str(),
+            wait_port);
+    if (ktpu_wait_for_endpoint(wait_host.c_str(), wait_port, wait_timeout_ms) !=
+        0) {
+      fprintf(stderr, "ktpu_supervisor: coordinator wait timed out\n");
+      ktpu_health_stop();
+      return 143;  // retryable: gang restart may fix it
+    }
+  }
+  std::vector<char*> child_argv;
+  for (int j = i; j < argc; j++) child_argv.push_back(argv[j]);
+  child_argv.push_back(nullptr);
+  int code = ktpu_run_supervised(child_argv.data());
+  ktpu_health_stop();
+  return code;
+}

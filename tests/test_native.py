@@ -1,0 +1,98 @@
+"""C++ runtime tests: supervisor exit-code contract, health prober,
+gang barrier. Builds the native library with g++ on first run."""
+
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from k8s_tpu.runtime import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    native.build_native()
+
+
+class TestHealthServer:
+    def test_probe_reports_phase(self):
+        hs = native.HealthServer(port=0)
+        try:
+            hs.set_phase("running")
+            with socket.create_connection(("127.0.0.1", hs.port), timeout=2) as s:
+                data = s.recv(64).decode()
+            assert data.strip() == "OK running"
+            hs.set_phase("done")
+            with socket.create_connection(("127.0.0.1", hs.port), timeout=2) as s:
+                assert s.recv(64).decode().strip() == "OK done"
+        finally:
+            hs.stop()
+
+
+class TestWaitForEndpoint:
+    def test_succeeds_when_listening(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            assert native.wait_for_endpoint("127.0.0.1", port, timeout_s=5)
+        finally:
+            srv.close()
+
+    def test_times_out(self):
+        t0 = time.monotonic()
+        assert not native.wait_for_endpoint("127.0.0.1", 1, timeout_s=0.5)
+        assert time.monotonic() - t0 < 5
+
+
+class TestSupervisor:
+    def _run(self, *args):
+        return subprocess.run(
+            [native.SUPERVISOR_PATH, *args], capture_output=True, timeout=30
+        )
+
+    def test_exit_code_passthrough(self):
+        r = self._run("--", sys.executable, "-c", "import sys; sys.exit(7)")
+        assert r.returncode == 7
+
+    def test_success(self):
+        r = self._run("--", "true")
+        assert r.returncode == 0
+
+    def test_signal_becomes_retryable_code(self):
+        # child kills itself with SIGKILL → 128+9=137, the retryable band
+        r = self._run(
+            "--", sys.executable, "-c",
+            "import os, signal; os.kill(os.getpid(), signal.SIGKILL)",
+        )
+        assert r.returncode == 137
+
+    def test_exec_failure_is_permanent(self):
+        r = self._run("--", "/nonexistent/binary")
+        assert r.returncode == 127
+
+    def test_wait_for_gates_and_times_out_retryable(self):
+        r = self._run(
+            "--wait-for", "127.0.0.1:1", "--wait-timeout-ms", "300",
+            "--", "true",
+        )
+        assert r.returncode == 143  # retryable: gang restart
+
+    def test_sigterm_forwarded(self):
+        # -S skips sitecustomize (which imports jax and would delay the
+        # child's handler registration past our kill)
+        proc = subprocess.Popen(
+            [
+                native.SUPERVISOR_PATH, "--",
+                sys.executable, "-S", "-c",
+                "import signal,sys,time\n"
+                "signal.signal(signal.SIGTERM, lambda *a: sys.exit(3))\n"
+                "time.sleep(30)",
+            ],
+        )
+        time.sleep(1.5)
+        proc.terminate()  # SIGTERM to supervisor → forwarded to child
+        assert proc.wait(timeout=10) == 3
