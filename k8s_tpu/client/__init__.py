@@ -1,0 +1,7 @@
+"""User-facing clients: the python job client + YAML loading.
+
+Analogue of reference ``py/tf_job_client.py`` and the kubectl YAML
+surface (``examples/*.yaml``).
+"""
+
+from k8s_tpu.client.job_client import TpuJobApi, load_tpu_job_yaml  # noqa: F401

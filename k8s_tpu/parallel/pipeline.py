@@ -99,5 +99,5 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(param_specs, x_spec),
         out_specs=x_spec,
-        check_rep=False,
+        check_vma=False,
     )(stacked_params, x)

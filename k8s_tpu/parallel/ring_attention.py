@@ -114,5 +114,5 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec_q, spec_q, spec_q),
         out_specs=spec_q,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)

@@ -1,0 +1,10 @@
+"""Runnable training programs — the workloads named by ``KTPU_PROGRAM``
+in a TpuJob manifest and invoked by the SPMD launcher as
+``fn(rendezvous)`` in every worker process.
+
+One program per benchmark config (BASELINE.md): mnist_train (#2),
+resnet_train (#3), bert_train (#4), llama_train (#5). Each builds the
+global mesh from ``jax.devices()`` (all processes see the same global
+device list after ``jax.distributed.initialize``), creates the sharded
+state, and runs the step loop with metrics + optional checkpointing.
+"""

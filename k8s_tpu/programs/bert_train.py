@@ -1,0 +1,50 @@
+"""BERT-base MLM pretraining with tensor parallelism — benchmark
+config #4 (v5p-64, pjit model-parallel)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_tpu.models import BertConfig, BertForPretraining
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.parallel.mesh import best_pow2_split
+from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 50, "batch_size": 32})
+    tiny = (cfg.extra or {}).get("tiny") == "1"
+    n = len(jax.devices())
+    tensor, data = best_pow2_split(n, max_first=4 if tiny else 8)
+    mesh = build_mesh(MeshConfig(data=data, tensor=tensor))
+    rules = LogicalRules(LogicalRules.TP)
+    bcfg = BertConfig.tiny() if tiny else BertConfig.base()
+    model = BertForPretraining(bcfg)
+    seq = bcfg.max_seq_len if not tiny else 64
+
+    import numpy as np
+
+    rng_np = np.random.default_rng(0)
+    ids = rng_np.integers(0, bcfg.vocab_size, (cfg.batch_size, seq)).astype("int32")
+    mask = (rng_np.random((cfg.batch_size, seq)) < 0.15).astype("int32")
+    batch = {"input_ids": ids, "labels": ids, "mask": mask}
+
+    state = create_sharded_state(
+        model, optax.adamw(1e-4), mesh, rules,
+        jax.random.PRNGKey(0), jnp.asarray(ids),
+    )
+
+    def loss_fn(state, params, b, rng):
+        mlm, _ = state.apply_fn({"params": params}, b["input_ids"])
+        return cross_entropy_loss(mlm, b["labels"], mask=b["mask"]), {}
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    logger = MetricLogger(rdzv, "bert")
+    rng = jax.random.PRNGKey(1)
+    for step in range(1, cfg.steps + 1):
+        state, metrics = step_fn(state, batch, rng)
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            logger.log(step, {"loss": float(metrics["loss"])})

@@ -1,0 +1,72 @@
+"""Shared program scaffolding: arg parsing from env, metric logging,
+periodic checkpointing, mesh sizing."""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class RunConfig:
+    steps: int = 100
+    batch_size: int = 64
+    log_every: int = 10
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    extra: Optional[Dict[str, str]] = None
+
+
+def parse_run_config(rdzv, defaults: Optional[dict] = None) -> RunConfig:
+    """Program args come from ``KTPU_PROGRAM_ARGS`` (shell-ish
+    ``--key=value`` tokens) with env fallbacks."""
+    cfg = RunConfig(**(defaults or {}))
+    extra: Dict[str, str] = {}
+    for tok in shlex.split(getattr(rdzv, "program_args", "") or ""):
+        if not tok.startswith("--") or "=" not in tok:
+            continue
+        key, _, val = tok[2:].partition("=")
+        key = key.replace("-", "_")
+        if hasattr(cfg, key) and key != "extra":
+            cur = getattr(cfg, key)
+            setattr(cfg, key, type(cur)(val) if cur is not None else val)
+        else:
+            extra[key] = val
+    cfg.extra = extra
+    if os.environ.get("KTPU_STEPS"):
+        cfg.steps = int(os.environ["KTPU_STEPS"])
+    return cfg
+
+
+class MetricLogger:
+    """Step-metrics logger: JSON lines on process 0 stdout (picked up
+    by `kubectl logs` / the kubelet log files) + steps/sec."""
+
+    def __init__(self, rdzv, run_name: str):
+        self.enabled = rdzv.process_id <= 0
+        self.run_name = run_name
+        self._t0 = time.perf_counter()
+        self._last_step = 0
+        self._last_t = self._t0
+
+    def log(self, step: int, metrics: Dict[str, float]) -> None:
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        steps_per_sec = (step - self._last_step) / max(now - self._last_t, 1e-9)
+        self._last_step, self._last_t = step, now
+        print(
+            json.dumps(
+                {
+                    "run": self.run_name,
+                    "step": step,
+                    "steps_per_sec": round(steps_per_sec, 3),
+                    **{k: round(float(v), 5) for k, v in metrics.items()},
+                }
+            ),
+            flush=True,
+        )

@@ -1,0 +1,104 @@
+"""Llama causal-LM training with FSDP(+TP/SP) — benchmark config #5
+(Llama-3-8B, multi-slice v5p-128 over DCN) with checkpoint/resume.
+
+Strategy selection via ``--strategy=`` (dp|fsdp|fsdp_tp|fsdp_tp_sp);
+multi-slice jobs put ``data`` across slices (gradient-sync over DCN)
+and fsdp/tensor/seq inside the slice (ICI), per the megascale recipe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_tpu.data import synthetic_token_batches
+from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
+
+STRATEGIES = {
+    "dp": "DP",
+    "fsdp": "FSDP",
+    "fsdp_tp": "FSDP_TP",
+    "fsdp_tp_sp": "FSDP_TP_SP",
+}
+
+
+def _mesh_for(strategy: str, n: int, num_slices: int):
+    if strategy == "dp":
+        return build_mesh(MeshConfig(data=n))
+    per_slice = max(1, n // num_slices)
+    if strategy == "fsdp":
+        return build_mesh(MeshConfig(data=num_slices, fsdp=per_slice))
+    if strategy == "fsdp_tp":
+        tensor = 4 if per_slice % 4 == 0 else (2 if per_slice % 2 == 0 else 1)
+        return build_mesh(
+            MeshConfig(data=num_slices, fsdp=per_slice // tensor, tensor=tensor)
+        )
+    if strategy == "fsdp_tp_sp":
+        tensor = 2 if per_slice % 2 == 0 else 1
+        seq = 2 if per_slice % (2 * tensor) == 0 else 1
+        return build_mesh(
+            MeshConfig(
+                data=num_slices, fsdp=per_slice // (tensor * seq),
+                seq=seq, tensor=tensor,
+            )
+        )
+    raise ValueError(f"unknown strategy {strategy}")
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 30, "batch_size": 16})
+    extra = cfg.extra or {}
+    strategy = extra.get("strategy", "fsdp")
+    model_name = extra.get("model", "tiny")
+    seq_len = int(extra.get("seq_len", "128" if model_name == "tiny" else "8192"))
+    n = len(jax.devices())
+    num_slices = max(1, rdzv.num_slices)
+
+    mesh = _mesh_for(strategy, n, num_slices)
+    rules = LogicalRules(getattr(LogicalRules, STRATEGIES[strategy]))
+    attention = "ring" if mesh.shape["seq"] > 1 else "flash"
+    if model_name == "llama3-8b":
+        lcfg = LlamaConfig.llama3_8b(attention=attention, mesh=mesh)
+    else:
+        lcfg = LlamaConfig.tiny(
+            attention=attention, mesh=mesh, num_heads=8, num_kv_heads=4, head_dim=16
+        )
+    model = LlamaForCausalLM(lcfg)
+    data = synthetic_token_batches(cfg.batch_size, seq_len, lcfg.vocab_size)
+    state = create_sharded_state(
+        model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules,
+        jax.random.PRNGKey(0), jnp.asarray(next(data)["input_ids"]),
+    )
+
+    mgr = None
+    if cfg.checkpoint_dir:
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(cfg.checkpoint_dir)
+        restored = mgr.restore(state)
+        if restored is not None:
+            state = restored
+
+    def loss_fn(state, params, b, rng):
+        logits = state.apply_fn({"params": params}, b["input_ids"])
+        labels = jnp.roll(b["input_ids"], -1, axis=1)
+        return cross_entropy_loss(logits[:, :-1], labels[:, :-1], z_loss=1e-4), {}
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
+    rng = jax.random.PRNGKey(1)
+    start = int(state.step)
+    for step in range(start + 1, cfg.steps + 1):
+        state, metrics = step_fn(state, next(data), rng)
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            logger.log(step, {"loss": float(metrics["loss"])})
+        if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+            mgr.save(step, state)
+    if mgr is not None:
+        mgr.save(cfg.steps, state, force=True)
+        mgr.wait()
+        mgr.close()

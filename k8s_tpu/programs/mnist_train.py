@@ -1,0 +1,43 @@
+"""MNIST data-parallel training — benchmark config #2 (v5e-8).
+
+Every worker process runs this via the SPMD launcher; the global mesh
+spans all chips of the slice, pure DP.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_tpu.data import synthetic_mnist
+from k8s_tpu.models import MnistCNN
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 60, "batch_size": 64})
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    rules = LogicalRules(LogicalRules.DP)
+    model = MnistCNN()
+    data = synthetic_mnist(cfg.batch_size)
+    batch = next(data)
+    state = create_sharded_state(
+        model, optax.adamw(1e-3), mesh, rules, jax.random.PRNGKey(0), batch["images"]
+    )
+
+    def loss_fn(state, params, b, rng):
+        logits = state.apply_fn({"params": params}, b["images"])
+        loss = cross_entropy_loss(logits, b["labels"])
+        acc = jnp.mean((jnp.argmax(logits, -1) == b["labels"]).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    logger = MetricLogger(rdzv, "mnist")
+    rng = jax.random.PRNGKey(1)
+    for step in range(1, cfg.steps + 1):
+        state, metrics = step_fn(state, next(data), rng)
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            logger.log(step, {k: float(v) for k, v in metrics.items()})

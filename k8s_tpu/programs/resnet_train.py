@@ -1,0 +1,67 @@
+"""ResNet-50/ImageNet data-parallel training — benchmark config #3
+(v5p-16, the north-star metric) with checkpoint/resume."""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from k8s_tpu.data import synthetic_image_batches
+from k8s_tpu.models import ResNet50, ResNet
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 50, "batch_size": 256})
+    tiny = (cfg.extra or {}).get("tiny") == "1"
+    image_size = 64 if tiny else 224
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    rules = LogicalRules(LogicalRules.DP)
+    model = (
+        ResNet(stage_sizes=(1, 1), num_classes=100, num_filters=8)
+        if tiny
+        else ResNet50(num_classes=1000)
+    )
+    data = synthetic_image_batches(cfg.batch_size, image_size,
+                                   num_classes=100 if tiny else 1000)
+    batch = next(data)
+    optimizer = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    state = create_sharded_state(
+        model, optimizer, mesh, rules, jax.random.PRNGKey(0),
+        batch["images"], init_kwargs={"train": False},
+    )
+
+    mgr = None
+    if cfg.checkpoint_dir:
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(cfg.checkpoint_dir)
+        restored = mgr.restore(state)
+        if restored is not None:
+            state = restored
+
+    def loss_fn(state, params, b, rng):
+        logits, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            b["images"], train=True, mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, b["labels"]), {
+            "batch_stats": mutated["batch_stats"]
+        }
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    logger = MetricLogger(rdzv, "resnet50")
+    rng = jax.random.PRNGKey(1)
+    start = int(state.step)
+    for step in range(start + 1, cfg.steps + 1):
+        state, metrics = step_fn(state, next(data), rng)
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            logger.log(step, {"loss": float(metrics["loss"])})
+        if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+            mgr.save(step, state)
+    if mgr is not None:
+        mgr.save(cfg.steps, state, force=True)
+        mgr.wait()
+        mgr.close()

@@ -1,0 +1,4 @@
+"""Operational tooling: e2e binary, test runner, junit writer, local
+kubectl, cleanup. Analogues of reference ``test/e2e/main.go``,
+``py/test_runner.py``, ``py/test_util.py``, ``scripts/``.
+"""

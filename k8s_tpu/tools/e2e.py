@@ -1,0 +1,130 @@
+"""E2E verification binary.
+
+Analogue of reference ``test/e2e/main.go``: create a job with
+coordinator + workers + TensorBoard (:49-102), poll to Succeeded with a
+5-minute default budget (:37,111-123), assert every per-replica
+resource exists (:139-151), assert the TensorBoard Deployment+Service
+(:153-166), delete, poll for full GC (:168-223), parallel ``--num-jobs``
+fan-out (:241-254), TAP output (:277-285).
+
+Runs against the in-process LocalWorld (simulated pods by default;
+``--subprocess`` runs the real SPMD launcher processes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import List
+
+from k8s_tpu.api.objects import Container, PodSpec, PodTemplateSpec
+from k8s_tpu import spec as S
+from k8s_tpu.tools.junit import TestCase, Timer, create_junit_xml_file
+from k8s_tpu.tools.local_world import LocalWorld
+
+
+def build_job(name: str, workers: int = 1) -> S.TpuJob:
+    j = S.TpuJob()
+    j.metadata.name = name
+    j.metadata.namespace = "default"
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(
+            replica_type="COORDINATOR",
+            template=PodTemplateSpec(
+                spec=PodSpec(containers=[Container(name="jax", image="img", command=["true"])])
+            ),
+        ),
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=workers),
+    ]
+    j.spec.tensorboard = S.TensorBoardSpec(log_dir="/tmp/tb")
+    return j
+
+
+def run_one(world: LocalWorld, name: str, timeout: float) -> None:
+    job = world.api.create(build_job(name, workers=2))
+    job = world.api.wait_for_job("default", name, timeout=timeout)
+    if job.status.state != S.TpuJobState.SUCCEEDED:
+        raise AssertionError(
+            f"job {name} finished {job.status.state}: {job.status.reason}"
+        )
+    rid = job.spec.runtime_id
+    expected_jobs = [
+        f"{name}-coordinator-{rid}-0",
+        f"{name}-worker-{rid}-0",
+        f"{name}-worker-{rid}-1",
+    ]
+    have = {x.metadata.name for x in world.client.jobs.list("default")}
+    for e in expected_jobs:
+        if e not in have:
+            raise AssertionError(f"expected Job {e} missing (have {sorted(have)})")
+    world.client.deployments.get("default", f"{name}-tensorboard-{rid}")
+    world.client.services.get("default", f"{name}-tensorboard-{rid}")
+
+    world.api.delete("default", name)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leftover_jobs = [
+            x for x in world.client.jobs.list("default")
+            if x.metadata.name.startswith(f"{name}-")
+        ]
+        leftover_deps = [
+            x for x in world.client.deployments.list("default")
+            if x.metadata.name.startswith(f"{name}-")
+        ]
+        if not leftover_jobs and not leftover_deps:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"resources of {name} not garbage-collected")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu-e2e")
+    p.add_argument("--num-jobs", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--subprocess", action="store_true",
+                   help="run real launcher subprocesses instead of simulated pods")
+    p.add_argument("--junit-path", default="")
+    args = p.parse_args(argv)
+
+    cases: List[TestCase] = []
+    ok = True
+    with LocalWorld(subprocess_pods=args.subprocess, log_dir="/tmp/ktpu-e2e-logs") as world:
+        errors: List[str] = [None] * args.num_jobs
+
+        def worker(i: int):
+            try:
+                run_one(world, f"e2e-{i}", args.timeout)
+            except Exception as e:  # noqa: BLE001 - report, don't crash
+                errors[i] = str(e)
+
+        with Timer() as t:
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(args.num_jobs)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        for i, err in enumerate(errors):
+            cases.append(
+                TestCase("e2e", f"job-{i}", t.elapsed / args.num_jobs, err)
+            )
+            if err:
+                ok = False
+
+    if args.junit_path:
+        create_junit_xml_file(cases, args.junit_path)
+    # TAP output (reference main.go:277-285)
+    print(f"1..{len(cases)}")
+    for i, c in enumerate(cases, 1):
+        if c.failure:
+            print(f"not ok {i} - {c.name}: {c.failure}")
+        else:
+            print(f"ok {i} - {c.name}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""JUnit XML writer.
+
+Analogue of reference ``py/test_util.py`` (``TestCase`` +
+``create_junit_xml_file``, :8-60): the CI artifact format Gubernator-
+style dashboards consume.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+from xml.sax.saxutils import escape
+
+
+@dataclass
+class TestCase:
+    class_name: str = ""
+    name: str = ""
+    time: float = 0.0
+    failure: Optional[str] = None
+
+
+def to_junit_xml(cases: List[TestCase]) -> str:
+    failures = sum(1 for c in cases if c.failure)
+    total_time = sum(c.time for c in cases)
+    lines = [
+        '<testsuite failures="{}" tests="{}" time="{}">'.format(
+            failures, len(cases), total_time
+        )
+    ]
+    for c in cases:
+        attrs = 'classname="{}" name="{}" time="{}"'.format(
+            escape(c.class_name, {'"': "&quot;"}),
+            escape(c.name, {'"': "&quot;"}),
+            c.time,
+        )
+        if c.failure:
+            lines.append(f"  <testcase {attrs}>")
+            lines.append(
+                '    <failure message="{}"/>'.format(
+                    escape(c.failure, {'"': "&quot;"})
+                )
+            )
+            lines.append("  </testcase>")
+        else:
+            lines.append(f"  <testcase {attrs}/>")
+    lines.append("</testsuite>")
+    return "\n".join(lines)
+
+
+def create_junit_xml_file(cases: List[TestCase], output_path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    with open(output_path, "w") as f:
+        f.write(to_junit_xml(cases))
+
+
+class Timer:
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.monotonic() - self.start
+        return False

@@ -1,0 +1,77 @@
+"""kubectl-style CLI for local mode.
+
+The reference's user surface is ``kubectl create -f tf_job.yaml``
+(README quickstart). Local mode has no apiserver, so this CLI gives
+the same verbs against a LocalWorld that lives for the command's
+duration: ``create`` runs the job to completion (with real launcher
+subprocesses), ``validate`` checks a manifest offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from k8s_tpu.client.job_client import load_tpu_job_yaml
+from k8s_tpu import spec as S
+from k8s_tpu.tools.local_world import LocalWorld
+
+
+def cmd_validate(args) -> int:
+    with open(args.file) as f:
+        job = load_tpu_job_yaml(f.read())
+    job.spec.set_defaults()
+    try:
+        job.spec.validate()
+    except S.ValidationError as e:
+        print(f"INVALID: {e}")
+        return 1
+    print(f"valid TpuJob {job.metadata.name or '<unnamed>'}")
+    for r in job.spec.replica_specs:
+        print(f"  {r.replica_type}: replicas={r.replicas} port={r.port}")
+    if job.spec.tpu:
+        t = job.spec.tpu.topology()
+        print(
+            f"  tpu: {job.spec.tpu.accelerator} ({t.chips} chips, "
+            f"{t.num_hosts} hosts) × {job.spec.tpu.num_slices} slice(s)"
+        )
+    return 0
+
+
+def cmd_create(args) -> int:
+    with open(args.file) as f:
+        text = f.read()
+    with LocalWorld(subprocess_pods=not args.simulate, log_dir=args.log_dir) as world:
+        job = world.api.create_from_yaml(text)
+        print(f"tpujob.tpu.k8s.io/{job.metadata.name} created")
+        if args.wait:
+            final = world.api.wait_for_job(
+                job.metadata.namespace or "default",
+                job.metadata.name,
+                timeout=args.timeout,
+                status_callback=lambda j: print(
+                    f"  phase={j.status.phase or 'None'} state={j.status.state}"
+                ),
+            )
+            print(f"final: phase={final.status.phase} state={final.status.state}")
+            return 0 if final.status.state == S.TpuJobState.SUCCEEDED else 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("create", help="create a TpuJob in a local world and run it")
+    c.add_argument("-f", "--file", required=True)
+    c.add_argument("--wait", action="store_true", default=True)
+    c.add_argument("--timeout", type=float, default=600.0)
+    c.add_argument("--simulate", action="store_true", help="simulated pods")
+    c.add_argument("--log-dir", default="/tmp/ktpu-logs")
+    v = sub.add_parser("validate", help="validate a TpuJob manifest")
+    v.add_argument("-f", "--file", required=True)
+    args = p.parse_args(argv)
+    return {"create": cmd_create, "validate": cmd_validate}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Templated-job test runner.
+
+Analogue of reference ``py/test_runner.py`` (:18-73): render a job
+manifest template, uniquify the name, create it, wait, record junit.
+Template variables use ``str.format`` (``{name}``, ``{image_tag}``)
+instead of jinja2 (not a baked dependency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import uuid
+
+from k8s_tpu.client.job_client import load_tpu_job_yaml
+from k8s_tpu import spec as S
+from k8s_tpu.tools.junit import TestCase, Timer, create_junit_xml_file
+from k8s_tpu.tools.local_world import LocalWorld
+
+
+def run_test(spec_text: str, timeout: float, world: LocalWorld) -> TestCase:
+    job = load_tpu_job_yaml(spec_text)
+    # uniquify (reference: name + salt)
+    job.metadata.name = f"{job.metadata.name}-{uuid.uuid4().hex[:4]}"
+    if not job.metadata.namespace:
+        job.metadata.namespace = "default"
+    with Timer() as t:
+        world.api.create(job)
+        try:
+            final = world.api.wait_for_job(
+                job.metadata.namespace, job.metadata.name, timeout=timeout
+            )
+            failure = (
+                None
+                if final.status.state == S.TpuJobState.SUCCEEDED
+                else f"state={final.status.state} reason={final.status.reason}"
+            )
+        except TimeoutError as e:
+            failure = str(e)
+    return TestCase("tpu-job", job.metadata.name, t.elapsed, failure)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu-test-runner")
+    p.add_argument("--spec", required=True, help="TpuJob YAML (template) path")
+    p.add_argument("--image-tag", default="", help="substituted for {image_tag}")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--junit-path", default="")
+    p.add_argument("--subprocess", action="store_true")
+    args = p.parse_args(argv)
+
+    with open(args.spec) as f:
+        text = f.read()
+    if "{image_tag}" in text:
+        text = text.replace("{image_tag}", args.image_tag)
+
+    with LocalWorld(subprocess_pods=args.subprocess) as world:
+        case = run_test(text, args.timeout, world)
+
+    if args.junit_path:
+        create_junit_xml_file([case], args.junit_path)
+    if case.failure:
+        print(f"FAILED {case.name}: {case.failure}")
+        return 1
+    print(f"PASSED {case.name} in {case.time:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,8 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
+        if step in (self.manager.all_steps() or []):
+            return False  # already checkpointed at this step
         return self.manager.save(
             step, args=self._ocp.args.StandardSave(state), force=force
         )

@@ -1,0 +1,203 @@
+"""Tooling-tier tests: e2e binary, test runner, kubectl-local, junit
+writer, python job client, example manifests, training programs —
+mirrors reference components 17, 21, 22, 30, 37 (SURVEY §2)."""
+
+import glob
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from k8s_tpu.client.job_client import load_tpu_job_yaml
+from k8s_tpu import spec as S
+from k8s_tpu.tools import e2e, junit, kubectl_local, test_runner
+from k8s_tpu.tools.local_world import LocalWorld
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+class TestJunit:
+    def test_xml_shape(self, tmp_path):
+        cases = [
+            junit.TestCase("suite", "pass", 1.5),
+            junit.TestCase("suite", "fail", 0.5, failure="boom"),
+        ]
+        path = str(tmp_path / "junit.xml")
+        junit.create_junit_xml_file(cases, path)
+        root = ET.parse(path).getroot()
+        assert root.tag == "testsuite"
+        assert root.get("tests") == "2" and root.get("failures") == "1"
+        fails = root.findall(".//failure")
+        assert len(fails) == 1 and fails[0].get("message") == "boom"
+
+
+class TestExamples:
+    @pytest.mark.parametrize(
+        "fname", sorted(os.path.basename(p) for p in glob.glob(f"{EXAMPLES}/*.yaml"))
+    )
+    def test_manifest_validates(self, fname):
+        with open(os.path.join(EXAMPLES, fname)) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        job.spec.validate()
+
+    def test_multislice_example_worker_count(self):
+        with open(os.path.join(EXAMPLES, "tpu_job_multislice_llama.yaml")) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        # v5p-128 = 16 hosts/slice × 2 slices
+        assert job.spec.replica_spec(S.WORKER).replicas == 32
+
+    def test_defaults_example_synthesizes_launcher(self):
+        with open(os.path.join(EXAMPLES, "tpu_job_defaults.yaml")) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        w = job.spec.replica_spec(S.WORKER)
+        assert w.is_default_launcher
+        assert w.template is not None
+
+
+class TestE2EBinary:
+    def test_single_job_tap_ok(self, capsys):
+        rc = e2e.main(["--num-jobs", "1", "--timeout", "30"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1..1" in out and "ok 1" in out
+
+    def test_parallel_jobs(self, capsys, tmp_path):
+        path = str(tmp_path / "junit.xml")
+        rc = e2e.main(["--num-jobs", "3", "--timeout", "60", "--junit-path", path])
+        assert rc == 0
+        root = ET.parse(path).getroot()
+        assert root.get("tests") == "3" and root.get("failures") == "0"
+
+
+class TestTestRunner:
+    def test_runs_spec_to_success(self, tmp_path, capsys):
+        spec_path = os.path.join(EXAMPLES, "tpu_job.yaml")
+        junit_path = str(tmp_path / "j.xml")
+        rc = test_runner.main(
+            ["--spec", spec_path, "--timeout", "30", "--junit-path", junit_path]
+        )
+        assert rc == 0
+        assert "PASSED" in capsys.readouterr().out
+        assert ET.parse(junit_path).getroot().get("failures") == "0"
+
+
+class TestKubectlLocal:
+    def test_validate_good(self, capsys):
+        rc = kubectl_local.main(
+            ["validate", "-f", os.path.join(EXAMPLES, "tpu_job_v5e_mnist.yaml")]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "v5e-8" in out
+
+    def test_validate_bad(self, tmp_path, capsys):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(
+            """
+apiVersion: tpu.k8s.io/v1alpha1
+kind: TpuJob
+metadata: {name: bad}
+spec:
+  replicaSpecs:
+    - tpuReplicaType: COORDINATOR
+      replicas: 2
+      template:
+        spec:
+          containers: [{name: jax, image: i}]
+"""
+        )
+        rc = kubectl_local.main(["validate", "-f", str(bad)])
+        assert rc == 1
+        assert "INVALID" in capsys.readouterr().out
+
+
+class TestJobClientWait:
+    def test_wait_times_out(self):
+        with LocalWorld(executor=None) as world:
+            # job that never finishes: simulated executor w/ long delay
+            from k8s_tpu.api.objects import Container, PodSpec, PodTemplateSpec
+
+            j = S.TpuJob()
+            j.metadata.name = "slow"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(
+                    replica_type="COORDINATOR",
+                    template=PodTemplateSpec(
+                        spec=PodSpec(containers=[Container(name="jax", image="i")])
+                    ),
+                )
+            ]
+            world.kubelet.executor.delay = 60
+            world.kubelet.executor.exit_code = 0
+            world.api.create(j)
+            with pytest.raises(TimeoutError):
+                world.api.wait_for_job("default", "slow", timeout=1.0, polling_interval=0.1)
+
+
+class TestPrograms:
+    """Each benchmark program runs a few steps on the test mesh."""
+
+    class FakeRdzv:
+        process_id = 0
+        num_processes = 1
+        num_slices = 1
+        program_args = ""
+
+    def test_mnist_program(self, capsys):
+        from k8s_tpu.programs import mnist_train
+
+        r = self.FakeRdzv()
+        r.program_args = "--steps=3 --batch_size=16 --log_every=1"
+        mnist_train.main(r)
+        assert '"run": "mnist"' in capsys.readouterr().out
+
+    def test_resnet_program_tiny(self, capsys):
+        from k8s_tpu.programs import resnet_train
+
+        r = self.FakeRdzv()
+        r.program_args = "--steps=2 --batch_size=8 --log_every=1 --tiny=1"
+        resnet_train.main(r)
+        assert '"run": "resnet50"' in capsys.readouterr().out
+
+    def test_bert_program_tiny(self, capsys):
+        from k8s_tpu.programs import bert_train
+
+        r = self.FakeRdzv()
+        r.program_args = "--steps=2 --batch_size=8 --log_every=1 --tiny=1"
+        bert_train.main(r)
+        assert '"run": "bert"' in capsys.readouterr().out
+
+    def test_llama_program_fsdp_tp_sp(self, capsys):
+        from k8s_tpu.programs import llama_train
+
+        r = self.FakeRdzv()
+        r.program_args = (
+            "--steps=2 --batch_size=8 --log_every=1 "
+            "--strategy=fsdp_tp_sp --model=tiny --seq_len=64"
+        )
+        llama_train.main(r)
+        assert "llama-tiny-fsdp_tp_sp" in capsys.readouterr().out
+
+    def test_llama_checkpoint_resume(self, tmp_path, capsys):
+        from k8s_tpu.programs import llama_train
+
+        ckpt = str(tmp_path / "ck")
+        r = self.FakeRdzv()
+        r.program_args = (
+            f"--steps=2 --batch_size=8 --log_every=1 --strategy=dp "
+            f"--model=tiny --seq_len=32 --checkpoint_dir={ckpt} --checkpoint_every=1"
+        )
+        llama_train.main(r)
+        # resume: second run starts from step 2 and runs to 4
+        r2 = self.FakeRdzv()
+        r2.program_args = (
+            f"--steps=4 --batch_size=8 --log_every=1 --strategy=dp "
+            f"--model=tiny --seq_len=32 --checkpoint_dir={ckpt}"
+        )
+        llama_train.main(r2)
+        out = capsys.readouterr().out
+        assert '"step": 4' in out
