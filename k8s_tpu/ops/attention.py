@@ -217,9 +217,18 @@ def flash_attention(
     if hq % hkv != 0:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # Mosaic tiling constraints: last dim must be lane-aligned (128) and
+    # seq lens must fill whole blocks (a partial KV block would feed
+    # padding garbage into the online softmax).
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    shapes_ok = (
+        d % 128 == 0 and sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128
+    )
     if use_pallas is None:
         platform = jax.devices()[0].platform
-        use_pallas = platform == "tpu" and sq >= 128 and sk >= 128
+        use_pallas = platform == "tpu" and shapes_ok
+    elif use_pallas and not shapes_ok and not interpret:
+        use_pallas = False  # unsupported tiling → XLA path
     if not use_pallas and not interpret:
         return mha_reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
