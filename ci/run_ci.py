@@ -1,0 +1,78 @@
+"""CI pipeline runner.
+
+The in-process analogue of the reference's Airflow DAG shape
+(``test-infra/airflow/dags/e2e_tests_dag.py:347-416``):
+
+    checks (lint) → unit tests → e2e → [bench] → teardown-always
+
+Each stage records junit XML under ``--artifacts-dir`` (the Gubernator
+layout of ``py/prow.py`` reduced to its artifact contract: junit files
++ a ``finished.json`` verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from k8s_tpu.tools.junit import TestCase, Timer, create_junit_xml_file
+
+
+def stage(name: str, cmd, artifacts: str, cases: list) -> bool:
+    print(f"\n=== stage: {name} ===\n$ {' '.join(cmd)}")
+    with Timer() as t:
+        proc = subprocess.run(cmd)
+    ok = proc.returncode == 0
+    cases.append(
+        TestCase("ci", name, t.elapsed, None if ok else f"exit {proc.returncode}")
+    )
+    create_junit_xml_file(cases, os.path.join(artifacts, "junit_ci.xml"))
+    print(f"=== {name}: {'ok' if ok else 'FAILED'} ({t.elapsed:.1f}s)")
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu-ci")
+    p.add_argument("--artifacts-dir", default="build/ci-artifacts")
+    p.add_argument("--with-bench", action="store_true")
+    p.add_argument("--skip-slow", action="store_true")
+    args = p.parse_args(argv)
+    os.makedirs(args.artifacts_dir, exist_ok=True)
+    py = sys.executable
+
+    cases: list = []
+    ok = True
+    # checks: compile every module (pylint analogue of py_checks.py)
+    ok = ok and stage(
+        "py-checks", [py, "-m", "compileall", "-q", "k8s_tpu", "tests"],
+        args.artifacts_dir, cases,
+    )
+    pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q",
+                  f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
+    if args.skip_slow:
+        pytest_cmd += ["-m", "not integration"]
+    ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
+    ok = ok and stage(
+        "e2e",
+        [py, "-m", "k8s_tpu.tools.e2e", "--num-jobs", "2",
+         "--junit-path", f"{args.artifacts_dir}/junit_e2e.xml"],
+        args.artifacts_dir, cases,
+    )
+    if args.with_bench and ok:
+        ok = stage("bench", [py, "bench.py"], args.artifacts_dir, cases)
+
+    # finished.json verdict (reference py/prow.py:100-143)
+    with open(os.path.join(args.artifacts_dir, "finished.json"), "w") as f:
+        json.dump(
+            {"timestamp": int(time.time()), "result": "SUCCESS" if ok else "FAILURE"},
+            f,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,9 +79,13 @@ class Controller:
     # ------------------------------------------------------------ dispatch
 
     def _start_job(self, job: TpuJob) -> None:
+        from k8s_tpu.controller import metrics
+
         tj = TrainingJob(self.client, self.job_client, job)
         self.jobs[job.key] = tj
         tj.start(self.config, self.reconcile_interval)
+        metrics.JOBS_STARTED.inc()
+        metrics.LIVE_JOBS.set(len(self.jobs))
         self.client.record_event(
             job.metadata.namespace,
             {"kind": "TpuJob", "name": job.metadata.name},
@@ -91,6 +95,9 @@ class Controller:
 
     def handle_event(self, ev_type: str, job: TpuJob) -> None:
         """Reference handleTfJobEvent (controller.go:123-170)."""
+        from k8s_tpu.controller import metrics
+
+        metrics.EVENTS_HANDLED.inc({"type": ev_type})
         key = job.key
         if ev_type == "ADDED":
             if job.status.is_failed():
@@ -101,6 +108,7 @@ class Controller:
             self._start_job(job)
         elif ev_type == "DELETED":
             tj = self.jobs.pop(key, None)
+            metrics.LIVE_JOBS.set(len(self.jobs))
             if tj is None:
                 log.warning("unsafe state: %s deleted but not tracked", key)
                 return

@@ -1,0 +1,104 @@
+"""Operator metrics: Prometheus-style counters/gauges + text exposition.
+
+Closes the observability gap SURVEY §5 flags in the reference ("no
+Prometheus metrics, no K8s Events" — the event recorder was a
+FakeRecorder, reference main.go:133). Dependency-free registry with
+the text exposition format, served on the operator health port.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, mtype: str):
+        self.name = name
+        self.help = help_text
+        self.type = mtype
+        self.values: Dict[LabelKV, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[Dict[str, str]]) -> LabelKV:
+        return tuple(sorted((labels or {}).items()))
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            for key, v in sorted(self.values.items()):
+                if key:
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    out.append(f"{self.name}{{{lbl}}} {v}")
+                else:
+                    out.append(f"{self.name} {v}")
+        return out
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_text):
+        super().__init__(name, help_text, "counter")
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, by: float = 1.0):
+        key = self._key(labels)
+        with self._lock:
+            self.values[key] = self.values.get(key, 0.0) + by
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_text):
+        super().__init__(name, help_text, "gauge")
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self.values[self._key(labels)] = value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.values.get(self._key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self.start_time = time.time()
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        m = Counter(name, help_text)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        m = Gauge(name, help_text)
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# process-global operator registry
+REGISTRY = Registry()
+EVENTS_HANDLED = REGISTRY.counter(
+    "ktpu_operator_events_total", "Watch events dispatched, by type"
+)
+JOBS_STARTED = REGISTRY.counter(
+    "ktpu_operator_jobs_started_total", "TrainingJob reconcilers started"
+)
+JOBS_TERMINAL = REGISTRY.counter(
+    "ktpu_operator_jobs_terminal_total", "Jobs reaching a terminal state, by state"
+)
+RECONCILES = REGISTRY.counter(
+    "ktpu_operator_reconciles_total", "Reconcile passes executed"
+)
+LIVE_JOBS = REGISTRY.gauge(
+    "ktpu_operator_live_jobs", "Reconcilers currently tracked"
+)

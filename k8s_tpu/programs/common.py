@@ -42,6 +42,30 @@ def parse_run_config(rdzv, defaults: Optional[dict] = None) -> RunConfig:
     return cfg
 
 
+class maybe_profile:
+    """jax.profiler trace around the hot loop when ``KTPU_PROFILE_DIR``
+    is set (process 0 only) — the per-step tracing upgrade SURVEY §5
+    calls for (the reference delegated all profiling to TensorBoard)."""
+
+    def __init__(self, rdzv):
+        self.dir = os.environ.get("KTPU_PROFILE_DIR", "")
+        self.active = bool(self.dir) and rdzv.process_id <= 0
+
+    def __enter__(self):
+        if self.active:
+            import jax
+
+            jax.profiler.start_trace(self.dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False
+
+
 class MetricLogger:
     """Step-metrics logger: JSON lines on process 0 stdout (picked up
     by `kubectl logs` / the kubelet log files) + steps/sec."""

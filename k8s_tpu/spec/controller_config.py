@@ -47,6 +47,11 @@ class ControllerConfig(K8sObject):
     # Python module executed by default-launcher workers (analogue of
     # GrpcServerFilePath, reference controller.go:9-16 + replicas.go:126-150).
     launcher_module: str = "k8s_tpu.launcher.spmd_launcher"
+    # Wrap launcher commands with the native C++ supervisor (health
+    # prober + gang barrier + exit-code contract, native/ktpu_runtime.cc)
+    use_native_supervisor: bool = False
+    supervisor_path: str = "/opt/ktpu/native/build/ktpu_supervisor"
+    health_port: int = 8080
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -61,4 +66,7 @@ class ControllerConfig(K8sObject):
         return cls(
             accelerators=accels,
             launcher_module=raw.get("launcherModule", cls.launcher_module),
+            use_native_supervisor=raw.get("useNativeSupervisor", False),
+            supervisor_path=raw.get("supervisorPath", cls.supervisor_path),
+            health_port=raw.get("healthPort", cls.health_port),
         )

@@ -1,0 +1,128 @@
+"""Release builder.
+
+Analogue of reference ``py/release.py`` (:116-280) +
+``py/build_and_push_image.py``: image tag ``v<date>-<githash>`` with a
+dirty-diff suffix, docker-context assembly, chart packaging, and a
+``latest_release.json`` manifest. Runs docker/gcloud when present;
+``--dry-run`` emits the plan (used by tests and airgapped CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+from typing import List, Optional
+
+
+def run(cmd: List[str], dry_run: bool = False, **kw) -> Optional[str]:
+    print("$ " + " ".join(cmd))
+    if dry_run:
+        return None
+    out = subprocess.run(cmd, check=True, capture_output=True, text=True, **kw)
+    return out.stdout
+
+
+def get_git_hash(repo_dir: str) -> str:
+    """Short hash, suffixed with a diff digest when dirty (reference
+    build_and_push_image.py:14-32)."""
+    h = subprocess.run(
+        ["git", "rev-parse", "--short=8", "HEAD"],
+        cwd=repo_dir, capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    diff = subprocess.run(
+        ["git", "diff", "HEAD"], cwd=repo_dir, capture_output=True, text=True
+    ).stdout
+    if diff.strip():
+        h = f"{h}-dirty-{hashlib.sha256(diff.encode()).hexdigest()[:8]}"
+    return h
+
+
+def image_tag(repo_dir: str, now: Optional[time.struct_time] = None) -> str:
+    now = now or time.gmtime()
+    return "v{}-{}".format(time.strftime("%Y%m%d", now), get_git_hash(repo_dir))
+
+
+def build_operator_image(repo_dir: str, registry: str, dry_run: bool = False) -> str:
+    tag = image_tag(repo_dir)
+    image = f"{registry}/tpu-operator:{tag}"
+    run(
+        ["docker", "build", "-t", image, "-f", "images/operator/Dockerfile", "."],
+        dry_run=dry_run, cwd=repo_dir,
+    )
+    run(["docker", "push", image], dry_run=dry_run)
+    return image
+
+
+def package_chart(repo_dir: str, out_dir: str, version: str) -> str:
+    """Chart re-version + package (reference release.py:193-239),
+    helm-free: tar.gz the chart with the version stamped in."""
+    os.makedirs(out_dir, exist_ok=True)
+    chart_dir = os.path.join(repo_dir, "chart")
+    out_path = os.path.join(out_dir, f"tpu-job-operator-{version}.tgz")
+    with tarfile.open(out_path, "w:gz") as tar:
+        for root, _, files in os.walk(chart_dir):
+            for f in files:
+                full = os.path.join(root, f)
+                arc = os.path.join(
+                    "tpu-job-operator", os.path.relpath(full, chart_dir)
+                )
+                if f == "Chart.yaml":
+                    content = open(full).read()
+                    content = "\n".join(
+                        f"version: {version}" if line.startswith("version:") else line
+                        for line in content.splitlines()
+                    )
+                    import io
+
+                    data = content.encode()
+                    info = tarfile.TarInfo(arc)
+                    info.size = len(data)
+                    tar.addfile(info, io.BytesIO(data))
+                else:
+                    tar.add(full, arcname=arc)
+    return out_path
+
+
+def write_release_manifest(out_dir: str, image: str, chart_path: str) -> str:
+    """``latest_release.json`` analogue (reference release.py:258-280)."""
+    manifest = {
+        "image": image,
+        "chart": os.path.basename(chart_path),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    path = os.path.join(out_dir, "latest_release.json")
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu-release")
+    p.add_argument("--registry", default="ghcr.io/k8s-tpu")
+    p.add_argument("--out-dir", default="build/release")
+    p.add_argument("--repo-dir", default=".")
+    p.add_argument("--chart-version", default="0.1.0")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    tag = image_tag(args.repo_dir)
+    print(f"release tag: {tag}")
+    image = (
+        build_operator_image(args.repo_dir, args.registry, dry_run=args.dry_run)
+        if not args.dry_run
+        else f"{args.registry}/tpu-operator:{tag}"
+    )
+    chart = package_chart(args.repo_dir, args.out_dir, f"{args.chart_version}+{tag}")
+    manifest = write_release_manifest(args.out_dir, image, chart)
+    print(f"chart: {chart}\nmanifest: {manifest}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

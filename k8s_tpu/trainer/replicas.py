@@ -139,7 +139,7 @@ class TpuReplicaSet:
             self._create_launcher_config_map(config)
         for index in range(self.spec.replicas or 0):
             self._create_service(index)
-            self._create_job(index)
+            self._create_job(index, config)
 
     def _create_service(self, index: int) -> None:
         svc = Service(
@@ -159,7 +159,7 @@ class TpuReplicaSet:
         except errors.AlreadyExistsError:
             pass  # idempotent re-create (reference replicas.go:180-186)
 
-    def _create_job(self, index: int) -> None:
+    def _create_job(self, index: int, config=None) -> None:
         template = self.spec.template.deepcopy()
         if template.metadata is None:
             template.metadata = ObjectMeta()
@@ -180,6 +180,8 @@ class TpuReplicaSet:
             if self.spec.is_default_launcher:
                 self._rewrite_launcher_command(c)
                 self._ensure_launcher_volume(template)
+            if config is not None and getattr(config, "use_native_supervisor", False):
+                self._wrap_with_supervisor(c, rdzv, config)
         # stable DNS inside the gang: pods resolve each other through
         # their per-index Services
         job = Job(
@@ -225,6 +227,17 @@ class TpuReplicaSet:
                 VolumeMount(name=LAUNCHER_VOLUME, mount_path=LAUNCHER_MOUNT_PATH)
             )
         c.command = ["python", f"{LAUNCHER_MOUNT_PATH}/spmd_launcher.py"]
+
+    def _wrap_with_supervisor(self, c: Container, rdzv: "RendezvousSpec", config) -> None:
+        """Wrap the container command with the native supervisor
+        (native/ktpu_runtime.cc): liveness endpoint for the pod probe
+        and, for non-coordinator processes, a TCP gang barrier on the
+        coordinator before burning the JAX init timeout."""
+        wrapped = [config.supervisor_path, "--health-port", str(config.health_port)]
+        if rdzv.process_id > 0 and rdzv.coordinator_address:
+            host, _, port = rdzv.coordinator_address.rpartition(":")
+            wrapped += ["--wait-for", f"{host}:{port}"]
+        c.command = wrapped + ["--"] + list(c.command)
 
     def _ensure_launcher_volume(self, template) -> None:
         spec = template.spec

@@ -190,6 +190,10 @@ class TrainingJob:
 
     def reconcile(self, config: ControllerConfig) -> None:
         """Reference reconcile (training.go:350-409)."""
+        from k8s_tpu.controller import metrics
+
+        metrics.RECONCILES.inc()
+        was_terminal = self.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED)
         if self.status.phase == TpuJobPhase.NONE:
             self.setup(config)
             self.update_crd_status()
@@ -213,6 +217,19 @@ class TrainingJob:
                 )
                 if running:
                     self.status.phase = TpuJobPhase.RUNNING
+
+        if not was_terminal and self.status.phase in (
+            TpuJobPhase.DONE,
+            TpuJobPhase.FAILED,
+        ):
+            metrics.JOBS_TERMINAL.inc({"state": self.status.state})
+            self.client.record_event(
+                self.job.metadata.namespace,
+                {"kind": "TpuJob", "name": self.name},
+                "Finished",
+                f"job reached {self.status.state}",
+                etype="Normal" if self.status.state == TpuJobState.SUCCEEDED else "Warning",
+            )
 
         self.update_crd_status()
 

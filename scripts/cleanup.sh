@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Label-selector resource sweep — analogue of reference
+# scripts/cleanup_clusters.sh: delete every resource the operator
+# created for TpuJobs (by the tpu.k8s.io group label), then the CRs.
+set -euo pipefail
+
+NAMESPACE="${1:-default}"
+SELECTOR="tpu.k8s.io="
+
+echo "sweeping namespace ${NAMESPACE} with selector ${SELECTOR}"
+kubectl -n "${NAMESPACE}" delete jobs,pods,services,configmaps,deployments \
+  -l "${SELECTOR}" --ignore-not-found
+kubectl -n "${NAMESPACE}" delete tpujobs --all --ignore-not-found

@@ -1,0 +1,27 @@
+"""Package metadata + C-extension-free install (native parts build via
+make; see native/Makefile)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="k8s-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native distributed training job framework: TpuJob CRD + "
+        "operator control plane, JAX/XLA SPMD data plane"
+    ),
+    packages=find_packages(include=["k8s_tpu", "k8s_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["numpy", "pyyaml"],
+    extras_require={
+        "jax": ["jax", "flax", "optax", "orbax-checkpoint", "chex"],
+    },
+    entry_points={
+        "console_scripts": [
+            "tpu-operator=k8s_tpu.operator:main",
+            "ktpu=k8s_tpu.tools.kubectl_local:main",
+            "ktpu-e2e=k8s_tpu.tools.e2e:main",
+            "ktpu-test-runner=k8s_tpu.tools.test_runner:main",
+        ]
+    },
+)

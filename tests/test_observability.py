@@ -1,0 +1,133 @@
+"""Observability + release tests: operator metrics, K8s Events on
+terminal states, native-supervisor command wrapping, release tooling."""
+
+import os
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.objects import Container, PodSpec, PodTemplateSpec
+from k8s_tpu.controller import metrics
+from k8s_tpu import spec as S
+from k8s_tpu.trainer.training import TrainingJob
+
+
+def make_job(client, jc, name="mjob"):
+    j = S.TpuJob()
+    j.metadata.name = name
+    j.metadata.namespace = "default"
+    j.spec.runtime_id = "abcd"
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(
+            replica_type="COORDINATOR",
+            template=PodTemplateSpec(
+                spec=PodSpec(containers=[Container(name="jax", image="i")])
+            ),
+        ),
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=2),
+    ]
+    return TrainingJob(client, jc, j)
+
+
+class TestMetrics:
+    def test_counters_and_exposition(self):
+        reg = metrics.Registry()
+        c = reg.counter("test_total", "help text")
+        g = reg.gauge("test_gauge", "gauge help")
+        c.inc()
+        c.inc({"type": "ADDED"})
+        g.set(3.0)
+        text = reg.expose()
+        assert "# TYPE test_total counter" in text
+        assert 'test_total{type="ADDED"} 1.0' in text
+        assert "test_gauge 3.0" in text
+
+    def test_reconcile_increments(self):
+        cluster = InMemoryCluster()
+        client, jc = KubeClient(cluster), TpuJobClient(cluster)
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        before = metrics.RECONCILES.get()
+        tj.reconcile(S.ControllerConfig())
+        assert metrics.RECONCILES.get() == before + 1
+
+    def test_terminal_state_records_event_and_metric(self):
+        cluster = InMemoryCluster()
+        client, jc = KubeClient(cluster), TpuJobClient(cluster)
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        chief = client.jobs.get("default", "mjob-coordinator-abcd-0")
+        chief.status.succeeded = 1
+        client.jobs.update(chief)
+        before = metrics.JOBS_TERMINAL.get({"state": "Succeeded"})
+        tj.reconcile(cfg)
+        assert metrics.JOBS_TERMINAL.get({"state": "Succeeded"}) == before + 1
+        evs = [e for e in client.events.list("default") if e.reason == "Finished"]
+        assert evs and "Succeeded" in evs[0].message
+
+
+class TestSupervisorWrapping:
+    def test_commands_wrapped_when_enabled(self):
+        cluster = InMemoryCluster()
+        client, jc = KubeClient(cluster), TpuJobClient(cluster)
+        tj = make_job(client, jc, name="supjob")
+        cfg = S.ControllerConfig(use_native_supervisor=True, health_port=8080)
+        tj.setup(cfg)
+        tj.create_resources(cfg)
+        w1 = client.jobs.get("default", f"supjob-worker-{tj.job.spec.runtime_id}-1")
+        cmd = w1.spec.template.spec.containers[0].command
+        assert cmd[0].endswith("ktpu_supervisor")
+        assert "--health-port" in cmd
+        # non-coordinator worker gates on the coordinator endpoint
+        assert "--wait-for" in cmd
+        i = cmd.index("--wait-for")
+        assert cmd[i + 1].endswith(":2222")
+        # worker 0 hosts the coordinator: no self-wait
+        w0 = client.jobs.get("default", f"supjob-worker-{tj.job.spec.runtime_id}-0")
+        assert "--wait-for" not in w0.spec.template.spec.containers[0].command
+
+    def test_not_wrapped_by_default(self):
+        cluster = InMemoryCluster()
+        client, jc = KubeClient(cluster), TpuJobClient(cluster)
+        tj = make_job(client, jc, name="plainjob")
+        cfg = S.ControllerConfig()
+        tj.setup(cfg)
+        tj.create_resources(cfg)
+        w = client.jobs.get("default", f"plainjob-worker-{tj.job.spec.runtime_id}-0")
+        cmd = w.spec.template.spec.containers[0].command
+        assert not cmd or "ktpu_supervisor" not in cmd[0]
+
+
+class TestControllerConfigYaml:
+    def test_supervisor_fields(self):
+        cfg = S.ControllerConfig.from_yaml(
+            "useNativeSupervisor: true\nhealthPort: 9999\nsupervisorPath: /x/sup\n"
+        )
+        assert cfg.use_native_supervisor
+        assert cfg.health_port == 9999
+        assert cfg.supervisor_path == "/x/sup"
+
+
+class TestRelease:
+    def test_image_tag_and_chart_package(self, tmp_path):
+        from k8s_tpu.tools import release
+
+        repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+        tag = release.image_tag(repo)
+        assert tag.startswith("v20")
+        chart = release.package_chart(repo, str(tmp_path), f"0.1.0+{tag}")
+        assert os.path.exists(chart)
+        import tarfile
+
+        with tarfile.open(chart) as t:
+            names = t.getnames()
+            assert "tpu-job-operator/Chart.yaml" in names
+            chart_yaml = t.extractfile("tpu-job-operator/Chart.yaml").read().decode()
+            assert f"version: 0.1.0+{tag}" in chart_yaml
+        manifest = release.write_release_manifest(str(tmp_path), "img:x", chart)
+        import json
+
+        data = json.load(open(manifest))
+        assert data["image"] == "img:x"
