@@ -43,6 +43,9 @@ def main() -> int:
     n_chips = len(jax.devices())
     mesh = build_mesh(MeshConfig(data=n_chips))
     rules = LogicalRules(LogicalRules.DP)
+    # conv7 stem: the canonical ResNet-v1.5 architecture, so the series
+    # stays apples-to-apples across rounds. (stem="space_to_depth" is
+    # ~1% faster but a different conv_init — opt-in, not benchmarked.)
     model = ResNet50(num_classes=1000)
 
     batch = next(synthetic_image_batches(batch_size, image_size))

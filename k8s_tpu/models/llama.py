@@ -42,8 +42,10 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = True
     scan_layers: bool = True
-    # "flash" (pallas kernel / XLA fallback) or "ring" (context-parallel
-    # over the `seq` mesh axis; requires mesh)
+    # "flash" (pallas kernel / XLA fallback), "ring" (KV rotates around
+    # the `seq` ICI ring; requires mesh), or "ulysses" (all-to-all
+    # re-shard seq->heads over `seq`; requires mesh, seq-degree must
+    # divide the head counts)
     attention: str = "flash"
     mesh: Optional[object] = dataclasses.field(default=None, hash=False, compare=False)
     # Mixture-of-Experts: >0 replaces the dense MLP with a top-2 routed
@@ -111,6 +113,10 @@ class LlamaAttention(nn.Module):
             from k8s_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, cfg.mesh, causal=True)
+        elif cfg.attention == "ulysses":
+            from k8s_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, cfg.mesh, causal=True)
         else:
             out = flash_attention(q, k, v, causal=True)
         out = nn.DenseGeneral(

@@ -12,6 +12,10 @@ from k8s_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     mesh_for_topology,
 )
+from k8s_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 from k8s_tpu.parallel.sharding import (  # noqa: F401
     LogicalRules,
     logical_sharding,

@@ -90,6 +90,28 @@ def ring_attention_sharded(
     return out.astype(q.dtype)
 
 
+def seq_parallel_call(
+    body,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+):
+    """Shared shard_map wrapper for sequence-parallel attention bodies
+    (ring and Ulysses): q/k/v and the output are laid out
+    ``[batch@data/fsdp, length@seq, heads@tensor, head_dim]``."""
+    from jax import shard_map
+
+    spec = P(batch_axes, axis_name, head_axis, None)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
 def ring_attention(
     q: jax.Array,  # global [B, S, Hq, D]
     k: jax.Array,
@@ -103,16 +125,10 @@ def ring_attention(
 ):
     """Global-array form: shards length over ``seq``, batch over
     data/fsdp, heads over tensor, and runs the ring body."""
-    from jax import shard_map
-
-    spec_q = P(batch_axes, axis_name, head_axis, None)
     body = partial(
         ring_attention_sharded, axis_name=axis_name, causal=causal, scale=scale
     )
-    return shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec_q, spec_q, spec_q),
-        out_specs=spec_q,
-        check_vma=False,
+    return seq_parallel_call(
+        body, mesh, axis_name=axis_name, batch_axes=batch_axes,
+        head_axis=head_axis,
     )(q, k, v)

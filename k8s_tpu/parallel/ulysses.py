@@ -1,0 +1,97 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy next to ring attention (SURVEY §5:
+the reference has no sequence parallelism of any kind; SURVEY §2.5 row
+"SP/CP" names both ring and Ulysses/all-to-all as the TPU-native
+capability to build). Where ring attention keeps Q local and rotates
+KV around the ``seq`` ICI ring, Ulysses re-shards: activations arrive
+sequence-sharded ``[B, S/n, H, D]``, one ``all_to_all`` over the
+``seq`` axis turns them head-sharded ``[B, S, H/n, D]``, each device
+runs ordinary (flash) attention over the FULL sequence for its head
+subset, and a second ``all_to_all`` restores sequence sharding.
+
+Trade-off vs ring (why both exist):
+
+- Ulysses moves each activation tensor twice (2 all-to-alls of the
+  local shard) regardless of sequence length — O(S·H·D/n) bytes —
+  while ring moves K and V ``n-1`` times; for long S with small KV
+  (GQA) ring wins, for moderate S and many heads Ulysses wins and
+  composes with the unmodified flash kernel (full-sequence causal
+  masking needs no cross-device bookkeeping).
+- Ulysses parallelism degree is capped by the head counts: ``n`` must
+  divide both Hq and Hkv. Ring has no head constraint.
+
+Both run over the same ``seq`` mesh axis, so models can pick per-layer
+via config (``attention="ulysses"`` in LlamaConfig).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from k8s_tpu.ops.attention import flash_attention
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,  # local [B, Sq/n, Hq, D]
+    k: jax.Array,  # local [B, Sk/n, Hkv, D]
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+):
+    """Per-device body — call inside ``shard_map`` (or use
+    :func:`ulysses_attention` for the wrapped form)."""
+    n = jax.lax.axis_size(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n or hkv % n:
+        raise ValueError(
+            f"ulysses degree {n} must divide q heads {hq} and kv heads {hkv}"
+        )
+    # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1)
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [B, S, H/n, D]
+    out = flash_attention(
+        qh, kh, vh, causal=causal, scale=scale, use_pallas=use_pallas
+    )
+    # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2)
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,  # global [B, S, Hq, D]
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+    use_pallas: Optional[bool] = None,
+):
+    """Global-array form mirroring :func:`ring_attention`: length over
+    ``seq``, batch over data/fsdp, heads over tensor."""
+    from k8s_tpu.parallel.ring_attention import seq_parallel_call
+
+    body = partial(
+        ulysses_attention_sharded,
+        axis_name=axis_name,
+        causal=causal,
+        scale=scale,
+        use_pallas=use_pallas,
+    )
+    return seq_parallel_call(
+        body, mesh, axis_name=axis_name, batch_axes=batch_axes,
+        head_axis=head_axis,
+    )(q, k, v)

@@ -1,5 +1,10 @@
 """ResNet-50/ImageNet data-parallel training — benchmark config #3
-(v5p-16, the north-star metric) with checkpoint/resume."""
+(v5p-16, the north-star metric) with checkpoint/resume.
+
+Note: the space_to_depth stem changes conv_init's kernel shape
+((7,7,3,64) → (4,4,12,64)); checkpoints written by a conv7-stem run
+cannot be restored into an s2d-stem run — clear the checkpoint dir
+when switching stems."""
 
 from __future__ import annotations
 
@@ -22,7 +27,8 @@ def main(rdzv) -> None:
     model = (
         ResNet(stage_sizes=(1, 1), num_classes=100, num_filters=8)
         if tiny
-        else ResNet50(num_classes=1000)
+        else ResNet50(num_classes=1000,
+                      stem=(cfg.extra or {}).get("stem", "conv7"))
     )
     data = synthetic_image_batches(cfg.batch_size, image_size,
                                    num_classes=100 if tiny else 1000)

@@ -1,0 +1,333 @@
+// Native data loader: multi-threaded sharded record reader.
+//
+// The reference delegated its entire input pipeline to user containers
+// (TF readers inside tensorflow/tensorflow:1.3.0 images); here the
+// framework ships its own native loader so the host-side input pipeline
+// keeps the TPU fed without holding the Python GIL: N reader threads
+// stream fixed-size binary records (static shapes — the TPU-idiomatic
+// record format) from a sharded file list, optionally shuffle through a
+// per-thread reservoir, assemble batches, and hand them to Python
+// through a bounded queue with a single memcpy into a caller-owned
+// (numpy) buffer.
+//
+// Exposed via ctypes from k8s_tpu/data/native_loader.py.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<uint8_t> data;
+  int records = 0;
+};
+
+struct Loader {
+  // config
+  int record_bytes = 0;
+  int batch = 0;
+  int queue_depth = 0;
+  int n_threads = 0;
+  int shuffle_buffer = 0;  // records per thread; 0 = sequential
+  bool drop_remainder = false;
+  bool loop = false;
+  uint64_t seed = 0;
+  std::vector<std::string> files;  // already shard-filtered
+
+  // queue
+  std::mutex mu;
+  std::condition_variable cv_put;  // producers wait for space
+  std::condition_variable cv_get;  // consumer waits for data
+  std::deque<Batch> queue;
+  int active_producers = 0;
+  bool eof = false;  // set by the flusher thread AFTER the tail flush
+  bool closed = false;
+  uint64_t produced_batches = 0;
+  uint64_t produced_records = 0;
+  uint64_t files_skipped = 0;  // unreadable files (guarded by mu)
+  // consumers currently inside next()/stats(); close() must not free
+  // the Loader until this drains (incremented under g_mu, so close's
+  // map-erase and the increment are totally ordered)
+  std::atomic<int> busy{0};
+
+  // leftover-record assembly across threads (epoch tail, loop=false)
+  std::mutex tail_mu;
+  std::vector<uint8_t> tail;
+
+  std::vector<std::thread> threads;
+
+  bool push(Batch&& b) {  // returns false if closed
+    std::unique_lock<std::mutex> lk(mu);
+    cv_put.wait(lk, [&] { return closed || (int)queue.size() < queue_depth; });
+    if (closed) return false;
+    produced_batches++;
+    produced_records += b.records;
+    queue.push_back(std::move(b));
+    cv_get.notify_one();
+    return true;
+  }
+
+};
+
+std::mutex g_mu;
+std::map<int, Loader*> g_loaders;
+int g_next_id = 1;
+
+// Pins the loader against concurrent close(): the caller MUST drop the
+// pin with `L->busy--` after its last touch of *L.
+Loader* find_and_pin(int h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_loaders.find(h);
+  if (it == g_loaders.end()) return nullptr;
+  it->second->busy++;
+  return it->second;
+}
+
+void reader_thread(Loader* L, int tid) {
+  std::mt19937_64 rng(L->seed * 2654435761u + tid);
+  std::vector<std::vector<uint8_t>> reservoir;
+  std::vector<uint8_t> out;  // batch under assembly
+  out.reserve((size_t)L->batch * L->record_bytes);
+  int out_records = 0;
+
+  auto emit_record = [&](const uint8_t* rec) -> bool {
+    out.insert(out.end(), rec, rec + L->record_bytes);
+    out_records++;
+    if (out_records == L->batch) {
+      Batch b;
+      b.data = std::move(out);
+      b.records = out_records;
+      out.clear();
+      out.reserve((size_t)L->batch * L->record_bytes);
+      out_records = 0;
+      return L->push(std::move(b));
+    }
+    return true;
+  };
+
+  auto handle_record = [&](std::vector<uint8_t>&& rec) -> bool {
+    if (L->shuffle_buffer > 1) {
+      if ((int)reservoir.size() < L->shuffle_buffer) {
+        reservoir.push_back(std::move(rec));
+        return true;
+      }
+      size_t j = rng() % reservoir.size();
+      std::vector<uint8_t> evicted = std::move(reservoir[j]);
+      reservoir[j] = std::move(rec);
+      return emit_record(evicted.data());
+    }
+    return emit_record(rec.data());
+  };
+
+  uint64_t epoch = 0;
+  bool alive = true;
+  do {
+    // per-epoch file order: deterministic from (seed, epoch), shared
+    // across threads so the idx%n_threads split stays disjoint
+    std::vector<std::string> order = L->files;
+    if (L->shuffle_buffer > 1) {
+      std::mt19937_64 erng(L->seed ^ (0x9e3779b97f4a7c15ull * (epoch + 1)));
+      std::shuffle(order.begin(), order.end(), erng);
+    }
+    uint64_t epoch_records = 0;
+    for (size_t i = tid; i < order.size() && alive; i += L->n_threads) {
+      FILE* f = std::fopen(order[i].c_str(), "rb");
+      if (!f) {  // unreadable: skip, but surface it in stats
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->files_skipped++;
+        continue;
+      }
+      std::vector<uint8_t> rec(L->record_bytes);
+      while (alive &&
+             std::fread(rec.data(), 1, L->record_bytes, f) ==
+                 (size_t)L->record_bytes) {
+        epoch_records++;
+        alive = handle_record(std::vector<uint8_t>(rec));
+      }
+      std::fclose(f);
+    }
+    epoch++;
+    // all files unreadable in loop mode: back off instead of busy-
+    // spinning on fopen failures until the consumer notices
+    if (L->loop && alive && epoch_records == 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  } while (L->loop && alive);
+
+  // drain the reservoir
+  if (L->shuffle_buffer > 1) {
+    std::shuffle(reservoir.begin(), reservoir.end(), rng);
+    for (auto& rec : reservoir) {
+      if (!alive) break;
+      alive = emit_record(rec.data());
+    }
+  }
+
+  // epoch tail: pool leftover records across threads. Every thread
+  // appends its leftover BEFORE the atomic decrement below, so the
+  // thread whose decrement hits zero (the flusher) knows all tails are
+  // pooled. The flusher pushes them and only then raises ``eof`` — the
+  // consumer can't observe end-of-data while tail batches are pending.
+  if (alive && out_records > 0) {
+    std::lock_guard<std::mutex> lk(L->tail_mu);
+    L->tail.insert(L->tail.end(), out.begin(), out.end());
+  }
+  bool flusher;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->active_producers--;
+    flusher = (L->active_producers == 0);
+  }
+  if (!flusher) return;
+  if (alive) {
+    std::lock_guard<std::mutex> lk(L->tail_mu);
+    size_t rb = (size_t)L->record_bytes;
+    size_t total = L->tail.size() / rb;
+    size_t off = 0;
+    while (total - off >= (size_t)L->batch && alive) {
+      Batch b;
+      b.data.assign(L->tail.begin() + off * rb,
+                    L->tail.begin() + (off + L->batch) * rb);
+      b.records = L->batch;
+      alive = L->push(std::move(b));
+      off += L->batch;
+    }
+    if (alive && !L->drop_remainder && off < total) {
+      Batch b;
+      b.data.assign(L->tail.begin() + off * rb, L->tail.begin() + total * rb);
+      b.records = (int)(total - off);
+      L->push(std::move(b));
+    }
+    L->tail.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->eof = true;
+    L->cv_get.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-joined file list. Returns handle (>0) or -errno.
+int ktpu_loader_open(const char* paths, int record_bytes, int batch,
+                     int queue_depth, int n_threads, int shuffle_buffer,
+                     uint64_t seed, int shard_id, int n_shards,
+                     int drop_remainder, int loop) {
+  if (!paths || record_bytes <= 0 || batch <= 0 || queue_depth <= 0 ||
+      n_threads <= 0 || n_shards <= 0 || shard_id < 0 || shard_id >= n_shards)
+    return -22;  // EINVAL
+  auto* L = new Loader();
+  L->record_bytes = record_bytes;
+  L->batch = batch;
+  L->queue_depth = queue_depth;
+  L->shuffle_buffer = shuffle_buffer;
+  L->seed = seed;
+  L->drop_remainder = drop_remainder != 0;
+  L->loop = loop != 0;
+
+  std::string all(paths);
+  size_t start = 0, idx = 0;
+  while (start <= all.size()) {
+    size_t nl = all.find('\n', start);
+    std::string p = all.substr(
+        start, nl == std::string::npos ? std::string::npos : nl - start);
+    if (!p.empty()) {
+      if ((int)(idx % n_shards) == shard_id) L->files.push_back(p);
+      idx++;
+    }
+    if (nl == std::string::npos) break;
+    start = nl + 1;
+  }
+  if (L->files.empty()) L->loop = false;  // nothing to re-read: EOF, not spin
+  L->n_threads = std::max(1, std::min(n_threads, (int)std::max<size_t>(
+                                                     1, L->files.size())));
+  L->active_producers = L->n_threads;
+  for (int t = 0; t < L->n_threads; t++)
+    L->threads.emplace_back(reader_thread, L, t);
+
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next_id++;
+  g_loaders[h] = L;
+  return h;
+}
+
+// Copies the next batch into dst (capacity batch*record_bytes).
+// Returns the number of records copied (>0), 0 on end-of-data,
+// -110 (ETIMEDOUT) on timeout, -9 (EBADF) on a bad handle.
+int ktpu_loader_next(int handle, void* dst, int timeout_ms) {
+  if (!dst) return -9;
+  Loader* L = find_and_pin(handle);
+  if (!L) return -9;
+  int result;
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    bool ok = L->cv_get.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 3600000),
+        [&] { return L->closed || !L->queue.empty() || L->eof; });
+    if (!ok) {
+      result = -110;
+    } else if (L->queue.empty()) {
+      result = L->closed ? -9 : 0;  // closed vs clean EOF
+    } else {
+      b = std::move(L->queue.front());
+      L->queue.pop_front();
+      L->cv_put.notify_one();
+      result = b.records;
+    }
+  }
+  L->busy--;  // last touch of *L; close() may free it from here on
+  if (result > 0) std::memcpy(dst, b.data.data(), b.data.size());
+  return result;
+}
+
+void ktpu_loader_stats(int handle, uint64_t* batches, uint64_t* records,
+                       uint64_t* skipped_files) {
+  Loader* L = find_and_pin(handle);
+  if (!L) return;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    if (batches) *batches = L->produced_batches;
+    if (records) *records = L->produced_records;
+    if (skipped_files) *skipped_files = L->files_skipped;
+  }
+  L->busy--;
+}
+
+void ktpu_loader_close(int handle) {
+  Loader* L = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_loaders.find(handle);
+    if (it == g_loaders.end()) return;
+    L = it->second;
+    g_loaders.erase(it);  // no new pins possible after this
+  }
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->closed = true;
+    L->cv_put.notify_all();
+    L->cv_get.notify_all();
+  }
+  // wait out consumers that pinned the loader before the map erase
+  while (L->busy.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  for (auto& t : L->threads) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -22,6 +22,7 @@ from k8s_tpu.ops.norms import rms_norm
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.parallel.mesh import best_pow2_split
 from k8s_tpu.parallel.ring_attention import ring_attention
+from k8s_tpu.parallel.ulysses import ulysses_attention
 from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
 
 
@@ -93,6 +94,44 @@ class TestRingAttention:
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+class TestUlyssesAttention:
+    def test_matches_reference(self):
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 8, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 4, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 128, 4, 32))
+        ref = mha_reference(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_matches_ring(self):
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 4, 16))
+        k = jax.random.normal(jax.random.PRNGKey(4), (2, 64, 4, 16))
+        v = jax.random.normal(jax.random.PRNGKey(5), (2, 64, 4, 16))
+        ring = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        uly = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, v)
+        np.testing.assert_allclose(uly, ring, atol=2e-5)
+
+    def test_grads_flow(self):
+        mesh = build_mesh(MeshConfig(seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 4, 16))
+
+        def loss(q):
+            return jnp.sum(ulysses_attention(q, q, q, mesh) ** 2)
+
+        g = jax.jit(jax.grad(loss))(q)
+        assert g.shape == q.shape
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_degree_must_divide_heads(self):
+        mesh = build_mesh(MeshConfig(seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 4, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 2, 16))
+        with pytest.raises(ValueError, match="must divide"):
+            jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, k)
+
+
 class TestModels:
     def test_mnist_forward(self):
         model = MnistCNN()
@@ -109,6 +148,37 @@ class TestModels:
         )
         assert out.shape == (2, 10)
         assert "batch_stats" in mutated
+
+    def test_resnet_space_to_depth_stem(self):
+        model = ResNet(
+            stage_sizes=(1, 1), num_classes=10, num_filters=8,
+            stem="space_to_depth",
+        )
+        x = jnp.zeros((2, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), x, train=False)
+        # the s2d stem rewrites 7x7/s2-on-3ch as 4x4/s1-on-12ch
+        assert v["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+
+    def test_resnet_space_to_depth_rejects_odd_size(self):
+        model = ResNet(
+            stage_sizes=(1, 1), num_classes=10, num_filters=8,
+            stem="space_to_depth",
+        )
+        with pytest.raises(ValueError, match="even H and W"):
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((2, 33, 33, 3)), train=False
+            )
+
+    def test_resnet_unknown_stem_rejected(self):
+        model = ResNet(
+            stage_sizes=(1, 1), num_classes=10, num_filters=8, stem="s2d"
+        )
+        with pytest.raises(ValueError, match="unknown stem"):
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), train=False
+            )
 
     def test_llama_tiny_forward(self):
         import flax.linen as nn
