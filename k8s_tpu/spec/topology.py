@@ -54,6 +54,21 @@ class TpuTopology:
             return f"{x}x{y}"
         return f"{x}x{y}x{z}"
 
+    @property
+    def gke_machine_type(self) -> str:
+        """GKE node machine type for one slice host, e.g.
+        ``ct5lp-hightpu-8t`` — the suffix is chips attached to that VM."""
+        fam = self.accelerator.split("-")[0]
+        base = {
+            "v4": "ct4p-hightpu",
+            "v5e": "ct5lp-hightpu",
+            "v5p": "ct5p-hightpu",
+            "v6e": "ct6e-standard",
+        }.get(fam)
+        if base is None:
+            raise ValueError(f"no GKE machine type known for family {fam!r}")
+        return f"{base}-{self.chips_per_host}t"
+
 
 def _t(acc: str, chips: int, cph: int, mesh: Tuple[int, int, int], cpc: int) -> TpuTopology:
     return TpuTopology(acc, chips, cph, mesh, cpc)

@@ -1,0 +1,191 @@
+"""Ephemeral-cluster deploy tool for CI and operators.
+
+Analogue of reference ``py/deploy.py`` (setup/test/teardown subcommands,
+:22-124): create a GKE cluster, install the operator chart, run
+``helm test``, tear everything down, recording junit either way.
+
+TPU-first differences: instead of an alpha-GPU ``accelerators=`` flag
+on the cluster request (reference ``py/deploy.py:51-61``), ``setup``
+creates a dedicated **TPU node pool** sized from the accelerator
+topology — GKE TPU slices are all-or-nothing gangs, so the node pool's
+``--num-nodes`` must equal the slice's host count and every node gets
+the same ``--tpu-topology``. The machine type is derived from the
+accelerator family and chips-per-host (``ct5lp-hightpu-8t`` etc.), not
+hand-picked.
+
+All gcloud/helm interaction is assembled as argv lists by pure
+``*_commands`` functions (unit-testable, ``--dry-run`` prints them),
+then executed by :func:`k8s_tpu.tools.release.run`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from k8s_tpu.spec.topology import TpuTopology, parse as parse_topology
+from k8s_tpu.tools.junit import TestCase, create_junit_xml_file
+from k8s_tpu.tools.release import run
+
+RELEASE_NAME = "tpu-job"
+
+
+def machine_type(topo: TpuTopology) -> str:
+    return topo.gke_machine_type
+
+
+def cluster_create_commands(args) -> List[List[str]]:
+    """CPU system pool + (optional) one TPU node pool per accelerator."""
+    cmds = [[
+        "gcloud", "container", "clusters", "create", args.cluster,
+        "--project", args.project,
+        "--zone", args.zone,
+        "--num-nodes", str(args.system_nodes),
+        "--machine-type", args.system_machine_type,
+        "--release-channel", "rapid",
+        "--scopes", "cloud-platform",
+    ]]
+    for accelerator in args.accelerators or []:
+        topo = parse_topology(accelerator)
+        cmds.append([
+            "gcloud", "container", "node-pools", "create",
+            f"tpu-{topo.accelerator}",
+            "--project", args.project,
+            "--zone", args.zone,
+            "--cluster", args.cluster,
+            "--machine-type", machine_type(topo),
+            "--tpu-topology", topo.topology_label,
+            # gang: one node per slice host, no autoscaling
+            "--num-nodes", str(topo.num_hosts),
+            "--node-labels", f"ktpu/accelerator={topo.accelerator}",
+        ])
+    cmds.append([
+        "gcloud", "container", "clusters", "get-credentials", args.cluster,
+        "--project", args.project,
+        "--zone", args.zone,
+    ])
+    return cmds
+
+
+def helm_install_commands(args) -> List[List[str]]:
+    cmd = [
+        "helm", "install", RELEASE_NAME, args.chart,
+        "--wait",
+        "--set", "rbac.install=true,cloud=gke",
+    ]
+    if args.image:
+        cmd += ["--set", f"image={args.image}"]
+    return [cmd]
+
+
+def helm_test_commands(args) -> List[List[str]]:
+    return [["helm", "test", RELEASE_NAME, "--timeout", f"{int(args.timeout)}s"]]
+
+
+def teardown_commands(args) -> List[List[str]]:
+    return [[
+        "gcloud", "container", "clusters", "delete", args.cluster,
+        "--project", args.project,
+        "--zone", args.zone,
+        "--quiet",
+    ]]
+
+
+def _run_stage(name: str, cmds: List[List[str]], cases: List[TestCase],
+               dry_run: bool) -> bool:
+    """Run a command list, appending one junit case for the stage
+    (reference deploy.py records helm-install / e2e-test cases)."""
+    failure = None
+    start = time.time()
+    try:
+        for cmd in cmds:
+            run(cmd, dry_run=dry_run)
+    except subprocess.CalledProcessError as e:
+        failure = f"{name} failed:\n{e.stderr or e.stdout or e}"
+    except OSError as e:  # binary not on PATH, etc.
+        failure = f"{name} failed to exec {cmd[0]!r}: {e}"
+    cases.append(TestCase("deploy", name, time.time() - start, failure))
+    if failure:
+        print(failure, file=sys.stderr)
+    return failure is None
+
+
+def _finish(cases: List[TestCase], args, ok: bool) -> int:
+    if args.junit_path:
+        create_junit_xml_file(cases, args.junit_path)
+    return 0 if ok else 1
+
+
+def setup(args) -> int:
+    cases: List[TestCase] = []
+    try:
+        create_cmds = cluster_create_commands(args)
+    except ValueError as e:  # unknown accelerator → recorded, not raised
+        cases.append(TestCase("deploy", "cluster-create", 0.0, str(e)))
+        print(e, file=sys.stderr)
+        return _finish(cases, args, ok=False)
+    ok = _run_stage("cluster-create", create_cmds, cases, args.dry_run)
+    if ok:
+        ok = _run_stage(
+            "helm-tpujob-install", helm_install_commands(args), cases,
+            args.dry_run,
+        )
+    return _finish(cases, args, ok)
+
+
+def test(args) -> int:
+    cases: List[TestCase] = []
+    ok = _run_stage("e2e-helm-test", helm_test_commands(args), cases, args.dry_run)
+    return _finish(cases, args, ok)
+
+
+def teardown(args) -> int:
+    cases: List[TestCase] = []
+    ok = _run_stage("cluster-delete", teardown_commands(args), cases, args.dry_run)
+    return _finish(cases, args, ok)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ktpu-deploy", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("--project", required=True)
+        sp.add_argument("--zone", default="us-east5-a")
+        sp.add_argument("--cluster", default="ktpu-e2e")
+        sp.add_argument("--junit-path", default=None)
+        sp.add_argument("--dry-run", action="store_true")
+
+    sp = sub.add_parser("setup", help="create cluster + install chart")
+    common(sp)
+    sp.add_argument("--chart", default="./chart")
+    sp.add_argument("--image", default=None, help="operator image override")
+    sp.add_argument("--system-nodes", type=int, default=1)
+    sp.add_argument("--system-machine-type", default="e2-standard-8")
+    sp.add_argument(
+        "--accelerators", action="append", default=None, metavar="TYPE",
+        help="TPU slice type to add a node pool for (e.g. v5e-8); repeatable",
+    )
+    sp.set_defaults(func=setup)
+
+    sp = sub.add_parser("test", help="helm test the installed release")
+    common(sp)
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.set_defaults(func=test)
+
+    sp = sub.add_parser("teardown", help="delete the cluster")
+    common(sp)
+    sp.set_defaults(func=teardown)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,7 @@ import pytest
 
 from k8s_tpu.client.job_client import load_tpu_job_yaml
 from k8s_tpu import spec as S
-from k8s_tpu.tools import e2e, junit, kubectl_local, test_runner
+from k8s_tpu.tools import deploy, e2e, junit, kubectl_local, test_runner
 from k8s_tpu.tools.local_world import LocalWorld
 
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
@@ -201,3 +201,105 @@ class TestPrograms:
         llama_train.main(r2)
         out = capsys.readouterr().out
         assert '"step": 4' in out
+
+
+def _deploy_setup_args(tmp_path, accelerators=None):
+    return deploy.build_parser().parse_args(
+        ["setup", "--project", "p", "--zone", "z", "--cluster", "c",
+         "--dry-run", "--junit-path", str(tmp_path / "junit.xml")]
+        + sum((["--accelerators", a] for a in accelerators or []), [])
+    )
+
+
+class TestDeploy:
+    """Deploy tool (reference py/deploy.py analogue, SURVEY §2 #23)."""
+
+    def _setup_args(self, tmp_path, accelerators=None):
+        return _deploy_setup_args(tmp_path, accelerators)
+
+    def test_machine_type_from_topology(self):
+        from k8s_tpu.spec.topology import parse
+
+        assert deploy.machine_type(parse("v5e-8")) == "ct5lp-hightpu-8t"
+        assert deploy.machine_type(parse("v5e-16")) == "ct5lp-hightpu-4t"
+        assert deploy.machine_type(parse("v5p-16")) == "ct5p-hightpu-4t"
+
+    def test_tpu_node_pool_is_gang_sized(self, tmp_path):
+        args = self._setup_args(tmp_path, accelerators=["v5p-16"])
+        cmds = deploy.cluster_create_commands(args)
+        pool = next(c for c in cmds if "node-pools" in c)
+        # v5p-16 = 8 chips / 4 per host = 2 hosts → exactly 2 nodes
+        assert pool[pool.index("--num-nodes") + 1] == "2"
+        assert pool[pool.index("--tpu-topology") + 1] == "2x2x2"
+        assert pool[pool.index("--machine-type") + 1] == "ct5p-hightpu-4t"
+
+    def test_setup_dry_run_records_junit(self, tmp_path, capsys):
+        args = self._setup_args(tmp_path, accelerators=["v5e-8"])
+        assert deploy.setup(args) == 0
+        out = capsys.readouterr().out
+        assert "clusters create c" in out.replace("  ", " ")
+        assert "helm install tpu-job" in out
+        tree = ET.parse(tmp_path / "junit.xml")
+        assert tree.getroot().get("failures") == "0"
+
+    def test_test_and_teardown_dry_run(self, tmp_path, capsys):
+        parser = deploy.build_parser()
+        for argv, marker in [
+            (["test", "--project", "p", "--dry-run"], "helm test tpu-job"),
+            (["teardown", "--project", "p", "--dry-run"], "clusters delete"),
+        ]:
+            args = parser.parse_args(argv)
+            assert args.func(args) == 0
+            assert marker in capsys.readouterr().out
+
+
+class TestSmokeWalkthrough:
+    """Notebook-style smoke walkthrough (reference examples/gke notebook,
+    SURVEY §2 #33)."""
+
+    def _load(self):
+        import importlib.util
+
+        path = os.path.join(EXAMPLES, "gke", "smoke_walkthrough.py")
+        mspec = importlib.util.spec_from_file_location("smoke_walkthrough", path)
+        mod = importlib.util.module_from_spec(mspec)
+        mspec.loader.exec_module(mod)
+        return mod
+
+    def test_local_mode_passes(self, capsys):
+        assert self._load().main([]) == 0
+        out = capsys.readouterr().out
+        assert "SMOKE WALKTHROUGH PASSED" in out
+        assert "garbage-collected" in out
+
+    def test_kubectl_mode_prints_commands(self, capsys):
+        assert self._load().main(["--kubectl"]) == 0
+        out = capsys.readouterr().out
+        assert "kubectl create -f" in out and "kubectl delete tpujob" in out
+
+
+class TestDeployJunit:
+    def test_setup_junit_has_both_stages(self, tmp_path):
+        args = _deploy_setup_args(tmp_path, accelerators=["v5e-8"])
+        assert deploy.setup(args) == 0
+        root = ET.parse(tmp_path / "junit.xml").getroot()
+        names = {c.get("name") for c in root.findall("testcase")}
+        assert names == {"cluster-create", "helm-tpujob-install"}
+
+    def test_missing_binary_recorded_not_raised(self, tmp_path, monkeypatch):
+        args = deploy.build_parser().parse_args(
+            ["teardown", "--project", "p",
+             "--junit-path", str(tmp_path / "junit.xml")]
+        )
+        # not dry-run, but with an empty PATH: exec fails with the
+        # OSError path, which must be recorded — never raised
+        monkeypatch.setenv("PATH", str(tmp_path))
+        assert deploy.teardown(args) == 1
+        root = ET.parse(tmp_path / "junit.xml").getroot()
+        assert root.get("failures") == "1"
+
+    def test_unknown_accelerator_recorded_not_raised(self, tmp_path):
+        args = _deploy_setup_args(tmp_path, accelerators=["v99-8"])
+        assert deploy.setup(args) == 1
+        root = ET.parse(tmp_path / "junit.xml").getroot()
+        assert root.get("failures") == "1"
