@@ -5,9 +5,13 @@ chip. The reference publishes no numbers (BASELINE.md), so
 ``vs_baseline`` is reported as 1.0 by convention against our own
 recorded series.
 
-Runs a full bf16 ResNet-50 train step (fwd+bwd+SGD-momentum+BN stats)
-on synthetic ImageNet-shaped data on whatever accelerator the runtime
-exposes (the driver runs it on one real TPU chip).
+Default mode runs a full bf16 ResNet-50 train step (fwd+bwd+
+SGD-momentum+BN stats) on synthetic ImageNet-shaped data on whatever
+accelerator the runtime exposes (the driver runs it on one real TPU
+chip). ``--metric startup`` measures the other half of BASELINE.json's
+metric — job-create→first-step latency — by driving a real 1-step job
+through the full control plane (operator → kubelet → launcher
+subprocess → program) on CPU devices.
 """
 
 from __future__ import annotations
@@ -15,6 +19,72 @@ from __future__ import annotations
 import json
 import sys
 import time
+
+
+def bench_startup() -> int:
+    """Job-create→first-step latency through the real control plane.
+
+    The job runs the MNIST program for exactly one step, so
+    create→Succeeded == create→(first step done + teardown); the
+    subprocess pins CPU devices to keep the measurement about
+    control-plane + bring-up cost, not chip contention.
+    """
+    from k8s_tpu import spec as S
+    from k8s_tpu.api.objects import Container, EnvVar, PodSpec, PodTemplateSpec
+    from k8s_tpu.tools.local_world import LocalWorld
+
+    job = S.TpuJob()
+    job.metadata.name = "startup-bench"
+    job.metadata.namespace = "default"
+    job.spec.replica_specs = [
+        S.TpuReplicaSpec(
+            replica_type="WORKER",
+            replicas=1,
+            template=PodTemplateSpec(
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            name="jax",
+                            image="local",
+                            command=[sys.executable, "-m",
+                                     "k8s_tpu.launcher.spmd_launcher"],
+                            env=[
+                                EnvVar("KTPU_PROGRAM",
+                                       "k8s_tpu.programs.mnist_train:main"),
+                                EnvVar("KTPU_PROGRAM_ARGS",
+                                       "--steps=1 --batch_size=8 --log_every=1"),
+                                EnvVar("KTPU_FORCE_PLATFORM", "cpu"),
+                                EnvVar("KTPU_NUM_CPU_DEVICES", "1"),
+                            ],
+                        )
+                    ]
+                )
+            ),
+        )
+    ]
+
+    with LocalWorld(subprocess_pods=True, log_dir="/tmp/ktpu-bench-logs") as world:
+        t0 = time.perf_counter()
+        world.api.create(job)
+        done = world.api.wait_for_job(
+            "default", "startup-bench", timeout=300, polling_interval=0.05
+        )
+        elapsed = time.perf_counter() - t0
+        if done.status.state != S.TpuJobState.SUCCEEDED:
+            print(f"startup job failed: {done.status.reason}", file=sys.stderr)
+            return 1
+        world.api.delete("default", "startup-bench")
+    print(
+        json.dumps(
+            {
+                "metric": "job_create_to_first_step_latency",
+                "value": round(elapsed, 2),
+                "unit": "seconds",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
 
 
 def main() -> int:
@@ -102,4 +172,13 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="bench")
+    parser.add_argument(
+        "--metric", choices=["resnet", "startup"], default="resnet",
+        help="resnet: train images/sec/chip (default, the driver's line); "
+             "startup: job-create→first-step latency via the control plane",
+    )
+    cli = parser.parse_args()
+    sys.exit(bench_startup() if cli.metric == "startup" else main())

@@ -3,7 +3,10 @@ writer, python job client, example manifests, training programs —
 mirrors reference components 17, 21, 22, 30, 37 (SURVEY §2)."""
 
 import glob
+import json
 import os
+import subprocess
+import sys
 import xml.etree.ElementTree as ET
 
 import pytest
@@ -303,3 +306,21 @@ class TestDeployJunit:
         assert deploy.setup(args) == 1
         root = ET.parse(tmp_path / "junit.xml").getroot()
         assert root.get("failures") == "1"
+
+
+@pytest.mark.integration
+class TestBenchStartup:
+    def test_create_to_first_step_latency(self):
+        """bench.py --metric startup drives a real 1-step job through
+        the control plane and prints one JSON line."""
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--metric", "startup"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        rec = json.loads(line)
+        assert rec["metric"] == "job_create_to_first_step_latency"
+        assert rec["unit"] == "seconds"
+        assert 0 < rec["value"] < 300
