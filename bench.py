@@ -146,15 +146,20 @@ def main() -> int:
     # input pipeline double-buffers ahead; don't measure host transfer
     batch = make_batch_sharder(mesh, rules)(batch)
 
+    # sync via host readback of the loss scalar, NOT block_until_ready:
+    # through remote-tunnel PJRT transports block_until_ready can return
+    # before execution completes (observed: a chained 8192^3 matmul loop
+    # "finishing" at 100x hardware peak), while a value fetch cannot lie
     for _ in range(warmup):
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch, rng)
-    jax.block_until_ready(metrics["loss"])
+    loss = float(metrics["loss"])
     elapsed = time.perf_counter() - t0
+    assert loss == loss, "loss is NaN — step not computing"
 
     steps_per_sec = iters / elapsed
     images_per_sec_per_chip = steps_per_sec * batch_size / n_chips

@@ -1,0 +1,30 @@
+"""Flash-attention microbench vs XLA reference attention (causal, GQA
+layout B=4 H=16 D=64). Sync via host readback — block_until_ready can
+return early on remote-tunnel PJRT transports."""
+import json, time
+import jax, jax.numpy as jnp
+from k8s_tpu.ops.attention import flash_attention, mha_reference
+
+def bench(fn, q, k, v, iters=20):
+    out = fn(q, k, v); float(out.sum())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        q = fn(q, k, v)
+    float(q.sum())
+    return (time.perf_counter() - t0) / iters * 1000
+
+for seq in (1024, 2048, 4096, 8192):
+    B, H, D = 4, 16, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, seq, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, seq, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, seq, H, D), jnp.bfloat16)
+    fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    ref = jax.jit(lambda q, k, v: mha_reference(q, k, v, causal=True))
+    t_fa = bench(fa, q, k, v)
+    try:
+        t_ref = bench(ref, q, k, v)
+        sp = round(t_ref / t_fa, 2)
+    except Exception:
+        t_ref, sp = None, "xla-oom"
+    print(json.dumps({"seq": seq, "flash_ms": round(t_fa, 3),
+                      "xla_ms": t_ref and round(t_ref, 3), "speedup": sp}))

@@ -159,9 +159,17 @@ def make_batch_sharder(mesh: Mesh, rules: LogicalRules):
     axes = rules["batch"]
 
     def put(x):
-        x = jnp.asarray(x)
+        if not isinstance(x, jax.Array):
+            x = jnp.asarray(x)
         spec = P(axes) if x.ndim >= 1 else P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        # already placed as requested → reuse the buffers. device_put is
+        # not guaranteed to short-circuit on every PJRT transport, and a
+        # redundant re-upload of the batch costs more than the step
+        # itself on remote-tunnel or multi-host DCN paths.
+        if x.sharding.is_equivalent_to(sharding, x.ndim):
+            return x
+        return jax.device_put(x, sharding)
 
     return lambda batch: jax.tree_util.tree_map(put, batch)
 
