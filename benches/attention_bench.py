@@ -1,7 +1,8 @@
 """Flash-attention microbench vs XLA reference attention (causal, GQA
 layout B=4 H=16 D=64). Sync via host readback — block_until_ready can
 return early on remote-tunnel PJRT transports."""
-import json, time
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp
 from k8s_tpu.ops.attention import flash_attention, mha_reference
 

@@ -1,0 +1,117 @@
+"""Llama train-step throughput — tokens/sec/chip and MFU.
+
+A 705M-param Llama (the largest that fits a 15.75 GB-HBM v5e chip
+alongside f32 AdamW moments at batch 4/chip) with the production path:
+scan-stacked remat blocks, flash attention, bf16 compute, AdamW. Sync
+is by host readback of the loss (see docs/BENCHMARKS.md, "Measurement
+integrity"). ``--batch-per-chip`` and ``--remat-policy`` reproduce the
+non-default rows of the BENCHMARKS.md table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.train import (
+    create_sharded_state,
+    cross_entropy_loss,
+    make_batch_sharder,
+    make_train_step,
+)
+
+PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="llama-bench")
+    p.add_argument("--batch-per-chip", type=int, default=4)
+    p.add_argument("--remat-policy", default="nothing_saveable",
+                   choices=["nothing_saveable", "dots"])
+    p.add_argument("--no-remat", action="store_true")
+    args = p.parse_args(argv)
+
+    n = len(jax.devices())
+    on_accel = jax.default_backend() in ("tpu", "gpu")
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32768, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
+            max_seq_len=2048, remat=not args.no_remat,
+            remat_policy=args.remat_policy,
+        )
+        batch, seq, warmup, iters = args.batch_per_chip * n, 2048, 3, 10
+    else:
+        cfg = LlamaConfig.tiny(remat=not args.no_remat,
+                               remat_policy=args.remat_policy)
+        batch, seq, warmup, iters = 2 * n, 128, 1, 3
+
+    mesh = build_mesh(MeshConfig(data=n))
+    rules = LogicalRules(LogicalRules.DP)
+    model = LlamaForCausalLM(cfg)
+
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    state = create_sharded_state(
+        model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules,
+        jax.random.PRNGKey(0), ids,
+    )
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+
+    def loss_fn(state, params, b, rng):
+        logits = state.apply_fn({"params": params}, b["ids"])
+        return cross_entropy_loss(logits[:, :-1], b["ids"][:, 1:]), {}
+
+    step = make_train_step(loss_fn, mesh, rules)
+    rng = jax.random.PRNGKey(1)
+    data = make_batch_sharder(mesh, rules)(
+        {"ids": jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)}
+    )
+
+    for _ in range(warmup):
+        state, metrics = step(state, data, rng)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, data, rng)
+    loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    assert loss == loss, "loss is NaN"
+
+    tokens_per_sec_chip = iters * batch * seq / elapsed / n
+    # 6ND for fwd+bwd; the remat forward recompute is NOT counted
+    # (MFU counts useful FLOPs only, the MLPerf convention)
+    mfu = None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if on_accel and gen in PEAK_BF16_TFLOPS:
+        mfu = round(
+            6 * n_params * tokens_per_sec_chip / (PEAK_BF16_TFLOPS[gen] * 1e12),
+            4,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec_chip, 1),
+                "unit": "tokens/sec/chip",
+                "params": n_params,
+                "mfu": mfu,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -191,6 +191,20 @@ class TestModels:
         assert logits.shape == (2, 16, cfg.vocab_size)
         assert logits.dtype == jnp.float32
 
+    def test_llama_remat_policies(self):
+        import flax.linen as nn
+        import pytest
+
+        ids = jnp.zeros((1, 16), jnp.int32)
+        for policy in ("nothing_saveable", "dots"):
+            cfg = LlamaConfig.tiny(remat=True, remat_policy=policy)
+            model = LlamaForCausalLM(cfg)
+            v = nn.unbox(model.init(jax.random.PRNGKey(0), ids))
+            assert model.apply(v, ids).shape == (1, 16, cfg.vocab_size)
+        bad = LlamaForCausalLM(LlamaConfig.tiny(remat=True, remat_policy="nope"))
+        with pytest.raises(ValueError, match="remat_policy"):
+            bad.init(jax.random.PRNGKey(0), ids)
+
     def test_llama_scan_equals_loop(self):
         import flax.linen as nn
 
