@@ -1,31 +1,72 @@
-"""Flash-attention microbench vs XLA reference attention (causal, GQA
-layout B=4 H=16 D=64). Sync via host readback — block_until_ready can
-return early on remote-tunnel PJRT transports."""
+"""Flash-attention microbench vs XLA reference attention.
+
+Causal GQA, Llama-3-8B head shape (Hq=12, Hkv=4, D=128 — D must be
+lane-aligned or the pallas gate falls back to XLA and the bench would
+compare XLA with itself). Reports fwd-only and fwd+bwd (the backward is
+the pallas dq/dkv kernel pair, not XLA recompute).
+
+Timing is an on-device ``lax.fori_loop`` with a data dependence between
+iterations: per-call host dispatch over the remote-tunnel PJRT
+transport costs ~ms and otherwise drowns the small-seq rows (observed:
+fwd+bwd "faster" than fwd at 1k). Sync via host readback —
+block_until_ready can return early on tunnel transports.
+"""
 import json, os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp
 from k8s_tpu.ops.attention import flash_attention, mha_reference
 
-def bench(fn, q, k, v, iters=20):
-    out = fn(q, k, v); float(out.sum())
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        q = fn(q, k, v)
-    float(q.sum())
-    return (time.perf_counter() - t0) / iters * 1000
+
+def bench(fn, q, k, v, iters=50):
+    """Mean per-iteration device time of fn(q, k, v).
+
+    The loop body feeds each result back into q (scaled to zero) so XLA
+    cannot hoist or dead-code the call; the whole loop is one dispatch.
+    """
+
+    @jax.jit
+    def loop(q):
+        def body(_, qq):
+            leaf = jax.tree_util.tree_leaves(fn(qq, k, v))[0]
+            return qq + 0.0 * leaf.astype(qq.dtype)
+
+        return jax.lax.fori_loop(0, iters, body, q)
+
+    float(loop(q).astype(jnp.float32).sum())  # compile + warm
+    best = float("inf")
+    for _ in range(5):  # best-of-5: the chip is shared, take the quiet run
+        t0 = time.perf_counter()
+        float(loop(q).astype(jnp.float32).sum())
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1000
+
 
 for seq in (1024, 2048, 4096, 8192):
-    B, H, D = 4, 16, 64
-    q = jax.random.normal(jax.random.PRNGKey(0), (B, seq, H, D), jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (B, seq, H, D), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (B, seq, H, D), jnp.bfloat16)
-    fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    ref = jax.jit(lambda q, k, v: mha_reference(q, k, v, causal=True))
-    t_fa = bench(fa, q, k, v)
+    B, HQ, HKV, D = 4, 12, 4, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, seq, HQ, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, seq, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, seq, HKV, D), jnp.bfloat16)
+
+    fa = lambda q, k, v: flash_attention(q, k, v, causal=True, use_pallas=True)
+    ref = lambda q, k, v: mha_reference(q, k, v, causal=True)
+    fa_g = jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, use_pallas=True)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+    ref_g = jax.grad(
+        lambda q, k, v: mha_reference(q, k, v, causal=True)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+    row = {"seq": seq}
+    row["fwd_flash_ms"] = round(bench(fa, q, k, v), 3)
     try:
-        t_ref = bench(ref, q, k, v)
-        sp = round(t_ref / t_fa, 2)
+        row["fwd_xla_ms"] = round(bench(ref, q, k, v), 3)
+        row["fwd_speedup"] = round(row["fwd_xla_ms"] / row["fwd_flash_ms"], 2)
     except Exception:
-        t_ref, sp = None, "xla-oom"
-    print(json.dumps({"seq": seq, "flash_ms": round(t_fa, 3),
-                      "xla_ms": t_ref and round(t_ref, 3), "speedup": sp}))
+        row["fwd_xla_ms"], row["fwd_speedup"] = None, "xla-oom"
+    row["fwdbwd_flash_ms"] = round(bench(fa_g, q, k, v), 3)
+    try:
+        row["fwdbwd_xla_ms"] = round(bench(ref_g, q, k, v), 3)
+        row["fwdbwd_speedup"] = round(row["fwdbwd_xla_ms"] / row["fwdbwd_flash_ms"], 2)
+    except Exception:
+        row["fwdbwd_xla_ms"], row["fwdbwd_speedup"] = None, "xla-oom"
+    print(json.dumps(row))

@@ -9,15 +9,19 @@ launch overhead dominates, and (c) as the recompute backward.
 Design notes (TPU-first, not a port — the reference has no attention
 anywhere; this is new capability per SURVEY §2.5):
 
-- grid = (batch·q_heads, q_blocks); each program streams KV blocks with
-  ``lax.fori_loop`` keeping running max/sum (online softmax) in VMEM
-  scratch, so the S = QKᵀ matrix is never materialized in HBM.
+- grid = (batch·q_heads, q_blocks, kv_blocks); the minor grid dim
+  streams KV blocks through VMEM while scratch carries the online
+  softmax running max/sum across steps, so the S = QKᵀ matrix is never
+  materialized in HBM and VMEM holds one (bq, bk) tile pair at any
+  sequence length.
 - causal masking prunes whole KV blocks past the diagonal.
 - GQA: q_heads may be a multiple of kv_heads; the kv head index is
   derived from the q head index, no KV duplication in memory.
-- backward = recompute with the XLA path under ``jax.custom_vjp``
-  (flash recompute-backward); trades FLOPs for HBM, the right trade on
-  TPU where attention backward is bandwidth-bound.
+- backward = pallas flash backward (dq kernel + dk/dv kernel, both
+  recomputing P blockwise from the forward's saved logsumexp, so the
+  S = QKᵀ matrix is never materialized in the backward either — long
+  context trains, not just infers). Off-TPU / odd shapes fall back to
+  recompute through the XLA path under the same ``jax.custom_vjp``.
 """
 
 from __future__ import annotations
@@ -29,9 +33,41 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Block-size defaults tuned on v5e (d=128, GQA 12/4, fwd+bwd, causal):
+# 512/1024 beats 128/128 by 2.4x at 2k seq and 3.2x at 8k — big blocks
+# amortize grid overhead and fill the MXU; see docs/BENCHMARKS.md.
+# Clamped per-call to the largest divisor of the sequence length
+# (see _fit_block) so off-multiple sequences shrink the block rather
+# than losing the pallas path.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+
+
+def _fit_block(block: int, seq: int, floor: int = 128) -> int:
+    """Largest b <= block with seq % b == 0, halving down to ``floor``.
+
+    Keeps long-but-off-multiple sequences (e.g. 13824 = 27*512) on the
+    pallas path — falling back to XLA there would materialize the S^2
+    score tensor, the exact failure the kernel exists to avoid.
+    """
+    b = min(block, seq)
+    while b > floor and seq % b:
+        b //= 2
+    return b
+
+
+def _causal_mask(s, qi, ki, block_q: int, block_k: int):
+    """Mask scores above the self-attention diagonal for the (qi, ki)
+    block pair. Absolute-position compare, no sq!=sk diagonal offset —
+    the public entry gates causal pallas on sq == sk."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -64,113 +100,354 @@ def mha_reference(
     return out.reshape(b, sq, hq, d).astype(q.dtype)
 
 
-# ---------------------------------------------------------------------------
-# Pallas kernel
-# ---------------------------------------------------------------------------
 
-
-def _flash_kernel(
-    q_ref,  # [block_q, d]
-    k_ref,  # [Sk, d]
-    v_ref,  # [Sk, d]
-    o_ref,  # [block_q, d]
+def _fwd_kernel(
+    q_ref,    # [1, block_q, d]
+    k_ref,    # [1, block_k, d]
+    v_ref,    # [1, block_k, d]
+    o_ref,    # [1, block_q, d]
+    lse_ref,  # [1, 1, Sq] or absent
+    m_scr,    # [block_q, 128] f32 running max (col 0 live, lane-padded)
+    l_scr,    # [block_q, 128] f32 running sum
+    acc_scr,  # [block_q, d] f32 accumulator
     *,
     scale: float,
     causal: bool,
+    block_q: int,
     block_k: int,
-    seq_k: int,
+    num_k_blocks: int,
+    with_lse: bool,
 ):
     from jax.experimental import pallas as pl
 
-    block_q = q_ref.shape[0]
-    d = q_ref.shape[1]
-    qi = pl.program_id(1)  # q-block index
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    num_k_blocks = pl.cdiv(seq_k, block_k)
+    # causal: KV blocks strictly above the q block's last row see nothing
+    needed = True
     if causal:
-        # KV blocks fully above the diagonal contribute nothing.
-        # query rows for this block span [qi*bq, (qi+1)*bq)
-        last_block = jax.lax.div((qi + 1) * block_q - 1, block_k) + 1
-        num_iters = jnp.minimum(num_k_blocks, last_block)
-    else:
-        num_iters = num_k_blocks
+        needed = kk * block_k <= (qi + 1) * block_q - 1
 
-    def body(ki, carry):
-        m_prev, l_prev, acc = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+            s = _causal_mask(s, qi, kk, block_q, block_k)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [bq, bk]
+        p = jnp.exp(s - m_new)
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        acc_new = acc * correction + pv
-        return m_new, l_new, acc_new
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc_scr[:] * correction + pv
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kk == num_k_blocks - 1)
+    def _flush():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if with_lse:
+            lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = (
+                m_scr[:, :1] + jnp.log(l)
+            )[:, 0]
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
-    block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
+    block_q: int, block_k: int, interpret: bool, with_residuals: bool = False,
+):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     groups = hq // hkv
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
 
-    # [B, S, H, D] → [B·H, S, D] with the kv head index recoverable as
+    # [B, S, H, D] -> [B*H, S, D] with the kv head index recoverable as
     # (flat_head // groups) for GQA
     qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
 
-    grid = (b * hq, pl.cdiv(sq, block_q))
+    num_k_blocks = pl.cdiv(sk, block_k)
+    # grid minor dim streams KV blocks, so VMEM holds one (bq, bk) tile
+    # pair regardless of sequence length — scratch carries the online
+    # softmax state across the kk steps
+    grid = (b * hq, pl.cdiv(sq, block_q), num_k_blocks)
 
-    # BlockSpec leading dim 1 hands the kernel [1, ·, d] refs; the 3d
-    # wrapper peels it so the math stays 2D.
-    def kernel_3d(q_ref, k_ref, v_ref, o_ref):
-        _flash_kernel(
-            q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
-            scale=scale, causal=causal, block_k=block_k, seq_k=sk,
+    def kernel(q_r, k_r, v_r, o_r, *rest):
+        # pallas passes refs positionally: inputs, outputs, scratch —
+        # the lse output ref is present only when requested
+        lse_r = rest[0] if with_residuals else None
+        m_s, l_s, a_s = rest[-3:]
+        _fwd_kernel(
+            q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            num_k_blocks=num_k_blocks, with_lse=with_residuals,
         )
 
-    out = pl.pallas_call(
-        kernel_3d,
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
+    if with_residuals:
+        # full-row block: every kk/qi program for a head revisits it and
+        # stores only its own slice
+        out_specs.append(pl.BlockSpec((1, 1, sq), lambda h, i, kk: (h, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * hq, 1, sq), jnp.float32))
+
+    res = pl.pallas_call(
+        kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda h, i: (h // groups, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda h, i: (h // groups, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    if not with_residuals:
+        res = [res] if not isinstance(res, (list, tuple)) else res
+    out = res[0].reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    if with_residuals:
+        return out, res[1]  # lse stays [B*H, 1, Sq]
+    return out
+
+
+def _bwd_dq_kernel(
+    q_ref,    # [1, block_q, d]
+    k_ref,    # [1, block_k, d]
+    v_ref,    # [1, block_k, d]
+    do_ref,   # [1, block_q, d]
+    lse_ref,  # [1, 1, Sq] full row
+    dd_ref,   # [1, 1, Sq] full row   D = rowsum(dO * O)
+    dq_ref,   # [1, block_q, d]
+    dq_scr,   # [block_q, d] f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = True
+    if causal:
+        needed = kk * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        dd = dd_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, qi, kk, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dd)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kk == num_k_blocks - 1)
+    def _flush():
+        dq_ref[0] = (scale * dq_scr[:]).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref,    # [1, block_q, d]
+    k_ref,    # [1, block_k, d]
+    v_ref,    # [1, block_k, d]
+    do_ref,   # [1, block_q, d]
+    lse_ref,  # [1, 1, Sq] full row
+    dd_ref,   # [1, 1, Sq] full row
+    dk_ref,   # [1, block_k, d]
+    dv_ref,   # [1, block_k, d]
+    dk_scr,   # [block_k, d] f32
+    dv_scr,   # [block_k, d] f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_q_blocks: int,
+):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = True
+    if causal:
+        # q blocks whose last row is above this KV block's first row
+        # contribute nothing
+        needed = (qi + 1) * block_q - 1 >= ki * block_k
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        dd = dd_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dd)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush():
+        dk_ref[0] = (scale * dk_scr[:]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+):
+    """Pallas flash backward: dq streams KV blocks, dk/dv stream Q
+    blocks, both recomputing P from the saved logsumexp — no S^2 in HBM
+    and O(block) VMEM at any sequence length."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = hq // hkv
+    bq = _fit_block(block_q, sq)
+    bk = _fit_block(block_k, sk)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    # D = rowsum(dO * O): cheap, bandwidth-bound — XLA fuses it.
+    # lse arrives [B*H, 1, Sq]; dd matches that layout.
+    dd = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    dd = dd.reshape(b * hq, 1, sq)
+
+    row_spec = pl.BlockSpec((1, 1, sq), lambda h, i, j: (h, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=bq, block_k=bk,
+            num_k_blocks=pl.cdiv(sk, bk),
+        ),
+        grid=(b * hq, pl.cdiv(sq, bq), pl.cdiv(sk, bk)),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dd)
+
+    # dk/dv per *q*-head (kv grads accumulate across the GQA group
+    # afterwards — a [B, Hkv, G, Sk, D] sum, trivial next to S^2)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale, causal=causal, block_q=bq, block_k=bk,
+            num_q_blocks=pl.cdiv(sq, bq),
+        ),
+        grid=(b * hq, pl.cdiv(sk, bk), pl.cdiv(sq, bq)),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h, ki, 0)),
+        ],
+        # f32 outputs: the per-q-head partials get summed over the GQA
+        # group below — rounding them to bf16 first would throw away the
+        # f32 accumulation the kernel maintains
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dd)
+
+    dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    # sum kv grads over the query-head group
+    dk = dk.reshape(b, hkv, groups, sk, d).sum(axis=2)
+    dv = dv.reshape(b, hkv, groups, sk, d).sum(axis=2)
+    dk = dk.transpose(0, 2, 1, 3)
+    dv = dv.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(
@@ -181,18 +458,21 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret, with_residuals=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-backward through the XLA path
-    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
 
 
 def flash_attention(
@@ -219,16 +499,37 @@ def flash_attention(
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # Mosaic tiling constraints: last dim must be lane-aligned (128) and
     # seq lens must fill whole blocks (a partial KV block would feed
-    # padding garbage into the online softmax).
-    bq, bk = min(block_q, sq), min(block_k, sk)
+    # padding garbage into the online softmax). Blocks shrink to fit the
+    # sequence (_fit_block) rather than dropping to the XLA path, which
+    # would materialize the S^2 score tensor at long context.
+    bq, bk = _fit_block(block_q, sq), _fit_block(block_k, sk)
     shapes_ok = (
-        d % 128 == 0 and sq % bq == 0 and sk % bk == 0 and sq >= 128 and sk >= 128
+        # seq % 128 keeps every fitted block sublane/lane aligned —
+        # without it _fit_block(512, 200) would hand Mosaic a 200-row
+        # block and fail at compile time instead of falling back
+        d % 128 == 0 and sq % 128 == 0 and sk % 128 == 0
+        and sq % bq == 0 and sk % bk == 0
+        # the kernels' causal mask compares absolute positions with no
+        # diagonal offset — only meaningful for self-attention lengths
+        and (not causal or sq == sk)
     )
+    if interpret:
+        # kernel-validation mode: force the kernel, but refuse shapes
+        # whose pallas result would silently diverge from mha_reference
+        # (partial blocks poison the online softmax; causal sq != sk has
+        # no diagonal offset in _causal_mask)
+        if sq % bq or sk % bk or (causal and sq != sk):
+            raise ValueError(
+                f"interpret=True with unsupported shape: sq={sq} bq={bq} "
+                f"sk={sk} bk={bk} causal={causal} (need whole blocks and "
+                "sq == sk for causal)"
+            )
+        return _flash(q, k, v, causal, scale, bq, bk, interpret)
     if use_pallas is None:
         platform = jax.devices()[0].platform
         use_pallas = platform == "tpu" and shapes_ok
-    elif use_pallas and not shapes_ok and not interpret:
+    elif use_pallas and not shapes_ok:
         use_pallas = False  # unsupported tiling → XLA path
-    if not use_pallas and not interpret:
+    if not use_pallas:
         return mha_reference(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, scale, bq, bk, interpret)

@@ -55,7 +55,11 @@ class TestAttentionOps:
         k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 4, 64))
         v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, 4, 64))
         ref = mha_reference(q, k, v, causal=True)
-        out = flash_attention(q, k, v, causal=True, interpret=True)
+        # explicit small blocks: 4x4 block grid so the cross-block online
+        # softmax (kk>0 correction rescale) is actually exercised
+        out = flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64, interpret=True
+        )
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
     def test_flash_noncausal(self):
@@ -63,16 +67,49 @@ class TestAttentionOps:
         k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 4, 32))
         v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 4, 32))
         ref = mha_reference(q, k, v, causal=False)
-        out = flash_attention(q, k, v, causal=False, interpret=True)
+        out = flash_attention(
+            q, k, v, causal=False, block_q=64, block_k=64, interpret=True
+        )
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
     def test_flash_grads(self):
         q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 32))
         k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 2, 32))
         v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 2, 32))
-        g1 = jax.grad(lambda q: flash_attention(q, k, v, interpret=True).sum())(q)
+        g1 = jax.grad(
+            lambda q: flash_attention(
+                q, k, v, block_q=64, block_k=64, interpret=True
+            ).sum()
+        )(q)
         g2 = jax.grad(lambda q: mha_reference(q, k, v).sum())(q)
         np.testing.assert_allclose(g1, g2, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_backward_kernels_full_qkv_gqa(self, causal):
+        """The pallas backward (dq + dk/dv kernels, P recomputed from the
+        saved logsumexp) matches XLA autodiff for every input, with GQA
+        head-group accumulation and multiple q/k blocks."""
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 256, 8, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 4, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, 4, 64))
+        w = jax.random.normal(jax.random.PRNGKey(3), (2, 256, 8, 64))
+
+        def loss_flash(q, k, v):
+            # asymmetric 64/128 blocks: 4 q-blocks x 2 k-blocks, so the
+            # dq kernel crosses KV blocks and the dk/dv kernel crosses
+            # q-blocks (scratch accumulation across the minor grid dim)
+            out = flash_attention(
+                q, k, v, causal=causal, block_q=64, block_k=128, interpret=True
+            )
+            return (out * w).sum()
+
+        def loss_ref(q, k, v):
+            return (mha_reference(q, k, v, causal=causal) * w).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(a, b, atol=5e-5, err_msg=name)
 
     def test_rms_norm_f32_accumulation(self):
         x = (jax.random.normal(jax.random.PRNGKey(0), (4, 128)) * 100).astype(jnp.bfloat16)
