@@ -171,6 +171,7 @@ def _fwd_kernel(
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool, with_residuals: bool = False,
+    out_f32: bool = False,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -205,7 +206,11 @@ def _flash_forward(
         )
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
+    # out_f32: ring attention merges per-step partials — quantizing each
+    # to q.dtype before the merge would compound rounding per ring step
+    out_shape = [jax.ShapeDtypeStruct(
+        (b * hq, sq, d), jnp.float32 if out_f32 else q.dtype
+    )]
     if with_residuals:
         # full-row block: every kk/qi program for a head revisits it and
         # stores only its own slice
@@ -358,12 +363,27 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def compute_dd(out: jax.Array, g: jax.Array) -> jax.Array:
+    """D = rowsum(dO * O) in the backward's [B*H, 1, Sq] row layout.
+
+    Cheap, bandwidth-bound — XLA fuses it. Split out from
+    _flash_backward because ring attention must compute it from the
+    *globally merged* output, not a per-ring-step block output."""
+    b, sq, hq, d = out.shape
+    ot = out.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    dd = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    return dd.reshape(b * hq, 1, sq)
+
+
 def _flash_backward(
-    q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+    q, k, v, dd, lse, g, causal, scale, block_q, block_k, interpret,
+    grads_f32: bool = False,
 ):
     """Pallas flash backward: dq streams KV blocks, dk/dv stream Q
     blocks, both recomputing P from the saved logsumexp — no S^2 in HBM
-    and O(block) VMEM at any sequence length."""
+    and O(block) VMEM at any sequence length. ``dd``/``lse`` arrive in
+    the [B*H, 1, Sq] row layout (see :func:`compute_dd`)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -377,11 +397,6 @@ def _flash_backward(
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     dot = g.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    ot = out.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    # D = rowsum(dO * O): cheap, bandwidth-bound — XLA fuses it.
-    # lse arrives [B*H, 1, Sq]; dd matches that layout.
-    dd = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
-    dd = dd.reshape(b * hq, 1, sq)
 
     row_spec = pl.BlockSpec((1, 1, sq), lambda h, i, j: (h, 0, 0))
 
@@ -401,7 +416,11 @@ def _flash_backward(
             row_spec,
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        # f32 when the caller accumulates partials across ring steps —
+        # flushing to bf16 here would quantize before the accumulation
+        out_shape=jax.ShapeDtypeStruct(
+            (b * hq, sq, d), jnp.float32 if grads_f32 else q.dtype
+        ),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dd)
@@ -447,6 +466,10 @@ def _flash_backward(
     dv = dv.reshape(b, hkv, groups, sk, d).sum(axis=2)
     dk = dk.transpose(0, 2, 1, 3)
     dv = dv.transpose(0, 2, 1, 3)
+    if grads_f32:
+        # ring attention accumulates these partials across ring steps —
+        # dq/dk/dv are all still f32 here (see the out_shape dtypes)
+        return dq, dk, dv
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -467,7 +490,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     return _flash_backward(
-        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+        q, k, v, compute_dd(out, g), lse, g, causal, scale, block_q, block_k,
+        interpret
     )
 
 

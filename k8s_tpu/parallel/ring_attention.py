@@ -14,9 +14,23 @@ at the end. Causal masking is block-granular on global positions, so
 chunks entirely in the future contribute nothing (their exp() terms
 vanish against the running max).
 
-Differentiable by construction (scan + ppermute autodiff); a fused
-pallas ring kernel with RDMA double-buffering is the round-2 upgrade
-path (pallas guide "Ring Collectives" pattern).
+Two interchangeable per-device bodies:
+
+- :func:`ring_attention_sharded` — XLA einsum blockwise attention,
+  differentiable by construction (scan + ppermute autodiff). Runs
+  anywhere; materializes local [Sq_local, Sk_local] score blocks.
+- :func:`ring_flash_attention_sharded` — each ring step runs the
+  pallas flash kernels (`ops.attention`) on the resident KV chunk and
+  the per-chunk outputs are merged exactly in log space via the
+  kernels' saved logsumexp. The backward is a hand-written ring pass
+  under ``jax.custom_vjp``: dq accumulates locally while dk/dv partials
+  ride around the ring with their KV chunk and arrive home after a full
+  cycle — per-block P is recomputed from the *global* lse, so gradients
+  are exact, never materializing S² on any device.
+
+An RDMA double-buffered fused kernel (pallas guide "Ring Collectives")
+remains the next upgrade once multi-chip hardware is available to
+validate it.
 """
 
 from __future__ import annotations
@@ -28,6 +42,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_tpu.ops.attention import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    _flash_backward,
+    _flash_forward,
+    compute_dd,
+)
 
 NEG_INF = -1e30
 
@@ -90,6 +112,176 @@ def ring_attention_sharded(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas-flash ring body
+# ---------------------------------------------------------------------------
+
+
+def _lse_to_out_layout(lse: jax.Array, b: int, hq: int, sq: int) -> jax.Array:
+    """[B*H, 1, Sq] kernel row layout → [B, Sq, Hq, 1] broadcastable
+    against the [B, Sq, Hq, D] output."""
+    return lse.reshape(b, hq, sq).transpose(0, 2, 1)[..., None]
+
+
+def _merge_partial(out_acc, lse_acc, out_i, lse_i):
+    """Exact log-space merge of two self-normalized attention partials.
+
+    out_* are [B, Sq, Hq, D] f32 normalized by their own lse_*
+    ([B*H, 1, Sq] f32); an empty partial is (0, NEG_INF) and drops out
+    of the merge since exp(NEG_INF - lse_new) == 0.
+    """
+    b, sq, hq, _ = out_acc.shape
+    m = jnp.maximum(lse_acc, lse_i)
+    lse_new = m + jnp.log(jnp.exp(lse_acc - m) + jnp.exp(lse_i - m))
+    w_acc = jnp.exp(_lse_to_out_layout(lse_acc - lse_new, b, hq, sq))
+    w_i = jnp.exp(_lse_to_out_layout(lse_i - lse_new, b, hq, sq))
+    return out_acc * w_acc + out_i * w_i, lse_new
+
+
+def _rotate(x, axis_name: str):
+    n = jax.lax.axis_size(axis_name)
+    return jax.lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd(
+    q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+):
+    b, sq, hq, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    def block_fwd(k_blk, v_blk, blk_causal):
+        # out_f32: partials stay f32 through the log-space merge; the
+        # single cast to q.dtype happens after the last ring step
+        return _flash_forward(
+            q, k_blk, v_blk, blk_causal, scale, block_q, block_k, interpret,
+            with_residuals=True, out_f32=True,
+        )
+
+    # step 0: the diagonal chunk (kv home) — statically causal
+    out_acc, lse_acc = block_fwd(k, v, causal)
+
+    def step_fn(carry, step):
+        out_acc, lse_acc, k_cur, v_cur = carry
+        k_cur = _rotate(k_cur, axis_name)
+        v_cur = _rotate(v_cur, axis_name)
+        src = (my - step) % n  # owner of the chunk now resident
+        if causal:
+            # past chunks attend fully; future chunks contribute nothing
+            out_i, lse_i = jax.lax.cond(
+                src < my,
+                lambda: block_fwd(k_cur, v_cur, False),
+                lambda: (
+                    jnp.zeros((b, sq, hq, d), jnp.float32),
+                    jnp.full((b * hq, 1, sq), NEG_INF, jnp.float32),
+                ),
+            )
+        else:
+            out_i, lse_i = block_fwd(k_cur, v_cur, False)
+        out_acc, lse_acc = _merge_partial(out_acc, lse_acc, out_i, lse_i)
+        return (out_acc, lse_acc, k_cur, v_cur), None
+
+    if n > 1:
+        (out_acc, lse_acc, _, _), _ = jax.lax.scan(
+            step_fn, (out_acc, lse_acc, k, v), jnp.arange(1, n)
+        )
+    out = out_acc.astype(q.dtype)
+    return out, (q, k, v, out, lse_acc)
+
+
+def _ring_flash_bwd(
+    axis_name, causal, scale, block_q, block_k, interpret, res, g
+):
+    q, k, v, out, lse = res
+    b, sq, hq, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    dd = compute_dd(out, g)  # GLOBAL rowsum(dO*O) — not per-chunk
+
+    def block_bwd(k_blk, v_blk, blk_causal):
+        # per-block P recomputed from the global lse → exact global grads
+        return _flash_backward(
+            q, k_blk, v_blk, dd, lse, g, blk_causal, scale, block_q, block_k,
+            interpret, grads_f32=True,
+        )
+
+    # step 0: diagonal chunk; its dk/dv partials start the ring ride
+    dq_acc, dk_cur, dv_cur = block_bwd(k, v, causal)
+
+    def step_fn(carry, step):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        k_cur = _rotate(k_cur, axis_name)
+        v_cur = _rotate(v_cur, axis_name)
+        dk_cur = _rotate(dk_cur, axis_name)
+        dv_cur = _rotate(dv_cur, axis_name)
+        src = (my - step) % n
+
+        def compute():
+            dq_i, dk_i, dv_i = block_bwd(k_cur, v_cur, False)
+            return dq_acc + dq_i, dk_cur + dk_i, dv_cur + dv_i
+
+        if causal:
+            dq_acc, dk_cur, dv_cur = jax.lax.cond(
+                src < my, compute, lambda: (dq_acc, dk_cur, dv_cur)
+            )
+        else:
+            dq_acc, dk_cur, dv_cur = compute()
+        return (dq_acc, k_cur, v_cur, dk_cur, dv_cur), None
+
+    if n > 1:
+        (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
+            step_fn, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(1, n)
+        )
+        # chunks have rotated n-1 times; one more brings dk/dv home
+        dk_cur = _rotate(dk_cur, axis_name)
+        dv_cur = _rotate(dv_cur, axis_name)
+    return (
+        dq_acc.astype(q.dtype),
+        dk_cur.astype(k.dtype),
+        dv_cur.astype(v.dtype),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention_sharded(
+    q: jax.Array,  # local [B, Sq_local, Hq, D]
+    k: jax.Array,  # local [B, Sk_local, Hkv, D]
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Per-device flash ring body — call inside ``shard_map``.
+
+    Causal masking assumes equal-size chunks laid out contiguously over
+    the ring (chunk r holds global positions [r*S_local, (r+1)*S_local))
+    with q and kv sharded identically, so the diagonal chunk is exactly
+    local causal self-attention.
+    """
+    if q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"ring flash needs equal q/kv chunk sizes, got {q.shape[1]} "
+            f"vs {k.shape[1]}"
+        )
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _ring_flash(
+        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    )
+
+
 def seq_parallel_call(
     body,
     mesh: Mesh,
@@ -122,12 +314,38 @@ def ring_attention(
     axis_name: str = "seq",
     batch_axes=("data", "fsdp"),
     head_axis: str = "tensor",
+    impl: Optional[str] = None,  # "flash" | "xla" | None = auto
+    interpret: bool = False,
 ):
     """Global-array form: shards length over ``seq``, batch over
-    data/fsdp, heads over tensor, and runs the ring body."""
-    body = partial(
-        ring_attention_sharded, axis_name=axis_name, causal=causal, scale=scale
-    )
+    data/fsdp, heads over tensor, and runs the ring body.
+
+    ``impl=None`` auto-selects the pallas-flash body on TPU when the
+    local chunk is lane-aligned, the XLA einsum body otherwise.
+    """
+    if impl is None:
+        d = q.shape[-1]
+        n = mesh.shape[axis_name]
+        local = q.shape[1] // max(n, 1)
+        flash_ok = (
+            q.shape[1] == k.shape[1] and d % 128 == 0 and local % 128 == 0
+        )
+        # the mesh's devices decide, not the default backend — they can
+        # differ (e.g. a CPU mesh on a TPU-backed host in dryruns)
+        on_tpu = mesh.devices.flat[0].platform == "tpu"
+        impl = "flash" if (flash_ok and (on_tpu or interpret)) else "xla"
+    if impl == "flash":
+        body = partial(
+            ring_flash_attention_sharded, axis_name=axis_name, causal=causal,
+            scale=scale, interpret=interpret,
+        )
+    elif impl == "xla":
+        body = partial(
+            ring_attention_sharded, axis_name=axis_name, causal=causal,
+            scale=scale,
+        )
+    else:
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     return seq_parallel_call(
         body, mesh, axis_name=axis_name, batch_axes=batch_axes,
         head_axis=head_axis,
