@@ -62,7 +62,7 @@ class BertLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, attention_mask=None):
         cfg = self.config
         h, d = cfg.num_heads, cfg.head_dim
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_attn")
@@ -71,7 +71,10 @@ class BertLayer(nn.Module):
         k = _dense((h, d), ("embed", "heads", "head_dim"), "k_proj", cfg.dtype)(x)
         v = _dense((h, d), ("embed", "heads", "head_dim"), "v_proj", cfg.dtype)(x)
         q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
-        attn = flash_attention(q, k, v, causal=False)
+        # padding mask rides the kernel's segment-id masking (1=real,
+        # 0=pad): pad keys are invisible; pad-query outputs are garbage
+        # and the MLM loss mask is expected to drop them
+        attn = flash_attention(q, k, v, causal=False, segment_ids=attention_mask)
         attn = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
             param_dtype=jnp.float32,
@@ -92,7 +95,7 @@ class BertForPretraining(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
         cfg = self.config
         b, s = input_ids.shape
         tok = nn.Embed(
@@ -114,7 +117,7 @@ class BertForPretraining(nn.Module):
             )(token_type_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_embed")(x)
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(x)
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
         mlm_logits = nn.DenseGeneral(
             features=cfg.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(

@@ -114,7 +114,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         cfg = self.config
         b, s, _ = x.shape
         h, kv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -129,13 +129,23 @@ class LlamaAttention(nn.Module):
         if cfg.attention == "ring":
             from k8s_tpu.parallel.ring_attention import ring_attention
 
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segments are not yet threaded through the "
+                    "ring-attention body"
+                )
             out = ring_attention(q, k, v, cfg.mesh, causal=True)
         elif cfg.attention == "ulysses":
             from k8s_tpu.parallel.ulysses import ulysses_attention
 
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segments are not yet threaded through the "
+                    "ulysses-attention body"
+                )
             out = ulysses_attention(q, k, v, cfg.mesh, causal=True)
         else:
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
         out = nn.DenseGeneral(
             features=cfg.hidden_size,
             axis=(-2, -1),
@@ -182,11 +192,11 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         cfg = self.config
         x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
         h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
-        x = x + LlamaAttention(cfg, name="attn")(h, positions)
+        x = x + LlamaAttention(cfg, name="attn")(h, positions, segment_ids)
         h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
         if cfg.num_experts > 0:
             from k8s_tpu.models.moe import MoeConfig, MoeMlp
@@ -208,18 +218,23 @@ class _ScannedBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        return LlamaBlock(self.config, name="block")(x, positions), None
+    def __call__(self, x, positions, segment_ids):
+        return LlamaBlock(self.config, name="block")(x, positions, segment_ids), None
 
 
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):  # [B, S] int32
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        """input_ids [B, S] int32. For packed pretraining pass
+        ``segment_ids`` ([B, S]: which document each token belongs to;
+        attention is masked across documents) and ``positions``
+        (restarting at 0 per document so RoPE sees local offsets)."""
         cfg = self.config
         b, s = input_ids.shape
-        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,
@@ -246,7 +261,7 @@ class LlamaForCausalLM(nn.Module):
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x, positions)
+            )(cfg, name="layers")(x, positions, segment_ids)
         else:
             block = LlamaBlock
             if cfg.remat:
@@ -256,7 +271,7 @@ class LlamaForCausalLM(nn.Module):
                     policy=_remat_policy(cfg.remat_policy),
                 )
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"layer_{i}")(x, positions)
+                x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         logits = nn.DenseGeneral(
             features=cfg.vocab_size,

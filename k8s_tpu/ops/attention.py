@@ -17,6 +17,9 @@ anywhere; this is new capability per SURVEY §2.5):
 - causal masking prunes whole KV blocks past the diagonal.
 - GQA: q_heads may be a multiple of kv_heads; the kv head index is
   derived from the q head index, no KV duplication in memory.
+- segment-id masking (``segment_ids``): padding masks and packed
+  multi-document rows, applied consistently in forward and both
+  backward kernels.
 - backward = pallas flash backward (dq kernel + dk/dv kernel, both
   recomputing P blockwise from the forward's saved logsumexp, so the
   S = QKᵀ matrix is never materialized in the backward either — long
@@ -70,6 +73,23 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _segment_mask(s, seg_ref, qi, ki, block_q: int, block_k: int):
+    """Mask scores across segment boundaries: token j is visible to
+    token i iff their segment ids match. Padding is the degenerate
+    case (mask 1 = real, 0 = pad): pad keys become invisible to real
+    queries; pad-query rows produce garbage outputs, which the loss
+    mask is expected to drop (same contract as every flash kernel).
+
+    ``seg_ref`` is the full [1, 1, S] row (the lse layout — Mosaic
+    rejects (1, block) blocks of a [B, S] array); the q/k slices are
+    cut here. Self-attention only, hence one shared row."""
+    from jax.experimental import pallas as pl
+
+    seg_q = seg_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+    seg_k = seg_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
+    return jnp.where(seg_q == seg_k, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # XLA reference path (also the recompute backward)
 # ---------------------------------------------------------------------------
@@ -81,6 +101,7 @@ def mha_reference(
     v: jax.Array,  # [B, Sk, Hkv, D]
     causal: bool = True,
     scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] (self-attention)
 ) -> jax.Array:
     """Plain XLA attention with GQA broadcast, f32 softmax."""
     b, sq, hq, d = q.shape
@@ -95,6 +116,10 @@ def mha_reference(
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        visible = seg[:, :, None] == seg[:, None, :]  # [B, Sq, Sk]
+        logits = jnp.where(visible[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
     return out.reshape(b, sq, hq, d).astype(q.dtype)
@@ -105,6 +130,7 @@ def _fwd_kernel(
     q_ref,    # [1, block_q, d]
     k_ref,    # [1, block_k, d]
     v_ref,    # [1, block_k, d]
+    seg_ref,  # [1, 1, Sq] int32 full row, or None
     o_ref,    # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] or absent
     m_scr,    # [block_q, 128] f32 running max (col 0 live, lane-padded)
@@ -144,6 +170,8 @@ def _fwd_kernel(
         )  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, kk, block_q, block_k)
+        if seg_ref is not None:
+            s = _segment_mask(s, seg_ref, qi, kk, block_q, block_k)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -171,7 +199,7 @@ def _fwd_kernel(
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool, with_residuals: bool = False,
-    out_f32: bool = False,
+    out_f32: bool = False, segment_ids: Optional[jax.Array] = None,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -181,6 +209,7 @@ def _flash_forward(
     groups = hq // hkv
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
+    with_segments = segment_ids is not None
 
     # [B, S, H, D] -> [B*H, S, D] with the kv head index recoverable as
     # (flat_head // groups) for GQA
@@ -194,16 +223,31 @@ def _flash_forward(
     # softmax state across the kk steps
     grid = (b * hq, pl.cdiv(sq, block_q), num_k_blocks)
 
-    def kernel(q_r, k_r, v_r, o_r, *rest):
+    def kernel(q_r, k_r, v_r, *rest):
         # pallas passes refs positionally: inputs, outputs, scratch —
-        # the lse output ref is present only when requested
-        lse_r = rest[0] if with_residuals else None
-        m_s, l_s, a_s = rest[-3:]
+        # the segment input and the lse output are present only on demand
+        rest = list(rest)
+        seg_r = rest.pop(0) if with_segments else None
+        o_r = rest.pop(0)
+        lse_r = rest.pop(0) if with_residuals else None
+        m_s, l_s, a_s = rest
         _fwd_kernel(
-            q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s,
+            q_r, k_r, v_r, seg_r, o_r, lse_r, m_s, l_s, a_s,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             num_k_blocks=num_k_blocks, with_lse=with_residuals,
         )
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if with_segments:
+        # full [1, 1, S] row per program, sliced in-kernel (lse layout)
+        seg = segment_ids.astype(jnp.int32).reshape(b, 1, sq)
+        in_specs.append(pl.BlockSpec((1, 1, sq), lambda h, i, kk: (h // hq, 0, 0)))
+        operands.append(seg)
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0))]
     # out_f32: ring attention merges per-step partials — quantizing each
@@ -220,11 +264,7 @@ def _flash_forward(
     res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, kk: (h // groups, kk, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -233,7 +273,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     if not with_residuals:
         res = [res] if not isinstance(res, (list, tuple)) else res
     out = res[0].reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
@@ -249,6 +289,7 @@ def _bwd_dq_kernel(
     do_ref,   # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] full row
     dd_ref,   # [1, 1, Sq] full row   D = rowsum(dO * O)
+    seg_ref,  # [1, 1, Sq] int32 full row, or None
     dq_ref,   # [1, block_q, d]
     dq_scr,   # [block_q, d] f32
     *,
@@ -284,6 +325,8 @@ def _bwd_dq_kernel(
         )
         if causal:
             s = _causal_mask(s, qi, kk, block_q, block_k)
+        if seg_ref is not None:
+            s = _segment_mask(s, seg_ref, qi, kk, block_q, block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -305,6 +348,7 @@ def _bwd_dkv_kernel(
     do_ref,   # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] full row
     dd_ref,   # [1, 1, Sq] full row
+    seg_ref,  # [1, 1, Sq] int32 full row, or None
     dk_ref,   # [1, block_k, d]
     dv_ref,   # [1, block_k, d]
     dk_scr,   # [block_k, d] f32
@@ -345,6 +389,8 @@ def _bwd_dkv_kernel(
         )  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if seg_ref is not None:
+            s = _segment_mask(s, seg_ref, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -378,7 +424,7 @@ def compute_dd(out: jax.Array, g: jax.Array) -> jax.Array:
 
 def _flash_backward(
     q, k, v, dd, lse, g, causal, scale, block_q, block_k, interpret,
-    grads_f32: bool = False,
+    grads_f32: bool = False, segment_ids: Optional[jax.Array] = None,
 ):
     """Pallas flash backward: dq streams KV blocks, dk/dv stream Q
     blocks, both recomputing P from the saved logsumexp — no S^2 in HBM
@@ -392,6 +438,7 @@ def _flash_backward(
     groups = hq // hkv
     bq = _fit_block(block_q, sq)
     bk = _fit_block(block_k, sk)
+    with_segments = segment_ids is not None
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
@@ -400,21 +447,37 @@ def _flash_backward(
 
     row_spec = pl.BlockSpec((1, 1, sq), lambda h, i, j: (h, 0, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel,
+    operands = [qt, kt, vt, dot, lse, dd]
+    if with_segments:
+        seg = segment_ids.astype(jnp.int32).reshape(b, 1, sq)
+        operands.append(seg)
+
+    def dq_wrapper(q_r, k_r, v_r, do_r, lse_r, dd_r, *rest):
+        rest = list(rest)
+        seg_r = rest.pop(0) if with_segments else None
+        _bwd_dq_kernel(
+            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, *rest,
             scale=scale, causal=causal, block_q=bq, block_k=bk,
             num_k_blocks=pl.cdiv(sk, bk),
-        ),
+        )
+
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
+        pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
+        pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
+        row_spec,
+        row_spec,
+    ]
+    if with_segments:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, sq), lambda h, i, kk: (h // hq, 0, 0))
+        )
+
+    dq = pl.pallas_call(
+        dq_wrapper,
         grid=(b * hq, pl.cdiv(sq, bq), pl.cdiv(sk, bk)),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, kk: (h // groups, kk, 0)),
-            pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
-            row_spec,
-            row_spec,
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda h, i, kk: (h, i, 0)),
         # f32 when the caller accumulates partials across ring steps —
         # flushing to bf16 here would quantize before the accumulation
@@ -423,25 +486,36 @@ def _flash_backward(
         ),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, dd)
+    )(*operands)
+
+    def dkv_wrapper(q_r, k_r, v_r, do_r, lse_r, dd_r, *rest):
+        rest = list(rest)
+        seg_r = rest.pop(0) if with_segments else None
+        _bwd_dkv_kernel(
+            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, *rest,
+            scale=scale, causal=causal, block_q=bq, block_k=bk,
+            num_q_blocks=pl.cdiv(sq, bq),
+        )
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
+        pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
+        row_spec,
+        row_spec,
+    ]
+    if with_segments:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, sq), lambda h, ki, i: (h // hq, 0, 0))
+        )
 
     # dk/dv per *q*-head (kv grads accumulate across the GQA group
     # afterwards — a [B, Hkv, G, Sk, D] sum, trivial next to S^2)
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel,
-            scale=scale, causal=causal, block_q=bq, block_k=bk,
-            num_q_blocks=pl.cdiv(sq, bq),
-        ),
+        dkv_wrapper,
         grid=(b * hq, pl.cdiv(sk, bk), pl.cdiv(sq, bq)),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, ki, i: (h // groups, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda h, ki, i: (h, i, 0)),
-            row_spec,
-            row_spec,
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda h, ki, i: (h, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda h, ki, i: (h, ki, 0)),
@@ -458,7 +532,7 @@ def _flash_backward(
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, dd)
+    )(*operands)
 
     dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
     # sum kv grads over the query-head group
@@ -474,25 +548,36 @@ def _flash_backward(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-
-
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret, with_residuals=True
+def _flash(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        segment_ids=segment_ids,
     )
-    return out, (q, k, v, out, lse)
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        with_residuals=True, segment_ids=segment_ids,
+    )
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_backward(
+    q, k, v, segment_ids, out, lse = res
+    dq, dk, dv = _flash_backward(
         q, k, v, compute_dd(out, g), lse, g, causal, scale, block_q, block_k,
-        interpret
+        interpret, segment_ids=segment_ids,
     )
+    # integer segment ids carry no gradient: float0 cotangent
+    dseg = None
+    if segment_ids is not None:
+        import numpy as np
+
+        dseg = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -509,17 +594,32 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention, [B, S, H, D] layout, GQA-aware.
 
     ``use_pallas=None`` auto-selects: the pallas kernel on TPU back-
     ends, the XLA path elsewhere (tests run it with ``interpret=True``
     to validate the kernel itself on CPU).
+
+    ``segment_ids`` ([B, S] int) masks attention across segment
+    boundaries: token j is visible to token i iff their ids match
+    (composed with causal). Covers both padding (mask 1=real, 0=pad)
+    and packed sequences. Outputs at padding/query rows with no
+    visible keys are garbage — mask them out of the loss.
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if segment_ids is not None and sq != sk:
+        # one shared [B, S] row serves both sides of the mask — with
+        # sq != sk the kernel's key slice would clamp and mask
+        # arbitrarily, silently
+        raise ValueError(
+            f"segment_ids requires self-attention lengths, got sq={sq} "
+            f"sk={sk}"
+        )
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # Mosaic tiling constraints: last dim must be lane-aligned (128) and
     # seq lens must fill whole blocks (a partial KV block would feed
@@ -548,12 +648,12 @@ def flash_attention(
                 f"sk={sk} bk={bk} causal={causal} (need whole blocks and "
                 "sq == sk for causal)"
             )
-        return _flash(q, k, v, causal, scale, bq, bk, interpret)
+        return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
     if use_pallas is None:
         platform = jax.devices()[0].platform
         use_pallas = platform == "tpu" and shapes_ok
     elif use_pallas and not shapes_ok:
         use_pallas = False  # unsupported tiling → XLA path
     if not use_pallas:
-        return mha_reference(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, bq, bk, interpret)
+        return mha_reference(q, k, v, causal, scale, segment_ids=segment_ids)
+    return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
