@@ -38,7 +38,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="llama-bench")
     p.add_argument("--batch-per-chip", type=int, default=4)
     p.add_argument("--remat-policy", default="nothing_saveable",
-                   choices=["nothing_saveable", "dots"])
+                   choices=["nothing_saveable", "dots", "flash"])
     p.add_argument("--no-remat", action="store_true")
     args = p.parse_args(argv)
 

@@ -80,8 +80,17 @@ def _remat_policy(name: str):
         return jax.checkpoint_policies.nothing_saveable
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "flash":
+        # save the flash kernel's residuals (output + logsumexp, named
+        # in its fwd rule) so the backward never re-runs the attention
+        # forward; projections/norms/MLP still remat. ~50 MB/layer at
+        # the bench config vs. the S^2-free attention recompute it buys.
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
     raise ValueError(
-        f"unknown remat_policy {name!r}; expected 'nothing_saveable' or 'dots'"
+        f"unknown remat_policy {name!r}; expected 'nothing_saveable', "
+        "'dots', or 'flash'"
     )
 
 

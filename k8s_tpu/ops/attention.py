@@ -562,7 +562,14 @@ def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret)
         q, k, v, causal, scale, block_q, block_k, interpret,
         with_residuals=True, segment_ids=segment_ids,
     )
-    return out, (q, k, v, segment_ids, out, lse)
+    # named so remat policies can pin them: save_only_these_names(
+    # "flash_out", "flash_lse") keeps the backward from re-running this
+    # kernel while everything else (projections, norms, MLP) remats
+    from jax.ad_checkpoint import checkpoint_name
+
+    out_r = checkpoint_name(out, "flash_out")
+    lse_r = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, segment_ids, out_r, lse_r)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):

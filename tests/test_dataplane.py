@@ -397,7 +397,7 @@ class TestModels:
         import pytest
 
         ids = jnp.zeros((1, 16), jnp.int32)
-        for policy in ("nothing_saveable", "dots"):
+        for policy in ("nothing_saveable", "dots", "flash"):
             cfg = LlamaConfig.tiny(remat=True, remat_policy=policy)
             model = LlamaForCausalLM(cfg)
             v = nn.unbox(model.init(jax.random.PRNGKey(0), ids))
