@@ -13,4 +13,8 @@ native), shapes static, layers scanned where depth warrants it.
 from k8s_tpu.models.mnist import MnistCNN  # noqa: F401
 from k8s_tpu.models.resnet import ResNet, ResNet50  # noqa: F401
 from k8s_tpu.models.bert import BertConfig, BertForPretraining  # noqa: F401
-from k8s_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: F401
+from k8s_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    generate,
+)

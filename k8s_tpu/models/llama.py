@@ -17,6 +17,7 @@ swaps (k8s_tpu.parallel.sharding.LogicalRules), not model edits.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional, Tuple
 
 import flax.linen as nn
@@ -59,6 +60,10 @@ class LlamaConfig:
     # expert MLP sharded over the `expert` mesh axis
     num_experts: int = 0
     expert_capacity_factor: float = 2.0
+    # autoregressive decoding: attention reads/writes a static
+    # [B, max_seq_len] KV cache ("cache" collection) instead of running
+    # the training kernels; see :func:`generate`
+    decode: bool = False
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -119,6 +124,33 @@ def _dense(features, axes, name, dtype):
     )
 
 
+def _cached_attention(q, k_all, v_all, mask, scale):
+    """Decode-mode attention against the full static cache.
+
+    q [B, s, Hq, D] (s = prefill chunk or 1), k/v [B, max_seq, Hkv, D],
+    mask [B, s, max_seq] bool (True = visible). Bandwidth-bound einsum
+    — the right shape for single-token decode, where a flash kernel
+    has nothing to block."""
+    b, s, hq, d = q.shape
+    _, smax, hkv, _ = k_all.shape
+    groups = hq // hkv
+    # k/v stay in cache dtype (bf16): casting the full [B, max_seq]
+    # cache to f32 would double the HBM traffic of a bandwidth-bound
+    # op — preferred_element_type gives f32 accumulation without copies
+    qf = (q.astype(jnp.float32) * scale).reshape(b, s, hkv, groups, d)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qf.astype(q.dtype), k_all,
+        preferred_element_type=jnp.float32,
+    )
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(q.dtype), v_all,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -135,7 +167,43 @@ class LlamaAttention(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "length", "kv_heads", "head_dim"))
         v = nn.with_logical_constraint(v, ("batch", "length", "kv_heads", "head_dim"))
-        if cfg.attention == "ring":
+        if cfg.decode:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "packed segments are not supported in decode mode"
+                )
+            # static-shape KV cache: prefill writes s entries at the
+            # current index, decode appends one per step; attention
+            # always spans the full cache with a visibility mask
+            ck = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (b, cfg.max_seq_len, kv, d), cfg.dtype,
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (b, cfg.max_seq_len, kv, d), cfg.dtype,
+            )
+            idx = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(ck.value.dtype), (0, cur, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cv.value.dtype), (0, cur, 0, 0)
+            )
+            idx.value = cur + s
+            q_pos = cur + jnp.arange(s)  # global positions of this chunk
+            k_pos = jnp.arange(cfg.max_seq_len)
+            mask = jnp.broadcast_to(
+                k_pos[None, None, :] <= q_pos[None, :, None],
+                (b, s, cfg.max_seq_len),
+            )
+            out = _cached_attention(
+                q, ck.value, cv.value, mask, 1.0 / math.sqrt(d)
+            )
+        elif cfg.attention == "ring":
             from k8s_tpu.parallel.ring_attention import ring_attention
 
             if segment_ids is not None:
@@ -235,11 +303,16 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(
+        self, input_ids, positions=None, segment_ids=None,
+        last_logit_only=False,
+    ):
         """input_ids [B, S] int32. For packed pretraining pass
         ``segment_ids`` ([B, S]: which document each token belongs to;
         attention is masked across documents) and ``positions``
-        (restarting at 0 per document so RoPE sees local offsets)."""
+        (restarting at 0 per document so RoPE sees local offsets).
+        ``last_logit_only`` computes the lm_head for the final position
+        only — prefill wants [B, 1, V], not [B, plen, V]."""
         cfg = self.config
         b, s = input_ids.shape
         if positions is None:
@@ -265,7 +338,7 @@ class LlamaForCausalLM(nn.Module):
                 )
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,
@@ -282,6 +355,8 @@ class LlamaForCausalLM(nn.Module):
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        if last_logit_only:
+            x = x[:, -1:]
         logits = nn.DenseGeneral(
             features=cfg.vocab_size,
             use_bias=False,
@@ -293,3 +368,84 @@ class LlamaForCausalLM(nn.Module):
             name="lm_head",
         )(x)
         return logits
+
+
+def generate(
+    model: LlamaForCausalLM,
+    params,
+    prompt_ids: jax.Array,  # [B, prompt_len] int32
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation with a static KV cache.
+
+    ``model.config.decode`` must be True. Prefill runs the whole prompt
+    in one jitted forward (lm_head on the final position only, writing
+    the cache), then one token decodes per step under a jitted
+    ``lax.scan`` — fixed shapes throughout, two compilations total.
+    temperature 0 = greedy, else softmax sampling.
+    Returns [B, max_new_tokens].
+    """
+    cfg = model.config
+    if not cfg.decode:
+        raise ValueError("generate() needs LlamaConfig(decode=True)")
+    b, plen = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    if plen + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {plen} + new {max_new_tokens} exceeds cache "
+            f"size {cfg.max_seq_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    rng, prefill_rng = jax.random.split(rng)
+
+    def pick(logits_last, r):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            r, logits_last / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # params/cache go through jit as ARGUMENTS: a jitted closure over
+    # concrete weight arrays embeds them as HLO constants, which makes
+    # compilation (especially remote-compiled) pathologically slow
+    @jax.jit
+    def prefill(params, prompt_ids, r):
+        positions = jnp.broadcast_to(jnp.arange(plen), (b, plen))
+        logits, mut = model.apply(
+            {"params": params}, prompt_ids, positions=positions,
+            last_logit_only=True, mutable=["cache"],
+        )
+        return mut["cache"], pick(logits[:, -1], r)
+
+    cache, tok = prefill(params, prompt_ids, prefill_rng)
+
+    if max_new_tokens == 1:
+        return tok[:, None]
+
+    @jax.jit
+    def decode_loop(params, cache, tok, r):
+        def step(carry, _):
+            cache, tok, pos, r = carry
+            r, r_step = jax.random.split(r)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                positions=jnp.full((b, 1), pos, jnp.int32),
+                mutable=["cache"],
+            )
+            nxt = pick(logits[:, -1], r_step)
+            return (mut["cache"], nxt, pos + 1, r), tok
+
+        return jax.lax.scan(
+            step, (cache, tok, jnp.int32(plen), r), None,
+            length=max_new_tokens - 1,
+        )
+
+    (_, last, _, _), toks = decode_loop(params, cache, tok, rng)
+    # toks holds the inputs of each step (tokens 0..n-2); append the last
+    out = jnp.concatenate([toks, last[None]], axis=0)  # [new, B]
+    return out.transpose(1, 0)
