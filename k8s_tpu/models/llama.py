@@ -206,21 +206,15 @@ class LlamaAttention(nn.Module):
         elif cfg.attention == "ring":
             from k8s_tpu.parallel.ring_attention import ring_attention
 
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "packed segments are not yet threaded through the "
-                    "ring-attention body"
-                )
-            out = ring_attention(q, k, v, cfg.mesh, causal=True)
+            out = ring_attention(
+                q, k, v, cfg.mesh, causal=True, segment_ids=segment_ids
+            )
         elif cfg.attention == "ulysses":
             from k8s_tpu.parallel.ulysses import ulysses_attention
 
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "packed segments are not yet threaded through the "
-                    "ulysses-attention body"
-                )
-            out = ulysses_attention(q, k, v, cfg.mesh, causal=True)
+            out = ulysses_attention(
+                q, k, v, cfg.mesh, causal=True, segment_ids=segment_ids
+            )
         else:
             out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
         out = nn.DenseGeneral(

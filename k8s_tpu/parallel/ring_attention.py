@@ -58,12 +58,15 @@ def ring_attention_sharded(
     q: jax.Array,  # local [B, Sq_local, Hq, D]
     k: jax.Array,  # local [B, Sk_local, Hkv, D]
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,  # local [B, Sq_local]
     axis_name: str = "seq",
     causal: bool = True,
     scale: Optional[float] = None,
 ):
     """Per-device body — call inside ``shard_map`` (or use
-    :func:`ring_attention` for the wrapped form)."""
+    :func:`ring_attention` for the wrapped form). ``segment_ids``
+    chunks rotate around the ring alongside their KV chunk, masking
+    cross-document attention exactly as the flash kernel does."""
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     groups = hq // hkv
@@ -73,9 +76,10 @@ def ring_attention_sharded(
 
     qf = (q.astype(jnp.float32) * scale).reshape(b, sq, hkv, groups, d)
     q_pos = my * sq + jnp.arange(sq)  # global query positions
+    seg_q = segment_ids
 
     def step_fn(carry, step):
-        m, l, acc, k_cur, v_cur = carry
+        m, l, acc, k_cur, v_cur, seg_cur = carry
         src = (my - step) % n  # who this KV chunk belongs to
         s = jnp.einsum(
             "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32),
@@ -85,6 +89,9 @@ def ring_attention_sharded(
             k_pos = src * sk + jnp.arange(sk)
             mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
             s = jnp.where(mask[None, None, None], s, NEG_INF)
+        if seg_cur is not None:
+            visible = seg_q[:, :, None] == seg_cur[:, None, :]  # [B,Sq,Sk]
+            s = jnp.where(visible[:, None, None], s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)  # [B,Hkv,G,Sq]
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
@@ -95,17 +102,21 @@ def ring_attention_sharded(
             preferred_element_type=jnp.float32,
         )
         acc_new = acc * corr[..., None] + pv
-        # rotate KV to the next neighbor (ring over ICI)
+        # rotate KV (and its segment ids) to the next neighbor (ICI ring)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, acc_new, k_next, v_next), None
+        seg_next = (
+            jax.lax.ppermute(seg_cur, axis_name, perm)
+            if seg_cur is not None else None
+        )
+        return (m_new, l_new, acc_new, k_next, v_next, seg_next), None
 
     m0 = jnp.full((b, hkv, groups, sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hkv, groups, sq), jnp.float32)
     acc0 = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        step_fn, (m0, l0, acc0, k, v), jnp.arange(n)
+    (m, l, acc, _, _, _), _ = jax.lax.scan(
+        step_fn, (m0, l0, acc0, k, v, segment_ids), jnp.arange(n)
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B,Hkv,G,Sq,D]
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, d)
@@ -288,20 +299,31 @@ def seq_parallel_call(
     axis_name: str = "seq",
     batch_axes=("data", "fsdp"),
     head_axis: str = "tensor",
+    segment_ids: Optional[jax.Array] = None,  # global [B, S]
 ):
     """Shared shard_map wrapper for sequence-parallel attention bodies
     (ring and Ulysses): q/k/v and the output are laid out
-    ``[batch@data/fsdp, length@seq, heads@tensor, head_dim]``."""
+    ``[batch@data/fsdp, length@seq, heads@tensor, head_dim]``. With
+    ``segment_ids`` the body takes them as a 4th arg, sharded
+    ``[batch@data/fsdp, length@seq]``; returns the ready-to-call
+    closure over (q, k, v)."""
     from jax import shard_map
 
     spec = P(batch_axes, axis_name, head_axis, None)
-    return shard_map(
+    seg_spec = P(batch_axes, axis_name)
+    with_segments = segment_ids is not None
+    in_specs = (spec, spec, spec) + ((seg_spec,) if with_segments else ())
+    wrapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
+    if with_segments:
+        seg = segment_ids.astype(jnp.int32)
+        return lambda q, k, v: wrapped(q, k, v, seg)
+    return wrapped
 
 
 def ring_attention(
@@ -316,12 +338,16 @@ def ring_attention(
     head_axis: str = "tensor",
     impl: Optional[str] = None,  # "flash" | "xla" | None = auto
     interpret: bool = False,
+    segment_ids: Optional[jax.Array] = None,  # global [B, S]
 ):
     """Global-array form: shards length over ``seq``, batch over
     data/fsdp, heads over tensor, and runs the ring body.
 
     ``impl=None`` auto-selects the pallas-flash body on TPU when the
     local chunk is lane-aligned, the XLA einsum body otherwise.
+    ``segment_ids`` (packed/padded batches) run the XLA body — the
+    flash body's kernels share one segment row per device and cannot
+    mask against a rotated remote chunk.
     """
     if impl is None:
         d = q.shape[-1]
@@ -329,12 +355,17 @@ def ring_attention(
         local = q.shape[1] // max(n, 1)
         flash_ok = (
             q.shape[1] == k.shape[1] and d % 128 == 0 and local % 128 == 0
+            and segment_ids is None
         )
         # the mesh's devices decide, not the default backend — they can
         # differ (e.g. a CPU mesh on a TPU-backed host in dryruns)
         on_tpu = mesh.devices.flat[0].platform == "tpu"
         impl = "flash" if (flash_ok and (on_tpu or interpret)) else "xla"
     if impl == "flash":
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "segment_ids needs impl='xla' for ring attention"
+            )
         body = partial(
             ring_flash_attention_sharded, axis_name=axis_name, causal=causal,
             scale=scale, interpret=interpret,
@@ -348,5 +379,5 @@ def ring_attention(
         raise ValueError(f"unknown ring attention impl {impl!r}")
     return seq_parallel_call(
         body, mesh, axis_name=axis_name, batch_axes=batch_axes,
-        head_axis=head_axis,
+        head_axis=head_axis, segment_ids=segment_ids,
     )(q, k, v)

@@ -40,13 +40,17 @@ def ulysses_attention_sharded(
     q: jax.Array,  # local [B, Sq/n, Hq, D]
     k: jax.Array,  # local [B, Sk/n, Hkv, D]
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,  # local [B, Sq/n]
     axis_name: str = "seq",
     causal: bool = True,
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
 ):
     """Per-device body — call inside ``shard_map`` (or use
-    :func:`ulysses_attention` for the wrapped form)."""
+    :func:`ulysses_attention` for the wrapped form). After the
+    all-to-all each device holds the FULL sequence for its head
+    subset, so packed/padded masking just needs the full segment row:
+    one cheap int all-gather."""
     n = jax.lax.axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if hq % n or hkv % n:
@@ -59,8 +63,14 @@ def ulysses_attention_sharded(
         tiled=True,
     )
     qh, kh, vh = a2a(q), a2a(k), a2a(v)  # [B, S, H/n, D]
+    seg_full = None
+    if segment_ids is not None:
+        seg_full = jax.lax.all_gather(
+            segment_ids, axis_name, axis=1, tiled=True
+        )  # [B, S]
     out = flash_attention(
-        qh, kh, vh, causal=causal, scale=scale, use_pallas=use_pallas
+        qh, kh, vh, causal=causal, scale=scale, use_pallas=use_pallas,
+        segment_ids=seg_full,
     )
     # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2)
     return jax.lax.all_to_all(
@@ -79,6 +89,7 @@ def ulysses_attention(
     batch_axes=("data", "fsdp"),
     head_axis: str = "tensor",
     use_pallas: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,  # global [B, S]
 ):
     """Global-array form mirroring :func:`ring_attention`: length over
     ``seq``, batch over data/fsdp, heads over tensor."""
@@ -93,5 +104,5 @@ def ulysses_attention(
     )
     return seq_parallel_call(
         body, mesh, axis_name=axis_name, batch_axes=batch_axes,
-        head_axis=head_axis,
+        head_axis=head_axis, segment_ids=segment_ids,
     )(q, k, v)

@@ -214,6 +214,33 @@ class TestRingAttention:
         )(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_xla_impl_segment_packing(self, causal):
+        """Ring attention with packed segments (ids rotate with their
+        KV chunk): forward AND gradients match the full-sequence
+        reference (llama packed training differentiates this path).
+        Boundary at 200 splits mid-device (4 devices x 128 local)."""
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 512, 4, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 512, 2, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 512, 2, 32))
+        w = jax.random.normal(jax.random.PRNGKey(3), (2, 512, 4, 32))
+        seg = jnp.where(jnp.arange(512) < 200, 1, 2).astype(jnp.int32)[None].repeat(2, 0)
+        ref = mha_reference(q, k, v, causal=causal, segment_ids=seg)
+        out = jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, mesh, causal=causal, impl="xla", segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+        g1 = jax.jit(jax.grad(lambda q: (ring_attention(
+            q, k, v, mesh, causal=causal, impl="xla", segment_ids=seg
+        ) * w).sum()))(q)
+        g2 = jax.grad(lambda q: (mha_reference(
+            q, k, v, causal=causal, segment_ids=seg
+        ) * w).sum())(q)
+        np.testing.assert_allclose(g1, g2, atol=1e-4)
+
     def test_flash_impl_bf16_partials_stay_f32(self):
         """bf16 inputs: per-step partials must not be quantized before
         the merge — the ring result should match the reference at the
@@ -257,6 +284,31 @@ class TestRingAttention:
 
 
 class TestUlyssesAttention:
+    def test_segment_packing_fwd_and_grads(self):
+        """Ulysses with packed segments (one int all-gather restores
+        the full row after the all-to-all): forward and gradients match
+        the reference."""
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 512, 8, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 512, 4, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 512, 4, 32))
+        w = jax.random.normal(jax.random.PRNGKey(3), (2, 512, 8, 32))
+        seg = jnp.where(jnp.arange(512) < 200, 1, 2).astype(jnp.int32)[None].repeat(2, 0)
+        ref = mha_reference(q, k, v, causal=True, segment_ids=seg)
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, mesh, causal=True, segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+        g1 = jax.jit(jax.grad(lambda q: (ulysses_attention(
+            q, k, v, mesh, causal=True, segment_ids=seg
+        ) * w).sum()))(q)
+        g2 = jax.grad(lambda q: (mha_reference(
+            q, k, v, causal=True, segment_ids=seg
+        ) * w).sum())(q)
+        np.testing.assert_allclose(g1, g2, atol=1e-4)
+
     def test_matches_reference(self):
         mesh = build_mesh(MeshConfig(data=2, seq=4))
         q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 8, 32))
