@@ -332,7 +332,7 @@ class LlamaForCausalLM(nn.Module):
                 )
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,

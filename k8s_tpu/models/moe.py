@@ -28,6 +28,8 @@ class MoeConfig:
     intermediate_size: int = 256
     dtype: jnp.dtype = jnp.bfloat16
     router_aux_loss_weight: float = 0.01
+    # z-loss on the router logits (stabilizes their scale, ST-MoE §2.2)
+    router_z_loss_weight: float = 1e-3
 
 
 class MoeMlp(nn.Module):
@@ -117,4 +119,9 @@ class MoeMlp(nn.Module):
         ce = onehot[:, 0, :].astype(jnp.float32).mean(axis=0)  # top-1 fraction
         aux_loss = cfg.router_aux_loss_weight * e * jnp.sum(me * ce)
         self.sow("intermediates", "router_aux_loss", aux_loss)
+        # router z-loss: keeps logit magnitudes bounded so the f32
+        # softmax stays well-conditioned at scale
+        logz = jax.nn.logsumexp(router_logits, axis=-1)
+        z_loss = cfg.router_z_loss_weight * jnp.mean(jnp.square(logz))
+        self.sow("intermediates", "router_z_loss", z_loss)
         return out

@@ -16,7 +16,12 @@ from k8s_tpu.data import synthetic_token_batches
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import MetricLogger, parse_run_config
-from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
+from k8s_tpu.train import (
+    create_sharded_state,
+    cross_entropy_loss,
+    make_train_step,
+    sum_sown_losses,
+)
 
 STRATEGIES = {
     "dp": "DP",
@@ -84,9 +89,18 @@ def main(rdzv) -> None:
             state = restored
 
     def loss_fn(state, params, b, rng):
-        logits = state.apply_fn({"params": params}, b["input_ids"])
+        # mutable intermediates: MoE layers sow their router
+        # load-balancing loss there — without adding it to the training
+        # loss the router collapses onto a few experts
+        logits, mut = state.apply_fn(
+            {"params": params}, b["input_ids"], mutable=["intermediates"]
+        )
         labels = jnp.roll(b["input_ids"], -1, axis=1)
-        return cross_entropy_loss(logits[:, :-1], labels[:, :-1], z_loss=1e-4), {}
+        ce = cross_entropy_loss(logits[:, :-1], labels[:, :-1], z_loss=1e-4)
+        aux = sum_sown_losses(mut.get("intermediates", {}))
+        # combined total of every sown router loss (load-balancing +
+        # z-loss) — named accordingly so it isn't misread as one of them
+        return ce + aux, {"router_losses": aux}
 
     step_fn = make_train_step(loss_fn, mesh, rules)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")

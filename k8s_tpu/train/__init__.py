@@ -10,4 +10,5 @@ from k8s_tpu.train.trainer_lib import (  # noqa: F401
     make_eval_step,
     make_train_step,
     shardings_from_logical,
+    sum_sown_losses,
 )

@@ -147,6 +147,30 @@ def cross_entropy_loss(
     return jnp.mean(losses)
 
 
+def sum_sown_losses(intermediates) -> jax.Array:
+    """Total of every ``*_loss`` value sown into the ``intermediates``
+    collection (e.g. the MoE router load-balancing loss, stacked across
+    scanned layers). Zero when nothing was sown — safe to add to any
+    training loss unconditionally."""
+    total = jnp.zeros((), jnp.float32)
+    if not intermediates:
+        return total
+
+    def visit(node, key=""):
+        nonlocal total
+        if isinstance(node, dict):
+            for k, v in node.items():
+                visit(v, k)
+        elif key.endswith("_loss"):
+            # sown values arrive as tuples of arrays; scanned layers
+            # stack along axis 0 — sum everything
+            for leaf in jax.tree_util.tree_leaves(node):
+                total = total + jnp.sum(leaf.astype(jnp.float32))
+
+    visit(intermediates)
+    return total
+
+
 # ---------------------------------------------------------------------------
 # Train step
 # ---------------------------------------------------------------------------
