@@ -15,6 +15,9 @@ from typing import Dict, Optional
 class RunConfig:
     steps: int = 100
     batch_size: int = 64
+    # gradient-accumulation microbatches per optimizer step (batch_size
+    # must divide evenly); the lever when global batch exceeds HBM
+    accum_steps: int = 1
     log_every: int = 10
     checkpoint_dir: str = ""
     checkpoint_every: int = 0

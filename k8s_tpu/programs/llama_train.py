@@ -102,7 +102,7 @@ def main(rdzv) -> None:
         # z-loss) — named accordingly so it isn't misread as one of them
         return ce + aux, {"router_losses": aux}
 
-    step_fn = make_train_step(loss_fn, mesh, rules)
+    step_fn = make_train_step(loss_fn, mesh, rules, accum_steps=cfg.accum_steps)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)
     start = int(state.step)

@@ -203,6 +203,7 @@ def make_train_step(
     mesh: Mesh,
     rules: LogicalRules,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> TrainStepFn:
     """Build the jitted SPMD train step.
 
@@ -212,14 +213,73 @@ def make_train_step(
     in-model ``with_logical_constraint`` resolve against this mesh.
     Batches are placed by :func:`make_batch_sharder` before the call,
     so jit adopts their data-parallel layout.
+
+    ``accum_steps > 1`` accumulates gradients over that many
+    microbatches (batch dim 0 must divide evenly): one optimizer update
+    per call on the averaged gradients — the standard lever when the
+    wanted global batch exceeds HBM. Peak memory is one microbatch's
+    activations plus one extra gradient buffer; equal-sized microbatches
+    keep the averaged gradient identical to the full-batch one for
+    mean-reduced losses.
     """
     shard_batch = make_batch_sharder(mesh, rules)
 
-    def step(state: TrainState, batch, rng):
+    def grad_of(state, batch, rng):
         def compute(params):
             return loss_fn(state, params, batch, rng)
 
-        (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(state.params)
+        return jax.value_and_grad(compute, has_aux=True)(state.params)
+
+    def step(state: TrainState, batch, rng):
+        if accum_steps == 1:
+            (loss, aux), grads = grad_of(state, batch, rng)
+        else:
+            def split(x):
+                if getattr(x, "ndim", 0) < 1:
+                    # scalar leaves (e.g. a loss scale) ride every
+                    # microbatch — scan xs need a leading axis
+                    return jnp.broadcast_to(x, (accum_steps,))
+                if x.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"accum_steps {accum_steps}"
+                    )
+                return x.reshape(
+                    accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
+                )
+
+            micro = jax.tree_util.tree_map(split, batch)
+            # first microbatch outside the scan: its grads seed the f32
+            # accumulator and its aux gives the carry its structure (so
+            # aux is carried, not stacked — no accum_steps-fold copies)
+            first = jax.tree_util.tree_map(lambda x: x[0], micro)
+            (l0, aux0), g_first = grad_of(
+                state, first, jax.random.fold_in(rng, 0)
+            )
+            g0 = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), g_first
+            )
+
+            def body(carry, mb):
+                g_acc, l_acc, i, _ = carry
+                (l, aux_i), g = grad_of(
+                    state, mb, jax.random.fold_in(rng, i)
+                )
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, l_acc + l, i + 1, aux_i), None
+
+            rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+            (g_sum, l_sum, _, aux), _ = jax.lax.scan(
+                body, (g0, l0.astype(jnp.float32), 1, aux0), rest
+            )
+            # cast back to the per-leaf gradient dtype (g_sum is the f32
+            # accumulator; the accum_steps=1 path yields param-dtype
+            # grads and the optimizer state must not drift between them)
+            grads = jax.tree_util.tree_map(
+                lambda g, gf: (g / accum_steps).astype(gf.dtype),
+                g_sum, g_first,
+            )
+            loss = l_sum / accum_steps
         new_state = state.apply_gradients(grads=grads)
         if aux and "batch_stats" in aux:
             new_state = new_state.replace(batch_stats=aux.pop("batch_stats"))

@@ -633,6 +633,39 @@ class TestShardedTraining:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
 
+    def test_grad_accumulation_matches_full_batch(self):
+        """accum_steps=4 microbatching produces the same update as one
+        full-batch step (mean-reduced loss, equal microbatch sizes)."""
+        mesh = build_mesh(MeshConfig(data=8))
+        rules = LogicalRules(LogicalRules.DP)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        make_state = lambda: create_sharded_state(
+            model, optax.sgd(1e-2), mesh, rules,
+            jax.random.PRNGKey(0), jnp.zeros((8, 32), jnp.int32),
+        )
+        ids = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        batch = {"input_ids": ids}
+
+        s_full = make_state()
+        step_full = make_train_step(_lm_loss, mesh, rules, donate=False)
+        s_full, m_full = step_full(s_full, batch, jax.random.PRNGKey(2))
+
+        s_acc = make_state()
+        step_acc = make_train_step(
+            _lm_loss, mesh, rules, donate=False, accum_steps=4
+        )
+        s_acc, m_acc = step_acc(s_acc, batch, jax.random.PRNGKey(2))
+
+        np.testing.assert_allclose(
+            float(m_full["loss"]), float(m_acc["loss"]), atol=1e-5
+        )
+        for pf, pa in zip(
+            jax.tree_util.tree_leaves(s_full.params),
+            jax.tree_util.tree_leaves(s_acc.params),
+        ):
+            np.testing.assert_allclose(pf, pa, atol=1e-5)
+
     def test_fsdp_shards_params_and_opt_state(self):
         mesh = build_mesh(MeshConfig(data=2, fsdp=4))
         rules = LogicalRules(LogicalRules.FSDP)
