@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.train import (
     create_sharded_state,
@@ -40,6 +41,8 @@ def main(argv=None) -> int:
     p.add_argument("--remat-policy", default="nothing_saveable",
                    choices=["nothing_saveable", "dots", "flash"])
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--no-fused-ce", action="store_true",
+                   help="materialize full [B,S,V] logits in the loss")
     args = p.parse_args(argv)
 
     n = len(jax.devices())
@@ -68,9 +71,18 @@ def main(argv=None) -> int:
     )
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
 
-    def loss_fn(state, params, b, rng):
-        logits = state.apply_fn({"params": params}, b["ids"])
-        return cross_entropy_loss(logits[:, :-1], b["ids"][:, 1:]), {}
+    if args.no_fused_ce:
+        def loss_fn(state, params, b, rng):
+            logits = state.apply_fn({"params": params}, b["ids"])
+            return cross_entropy_loss(logits[:, :-1], b["ids"][:, 1:]), {}
+    else:
+        def loss_fn(state, params, b, rng):
+            hidden = state.apply_fn(
+                {"params": params}, b["ids"], return_hidden=True
+            )
+            return fused_lm_head_cross_entropy(
+                hidden[:, :-1], params["lm_head"]["kernel"], b["ids"][:, 1:]
+            ), {}
 
     step = make_train_step(loss_fn, mesh, rules)
     rng = jax.random.PRNGKey(1)

@@ -299,14 +299,19 @@ class LlamaForCausalLM(nn.Module):
     @nn.compact
     def __call__(
         self, input_ids, positions=None, segment_ids=None,
-        last_logit_only=False,
+        last_logit_only=False, return_hidden=False,
     ):
         """input_ids [B, S] int32. For packed pretraining pass
         ``segment_ids`` ([B, S]: which document each token belongs to;
         attention is masked across documents) and ``positions``
         (restarting at 0 per document so RoPE sees local offsets).
         ``last_logit_only`` computes the lm_head for the final position
-        only — prefill wants [B, 1, V], not [B, plen, V]."""
+        only — prefill wants [B, 1, V], not [B, plen, V].
+        ``return_hidden`` skips the lm_head and returns the final-norm
+        hidden states [B, S, E] — the input contract of
+        :func:`k8s_tpu.ops.fused_ce.fused_lm_head_cross_entropy`, which
+        fuses the head matmul into the loss so the [B, S, V] logits are
+        never materialized (load-bearing at 128k vocab)."""
         cfg = self.config
         b, s = input_ids.shape
         if positions is None:
@@ -349,6 +354,8 @@ class LlamaForCausalLM(nn.Module):
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        if return_hidden:
+            return x
         if last_logit_only:
             x = x[:, -1:]
         logits = nn.DenseGeneral(

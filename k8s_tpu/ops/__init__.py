@@ -7,4 +7,5 @@ blocks the model zoo uses.
 """
 
 from k8s_tpu.ops.attention import flash_attention, mha_reference  # noqa: F401
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy  # noqa: F401
 from k8s_tpu.ops.norms import rms_norm  # noqa: F401

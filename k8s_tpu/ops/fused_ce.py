@@ -1,0 +1,106 @@
+"""Fused LM-head cross-entropy: head matmul + softmax-CE without the
+``[B, S, V]`` logits tensor.
+
+The reference has no compute path at all (training ran in user
+containers, SURVEY §0); this op exists for the flagship LLM configs the
+TPU framework adds (BASELINE.json #4/#5). At Llama-3-8B scale
+(vocab 128 256) the materialized f32 logits for one 8×2048 batch are
+~8.4 GB — more than half a v5e chip's HBM — and the unfused loss pays
+that twice more in the backward (dlogits write + read). Streaming the
+head over vocab chunks keeps the live footprint at one ``[B, S, V/C]``
+block while the MXU still sees large matmuls.
+
+Mechanics: the vocab dimension is split into C chunks; a
+``lax.scan`` computes per-chunk ``logsumexp`` and the label logit
+(tokens whose label falls in the chunk), which combine exactly via
+``logsumexp`` over the chunk axis. The chunk body is
+``jax.checkpoint``-ed, so the backward re-runs each chunk's matmul
+instead of saving its logits: the classic remat trade — one extra
+head-matmul of FLOPs buys O(V) → O(V/C) loss memory. Gradients for
+``hidden`` and ``kernel`` come out of plain autodiff through the scan
+(chunk cotangents accumulate across iterations).
+
+The matmul runs in the activations' dtype (bf16 on TPU) with f32
+accumulation via ``preferred_element_type`` — same MXU path the rest
+of the model uses — and all softmax math is f32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_num_chunks(vocab: int, target_chunk: int) -> int:
+    """Chunk count so each chunk is <= target entries. Indivisible
+    vocabs are handled by padding the last chunk (masked below), so any
+    count works — no silent fall-back to a full-vocab block."""
+    return max(1, -(-vocab // target_chunk))
+
+
+def fused_lm_head_cross_entropy(
+    hidden: jax.Array,  # [B, S, E] final hidden states (pre-lm_head)
+    kernel: jax.Array,  # [E, V] head weights
+    labels: jax.Array,  # [B, S] int32
+    mask: Optional[jax.Array] = None,  # [B, S]; truthy = counted
+    z_loss: float = 0.0,
+    target_chunk: int = 8192,
+) -> jax.Array:
+    """Mean token cross-entropy of ``softmax(hidden @ kernel)`` vs
+    ``labels``, computed without materializing the full logits.
+
+    Matches :func:`k8s_tpu.train.cross_entropy_loss` semantics
+    (masking, z-loss) on the same logits to f32-accumulation accuracy.
+    Differentiable in ``hidden`` and ``kernel``.
+    """
+    e, v = kernel.shape
+    num_chunks = _pick_num_chunks(v, target_chunk)
+    vc = -(-v // num_chunks)  # chunk size, last chunk possibly padded
+    cdt = hidden.dtype
+
+    pad = num_chunks * vc - v
+    if pad:
+        # zero columns appended to the last chunk; masked to -inf below
+        # so they never enter the logsumexp (a zero *logit* would not
+        # be neutral) and can never be a label
+        kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+    # [E, C*Vc] -> [C, E, Vc]: one transposed copy outside the scan; its
+    # gradient is the inverse reshape of the stacked per-chunk dW.
+    w_chunks = kernel.reshape(e, num_chunks, vc).transpose(1, 0, 2)
+    bases = (jnp.arange(num_chunks) * vc).astype(labels.dtype)
+
+    @jax.checkpoint
+    def chunk_stats(x, w_c, base):
+        logits_c = jax.lax.dot_general(
+            x.astype(cdt),
+            w_c.astype(cdt),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, S, Vc] f32 — the only vocab-sized live buffer
+        if pad:
+            col_valid = base + jnp.arange(vc) < v
+            logits_c = jnp.where(col_valid, logits_c, -jnp.inf)
+        lse_c = jax.nn.logsumexp(logits_c, axis=-1)
+        local = labels - base
+        hit = (local >= 0) & (local < vc)
+        picked = jnp.take_along_axis(
+            logits_c, jnp.clip(local, 0, vc - 1)[..., None], axis=-1
+        )[..., 0]
+        label_logit_c = jnp.where(hit, picked, 0.0)
+        return lse_c, label_logit_c
+
+    def body(_, inp):
+        w_c, base = inp
+        return None, chunk_stats(hidden, w_c, base)
+
+    _, (lses, label_logits) = jax.lax.scan(body, None, (w_chunks, bases))
+    logz = jax.nn.logsumexp(lses, axis=0)  # [B, S]
+    losses = logz - jnp.sum(label_logits, axis=0)
+    if z_loss:
+        losses = losses + z_loss * jnp.square(logz)
+    if mask is not None:
+        maskf = mask.astype(losses.dtype)
+        return jnp.sum(losses * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+    return jnp.mean(losses)

@@ -14,6 +14,7 @@ import optax
 
 from k8s_tpu.data import synthetic_token_batches
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import MetricLogger, parse_run_config
 from k8s_tpu.train import (
@@ -88,15 +89,30 @@ def main(rdzv) -> None:
         if restored is not None:
             state = restored
 
+    # default on: fuses the lm_head matmul into the loss so the
+    # [B, S, V] logits never materialize — required headroom at 128k
+    # vocab, and less HBM traffic at any vocab
+    fused_ce = extra.get("fused_ce", "1") not in ("0", "false")
+
     def loss_fn(state, params, b, rng):
         # mutable intermediates: MoE layers sow their router
         # load-balancing loss there — without adding it to the training
         # loss the router collapses onto a few experts
-        logits, mut = state.apply_fn(
-            {"params": params}, b["input_ids"], mutable=["intermediates"]
-        )
-        labels = jnp.roll(b["input_ids"], -1, axis=1)
-        ce = cross_entropy_loss(logits[:, :-1], labels[:, :-1], z_loss=1e-4)
+        if fused_ce:
+            hidden, mut = state.apply_fn(
+                {"params": params}, b["input_ids"],
+                return_hidden=True, mutable=["intermediates"],
+            )
+            ce = fused_lm_head_cross_entropy(
+                hidden[:, :-1], params["lm_head"]["kernel"],
+                b["input_ids"][:, 1:], z_loss=1e-4,
+            )
+        else:
+            logits, mut = state.apply_fn(
+                {"params": params}, b["input_ids"], mutable=["intermediates"]
+            )
+            labels = jnp.roll(b["input_ids"], -1, axis=1)
+            ce = cross_entropy_loss(logits[:, :-1], labels[:, :-1], z_loss=1e-4)
         aux = sum_sown_losses(mut.get("intermediates", {}))
         # combined total of every sown router loss (load-balancing +
         # z-loss) — named accordingly so it isn't misread as one of them

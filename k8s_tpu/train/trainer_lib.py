@@ -220,7 +220,13 @@ def make_train_step(
     wanted global batch exceeds HBM. Peak memory is one microbatch's
     activations plus one extra gradient buffer; equal-sized microbatches
     keep the averaged gradient identical to the full-batch one for
-    mean-reduced losses.
+    mean-reduced losses. Caveat: a *masked* loss normalizes by its own
+    microbatch's valid-token count, so with very uneven masking across
+    microbatches the equal-weight average over-weights sparse
+    microbatches relative to the full-batch gradient — keep valid
+    counts roughly balanced (e.g. pack sequences) when using
+    ``accum_steps`` with masks. Aux outputs (metrics, ``batch_stats``)
+    are averaged over microbatches.
     """
     shard_batch = make_batch_sharder(mesh, rules)
 
@@ -249,28 +255,38 @@ def make_train_step(
                 )
 
             micro = jax.tree_util.tree_map(split, batch)
-            # first microbatch outside the scan: its grads seed the f32
-            # accumulator and its aux gives the carry its structure (so
-            # aux is carried, not stacked — no accum_steps-fold copies)
+            # first microbatch outside the scan: its grads/aux seed the
+            # f32 accumulators and give the carry its structure (aux is
+            # summed in the carry, not stacked — no accum_steps-fold
+            # copies; the mean over microbatches is taken at the end so
+            # batch_stats/metrics reflect ALL microbatches, not the last)
             first = jax.tree_util.tree_map(lambda x: x[0], micro)
             (l0, aux0), g_first = grad_of(
                 state, first, jax.random.fold_in(rng, 0)
             )
-            g0 = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), g_first
+            to_f32 = lambda t: jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), t
             )
+            g0 = to_f32(g_first)
 
             def body(carry, mb):
-                g_acc, l_acc, i, _ = carry
+                g_acc, l_acc, aux_acc, i = carry
                 (l, aux_i), g = grad_of(
                     state, mb, jax.random.fold_in(rng, i)
                 )
                 g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                return (g_acc, l_acc + l, i + 1, aux_i), None
+                aux_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), aux_acc, aux_i
+                )
+                return (g_acc, l_acc + l, aux_acc, i + 1), None
 
             rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
-            (g_sum, l_sum, _, aux), _ = jax.lax.scan(
-                body, (g0, l0.astype(jnp.float32), 1, aux0), rest
+            (g_sum, l_sum, aux_sum, _), _ = jax.lax.scan(
+                body, (g0, l0.astype(jnp.float32), to_f32(aux0), 1), rest
+            )
+            aux = jax.tree_util.tree_map(
+                lambda s, ref: (s / accum_steps).astype(ref.dtype),
+                aux_sum, aux0,
             )
             # cast back to the per-leaf gradient dtype (g_sum is the f32
             # accumulator; the accum_steps=1 path yields param-dtype

@@ -19,6 +19,7 @@ from k8s_tpu.models import (
     ResNet,
 )
 from k8s_tpu.ops.attention import flash_attention, mha_reference
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.ops.norms import rms_norm
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.parallel.mesh import best_pow2_split
@@ -666,6 +667,35 @@ class TestShardedTraining:
         ):
             np.testing.assert_allclose(pf, pa, atol=1e-5)
 
+    def test_grad_accumulation_averages_aux(self):
+        """aux metrics under accum_steps reflect ALL microbatches (the
+        mean), not just the last one's."""
+        mesh = build_mesh(MeshConfig(data=8))
+        rules = LogicalRules(LogicalRules.DP)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        state = create_sharded_state(
+            model, optax.sgd(1e-2), mesh, rules,
+            jax.random.PRNGKey(0), jnp.zeros((8, 32), jnp.int32),
+        )
+
+        def loss_with_aux(state, params, batch, rng):
+            loss, _ = _lm_loss(state, params, batch, rng)
+            # an aux that differs per microbatch: mean token id
+            return loss, {"mean_id": jnp.mean(
+                batch["input_ids"].astype(jnp.float32))}
+
+        ids = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        batch = {"input_ids": ids}
+        step = make_train_step(
+            loss_with_aux, mesh, rules, donate=False, accum_steps=4
+        )
+        _, m = step(state, batch, jax.random.PRNGKey(2))
+        np.testing.assert_allclose(
+            float(m["mean_id"]), float(jnp.mean(ids.astype(jnp.float32))),
+            rtol=1e-5,
+        )
+
     def test_fsdp_shards_params_and_opt_state(self):
         mesh = build_mesh(MeshConfig(data=2, fsdp=4))
         rules = LogicalRules(LogicalRules.FSDP)
@@ -785,3 +815,99 @@ class TestLosses:
             logits[:2], labels[:2]
         ).mean()
         np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+class TestFusedCE:
+    """fused_lm_head_cross_entropy vs. the materialized-logits loss —
+    same values and gradients without ever forming [B, S, V]."""
+
+    def _setup(self, b=2, s=8, e=16, v=64, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        hidden = jax.random.normal(k1, (b, s, e), dtype)
+        kernel = jax.random.normal(k2, (e, v), jnp.float32) * 0.1
+        labels = jax.random.randint(k3, (b, s), 0, v)
+        return hidden, kernel, labels
+
+    def _reference(self, hidden, kernel, labels, mask=None, z_loss=0.0):
+        logits = (
+            hidden.astype(hidden.dtype) @ kernel.astype(hidden.dtype)
+        ).astype(jnp.float32)
+        return cross_entropy_loss(logits, labels, mask=mask, z_loss=z_loss)
+
+    def test_matches_unfused(self):
+        hidden, kernel, labels = self._setup()
+        got = fused_lm_head_cross_entropy(
+            hidden, kernel, labels, target_chunk=16
+        )
+        ref = self._reference(hidden, kernel, labels)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_masked_and_zloss(self):
+        hidden, kernel, labels = self._setup()
+        mask = jnp.array([[1] * 5 + [0] * 3, [1] * 8])
+        got = fused_lm_head_cross_entropy(
+            hidden, kernel, labels, mask=mask, z_loss=1e-3, target_chunk=16
+        )
+        ref = self._reference(hidden, kernel, labels, mask=mask, z_loss=1e-3)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_gradients_match(self):
+        hidden, kernel, labels = self._setup()
+
+        g_fused = jax.grad(
+            lambda h, w: fused_lm_head_cross_entropy(
+                h, w, labels, target_chunk=16
+            ),
+            argnums=(0, 1),
+        )(hidden, kernel)
+        g_ref = jax.grad(
+            lambda h, w: self._reference(h, w, labels), argnums=(0, 1)
+        )(hidden, kernel)
+        for got, ref in zip(g_fused, g_ref):
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+    def test_single_chunk_fallback(self):
+        # vocab <= target_chunk: degenerates to one chunk, still correct
+        hidden, kernel, labels = self._setup(v=32)
+        got = fused_lm_head_cross_entropy(
+            hidden, kernel, labels, target_chunk=4096
+        )
+        ref = self._reference(hidden, kernel, labels)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_indivisible_vocab_pads(self):
+        # prime vocab (no divisor <= target): last chunk is padded and
+        # masked — values AND gradients still match the unfused loss
+        hidden, kernel, labels = self._setup(v=61)
+        got, g_fused = jax.value_and_grad(
+            lambda h, w: fused_lm_head_cross_entropy(
+                h, w, labels, target_chunk=16
+            ),
+            argnums=(0, 1),
+        )(hidden, kernel)
+        ref, g_ref = jax.value_and_grad(
+            lambda h, w: self._reference(h, w, labels), argnums=(0, 1)
+        )(hidden, kernel)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+    def test_model_return_hidden_path(self):
+        # end-to-end: model(return_hidden) + fused CE == logits + CE
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        import flax.linen as fnn
+
+        params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+        logits = model.apply({"params": params}, ids)
+        hidden = model.apply({"params": params}, ids, return_hidden=True)
+        assert hidden.shape == (2, 16, cfg.hidden_size)
+        ref = cross_entropy_loss(logits[:, :-1], ids[:, 1:])
+        got = fused_lm_head_cross_entropy(
+            hidden[:, :-1].astype(jnp.float32),
+            params["lm_head"]["kernel"],
+            ids[:, 1:],
+            target_chunk=128,
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-2)
