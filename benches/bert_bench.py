@@ -1,0 +1,138 @@
+"""BERT-base MLM pretraining throughput — benchmark config #4.
+
+Full production train step: flash attention (non-causal), bf16
+compute / f32 AdamW, 15%-masked MLM loss through the fused LM-head
+cross-entropy (the [B, S, V] logits never materialize — at batch 128 ×
+seq 512 × vocab 30522 they would be 8 GB f32, over half this chip's
+HBM). Sync is by host readback of the loss (docs/BENCHMARKS.md,
+"Measurement integrity").
+
+MFU counts matmul FLOPs only: 6 × (params − embedding tables) × tokens
+— embedding lookups are gathers, not MXU work, and BERT's tables are
+~20% of its parameters, so plain 6ND would flatter the number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from k8s_tpu.models import BertConfig, BertForPretraining
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+from k8s_tpu.train import (
+    create_sharded_state,
+    cross_entropy_loss,
+    make_batch_sharder,
+    make_train_step,
+)
+
+PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bert-bench")
+    p.add_argument("--batch-per-chip", type=int, default=64)
+    p.add_argument("--no-fused-ce", action="store_true",
+                   help="materialize full [B,S,V] logits in the loss")
+    args = p.parse_args(argv)
+
+    n = len(jax.devices())
+    on_accel = jax.default_backend() in ("tpu", "gpu")
+    if on_accel:
+        cfg = BertConfig.base()
+        batch, seq, warmup, iters = args.batch_per_chip * n, 512, 3, 10
+    else:
+        cfg = BertConfig.tiny()
+        batch, seq, warmup, iters = 2 * n, 64, 1, 3
+
+    mesh = build_mesh(MeshConfig(data=n))
+    rules = LogicalRules(LogicalRules.DP)
+    model = BertForPretraining(cfg)
+
+    ids0 = jnp.zeros((batch, seq), jnp.int32)
+    state = create_sharded_state(
+        model, optax.adamw(1e-4, weight_decay=0.01), mesh, rules,
+        jax.random.PRNGKey(0), ids0,
+    )
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    embed_params = sum(
+        state.params[k]["embedding"].size
+        for k in ("tok_embed", "pos_embed", "type_embed")
+        if k in state.params
+    )
+
+    if args.no_fused_ce:
+        def loss_fn(state, params, b, rng):
+            mlm, _ = state.apply_fn({"params": params}, b["ids"])
+            return cross_entropy_loss(mlm, b["labels"], mask=b["mask"]), {}
+    else:
+        def loss_fn(state, params, b, rng):
+            hidden, _ = state.apply_fn(
+                {"params": params}, b["ids"], return_hidden=True
+            )
+            return fused_lm_head_cross_entropy(
+                hidden, params["mlm_head"]["kernel"], b["labels"],
+                mask=b["mask"], bias=params["mlm_head"]["bias"],
+            ), {}
+
+    step = make_train_step(loss_fn, mesh, rules)
+    rng = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(rng)
+    data = make_batch_sharder(mesh, rules)(
+        {
+            "ids": jax.random.randint(k1, (batch, seq), 0, cfg.vocab_size),
+            "labels": jax.random.randint(k2, (batch, seq), 0, cfg.vocab_size),
+            "mask": (
+                jax.random.uniform(k1, (batch, seq)) < 0.15
+            ).astype(jnp.int32),
+        }
+    )
+
+    for _ in range(warmup):
+        state, metrics = step(state, data, rng)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, data, rng)
+    loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    assert loss == loss, "loss is NaN"
+
+    seqs_per_sec_chip = iters * batch / elapsed / n
+    tokens_per_sec_chip = seqs_per_sec_chip * seq
+    mfu = None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if on_accel and gen in PEAK_BF16_TFLOPS:
+        mfu = round(
+            6 * (n_params - embed_params) * tokens_per_sec_chip
+            / (PEAK_BF16_TFLOPS[gen] * 1e12),
+            4,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "bert_train_seqs_per_sec_per_chip",
+                "value": round(seqs_per_sec_chip, 2),
+                "unit": "seq512/sec/chip",
+                "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 1),
+                "params": n_params,
+                "mfu": mfu,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,13 @@ class BertForPretraining(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 return_hidden=False):
+        """``return_hidden`` skips the MLM head and returns
+        ``(hidden [B,S,E], nsp_logits)`` — feed hidden to
+        :func:`k8s_tpu.ops.fused_ce.fused_lm_head_cross_entropy` with
+        ``params["mlm_head"]["kernel"]`` so the [B,S,V] logits never
+        materialize (the NSP head is two columns; it stays in-model)."""
         cfg = self.config
         b, s = input_ids.shape
         tok = nn.Embed(
@@ -118,6 +124,9 @@ class BertForPretraining(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_embed")(x)
         for i in range(cfg.num_layers):
             x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        if return_hidden:
+            nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_head")(x[:, 0])
+            return x, nsp_logits
         mlm_logits = nn.DenseGeneral(
             features=cfg.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(

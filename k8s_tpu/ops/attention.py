@@ -637,8 +637,11 @@ def flash_attention(
     shapes_ok = (
         # seq % 128 keeps every fitted block sublane/lane aligned —
         # without it _fit_block(512, 200) would hand Mosaic a 200-row
-        # block and fail at compile time instead of falling back
-        d % 128 == 0 and sq % 128 == 0 and sk % 128 == 0
+        # block and fail at compile time instead of falling back.
+        # d % 64: Mosaic lane-pads a 64-wide head dim (verified exact
+        # vs mha_reference on v5e, fwd+bwd) — this keeps BERT-family
+        # head_dim 64 on the kernel instead of the S^2 XLA path
+        d % 64 == 0 and sq % 128 == 0 and sk % 128 == 0
         and sq % bq == 0 and sk % bk == 0
         # the kernels' causal mask compares absolute positions with no
         # diagonal offset — only meaningful for self-attention lengths

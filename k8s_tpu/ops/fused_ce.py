@@ -47,13 +47,17 @@ def fused_lm_head_cross_entropy(
     mask: Optional[jax.Array] = None,  # [B, S]; truthy = counted
     z_loss: float = 0.0,
     target_chunk: int = 8192,
+    bias: Optional[jax.Array] = None,  # [V] head bias (BERT-style heads)
 ) -> jax.Array:
-    """Mean token cross-entropy of ``softmax(hidden @ kernel)`` vs
-    ``labels``, computed without materializing the full logits.
+    """Mean token cross-entropy of ``softmax(hidden @ kernel + bias)``
+    vs ``labels``, computed without materializing the full logits.
 
     Matches :func:`k8s_tpu.train.cross_entropy_loss` semantics
     (masking, z-loss) on the same logits to f32-accumulation accuracy.
-    Differentiable in ``hidden`` and ``kernel``.
+    Differentiable in ``hidden``, ``kernel``, and ``bias``. Heads with
+    a bias (e.g. BERT's MLM head) MUST pass it — omitting it both
+    shifts the loss and freezes the bias at its initialization (zero
+    gradient).
     """
     e, v = kernel.shape
     num_chunks = _pick_num_chunks(v, target_chunk)
@@ -66,19 +70,24 @@ def fused_lm_head_cross_entropy(
         # so they never enter the logsumexp (a zero *logit* would not
         # be neutral) and can never be a label
         kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, pad))
     # [E, C*Vc] -> [C, E, Vc]: one transposed copy outside the scan; its
     # gradient is the inverse reshape of the stacked per-chunk dW.
     w_chunks = kernel.reshape(e, num_chunks, vc).transpose(1, 0, 2)
+    b_chunks = None if bias is None else bias.reshape(num_chunks, vc)
     bases = (jnp.arange(num_chunks) * vc).astype(labels.dtype)
 
     @jax.checkpoint
-    def chunk_stats(x, w_c, base):
+    def chunk_stats(x, w_c, b_c, base):
         logits_c = jax.lax.dot_general(
             x.astype(cdt),
             w_c.astype(cdt),
             (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [B, S, Vc] f32 — the only vocab-sized live buffer
+        if b_c is not None:
+            logits_c = logits_c + b_c.astype(jnp.float32)
         if pad:
             col_valid = base + jnp.arange(vc) < v
             logits_c = jnp.where(col_valid, logits_c, -jnp.inf)
@@ -91,11 +100,20 @@ def fused_lm_head_cross_entropy(
         label_logit_c = jnp.where(hit, picked, 0.0)
         return lse_c, label_logit_c
 
-    def body(_, inp):
-        w_c, base = inp
-        return None, chunk_stats(hidden, w_c, base)
+    if b_chunks is None:
+        def body(_, inp):
+            w_c, base = inp
+            return None, chunk_stats(hidden, w_c, None, base)
 
-    _, (lses, label_logits) = jax.lax.scan(body, None, (w_chunks, bases))
+        _, (lses, label_logits) = jax.lax.scan(body, None, (w_chunks, bases))
+    else:
+        def body(_, inp):
+            w_c, b_c, base = inp
+            return None, chunk_stats(hidden, w_c, b_c, base)
+
+        _, (lses, label_logits) = jax.lax.scan(
+            body, None, (w_chunks, b_chunks, bases)
+        )
     logz = jax.nn.logsumexp(lses, axis=0)  # [B, S]
     losses = logz - jnp.sum(label_logits, axis=0)
     if z_loss:

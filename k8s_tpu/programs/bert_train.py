@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import optax
 
 from k8s_tpu.models import BertConfig, BertForPretraining
+from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.parallel.mesh import best_pow2_split
 from k8s_tpu.programs.common import MetricLogger, parse_run_config
@@ -37,7 +38,19 @@ def main(rdzv) -> None:
         jax.random.PRNGKey(0), jnp.asarray(ids),
     )
 
+    # default on: MLM head fused into the CE (no [B,S,V] logits);
+    # fused_ce=0 falls back to the materialized-logits loss
+    fused_ce = (cfg.extra or {}).get("fused_ce", "1") not in ("0", "false")
+
     def loss_fn(state, params, b, rng):
+        if fused_ce:
+            hidden, _ = state.apply_fn(
+                {"params": params}, b["input_ids"], return_hidden=True
+            )
+            return fused_lm_head_cross_entropy(
+                hidden, params["mlm_head"]["kernel"], b["labels"],
+                mask=b["mask"], bias=params["mlm_head"]["bias"],
+            ), {}
         mlm, _ = state.apply_fn({"params": params}, b["input_ids"])
         return cross_entropy_loss(mlm, b["labels"], mask=b["mask"]), {}
 

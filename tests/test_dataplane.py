@@ -828,10 +828,13 @@ class TestFusedCE:
         labels = jax.random.randint(k3, (b, s), 0, v)
         return hidden, kernel, labels
 
-    def _reference(self, hidden, kernel, labels, mask=None, z_loss=0.0):
+    def _reference(self, hidden, kernel, labels, mask=None, z_loss=0.0,
+                   bias=None):
         logits = (
             hidden.astype(hidden.dtype) @ kernel.astype(hidden.dtype)
         ).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias
         return cross_entropy_loss(logits, labels, mask=mask, z_loss=z_loss)
 
     def test_matches_unfused(self):
@@ -877,20 +880,58 @@ class TestFusedCE:
 
     def test_indivisible_vocab_pads(self):
         # prime vocab (no divisor <= target): last chunk is padded and
-        # masked — values AND gradients still match the unfused loss
+        # masked — values AND gradients (incl. the padded bias) still
+        # match the unfused loss
         hidden, kernel, labels = self._setup(v=61)
+        bias = jax.random.normal(jax.random.PRNGKey(7), (61,))
         got, g_fused = jax.value_and_grad(
-            lambda h, w: fused_lm_head_cross_entropy(
-                h, w, labels, target_chunk=16
+            lambda h, w, bb: fused_lm_head_cross_entropy(
+                h, w, labels, target_chunk=16, bias=bb
             ),
-            argnums=(0, 1),
-        )(hidden, kernel)
+            argnums=(0, 1, 2),
+        )(hidden, kernel, bias)
         ref, g_ref = jax.value_and_grad(
-            lambda h, w: self._reference(h, w, labels), argnums=(0, 1)
-        )(hidden, kernel)
+            lambda h, w, bb: self._reference(h, w, labels, bias=bb),
+            argnums=(0, 1, 2),
+        )(hidden, kernel, bias)
         np.testing.assert_allclose(got, ref, rtol=1e-5)
         for a, b in zip(g_fused, g_ref):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+    def test_bert_return_hidden_path(self):
+        # BERT MLM: model(return_hidden) + fused masked CE == logits + CE
+        from k8s_tpu.models import BertConfig, BertForPretraining
+        import flax.linen as fnn
+
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        ids = jax.random.randint(k1, (2, 32), 0, cfg.vocab_size)
+        mask = (jax.random.uniform(k2, (2, 32)) < 0.15).astype(jnp.int32)
+        params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+        # non-zero head bias: the fused loss must include it (a dropped
+        # bias passes at init where it is all-zero)
+        params["mlm_head"]["bias"] = jax.random.normal(
+            jax.random.PRNGKey(3), params["mlm_head"]["bias"].shape
+        )
+        mlm, nsp_ref = model.apply({"params": params}, ids)
+        hidden, nsp = model.apply({"params": params}, ids, return_hidden=True)
+        np.testing.assert_allclose(nsp, nsp_ref, rtol=1e-6)
+        ref = cross_entropy_loss(mlm, ids, mask=mask)
+        got = fused_lm_head_cross_entropy(
+            hidden.astype(jnp.float32), params["mlm_head"]["kernel"],
+            ids, mask=mask, target_chunk=128,
+            bias=params["mlm_head"]["bias"],
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-2)
+        # and the bias gradient is live, not silently zero
+        gbias = jax.grad(
+            lambda bb: fused_lm_head_cross_entropy(
+                hidden.astype(jnp.float32), params["mlm_head"]["kernel"],
+                ids, mask=mask, target_chunk=128, bias=bb,
+            )
+        )(params["mlm_head"]["bias"])
+        assert float(jnp.max(jnp.abs(gbias))) > 0
 
     def test_model_return_hidden_path(self):
         # end-to-end: model(return_hidden) + fused CE == logits + CE
