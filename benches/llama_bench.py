@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--no-fused-ce", action="store_true",
                    help="materialize full [B,S,V] logits in the loss")
+    p.add_argument("--quant", default="none",
+                   choices=["none", "int8", "int8_bwd"],
+                   help="int8: W8A8 forward projections/MLP; int8_bwd: "
+                        "int8 backward matmuls too (experimental)")
     args = p.parse_args(argv)
 
     n = len(jax.devices())
@@ -52,12 +56,13 @@ def main(argv=None) -> int:
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
             max_seq_len=2048, remat=not args.no_remat,
-            remat_policy=args.remat_policy,
+            remat_policy=args.remat_policy, quant=args.quant,
         )
         batch, seq, warmup, iters = args.batch_per_chip * n, 2048, 3, 10
     else:
         cfg = LlamaConfig.tiny(remat=not args.no_remat,
-                               remat_policy=args.remat_policy)
+                               remat_policy=args.remat_policy,
+                               quant=args.quant)
         batch, seq, warmup, iters = 2 * n, 128, 1, 3
 
     mesh = build_mesh(MeshConfig(data=n))

@@ -64,6 +64,13 @@ class LlamaConfig:
     # [B, max_seq_len] KV cache ("cache" collection) instead of running
     # the training kernels; see :func:`generate`
     decode: bool = False
+    # "int8": W8A8 forward on q/k/v and the MLP (2x MXU rate on v5e),
+    # bf16 straight-through backward; "int8_bwd": int8 backward matmuls
+    # too (EXPERIMENTAL numerics — validate convergence). Opt-in; embed,
+    # lm_head, and o_proj stay high-precision (o_proj: measured net
+    # loss when quantized — see the o_proj comment below and
+    # k8s_tpu/ops/quant.py)
+    quant: str = "none"
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -111,7 +118,24 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _dense(features, axes, name, dtype):
+def _quant_extra(quant: str) -> dict:
+    """kwargs for nn.DenseGeneral selecting the quantized dot_general —
+    same params/metadata/shardings, only the compute changes."""
+    if quant == "int8":
+        from k8s_tpu.ops.quant import int8_dot_general
+
+        return {"dot_general": int8_dot_general}
+    if quant == "int8_bwd":
+        from k8s_tpu.ops.quant import int8_dot_general_bwd8
+
+        return {"dot_general": int8_dot_general_bwd8}
+    if quant != "none":
+        raise ValueError(f"unknown quant {quant!r}")
+    return {}
+
+
+def _dense(features, axes, name, dtype, quant="none"):
+    extra = _quant_extra(quant)
     return nn.DenseGeneral(
         features=features,
         use_bias=False,
@@ -121,6 +145,7 @@ def _dense(features, axes, name, dtype):
             nn.initializers.lecun_normal(), axes
         ),
         name=name,
+        **extra,
     )
 
 
@@ -159,9 +184,12 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         b, s, _ = x.shape
         h, kv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype)(x)
-        k = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "k_proj", cfg.dtype)(x)
-        v = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "v_proj", cfg.dtype)(x)
+        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype,
+                   cfg.quant)(x)
+        k = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "k_proj",
+                   cfg.dtype, cfg.quant)(x)
+        v = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "v_proj",
+                   cfg.dtype, cfg.quant)(x)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
@@ -226,6 +254,11 @@ class LlamaAttention(nn.Module):
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
             ),
+            # o_proj deliberately NOT quantized: its K=H*D contraction
+            # is too small to amortize the quantize pass over a fresh
+            # input tensor (q/k/v and gate/up share their input's
+            # quantization via CSE) — measured -4% end-to-end when
+            # quantized vs excluded (docs/BENCHMARKS.md)
             name="o_proj",
         )(out)
         return out
@@ -237,11 +270,14 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj", cfg.dtype)(x)
-        up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.dtype)(x)
+        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
+                      cfg.dtype, cfg.quant)(x)
+        up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj",
+                    cfg.dtype, cfg.quant)(x)
         y = nn.silu(gate) * up
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
-        return _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.dtype)(y)
+        return _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.dtype,
+                      cfg.quant)(y)
 
 
 class RMSNorm(nn.Module):
