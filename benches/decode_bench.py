@@ -1,0 +1,110 @@
+"""Autoregressive decode throughput — tokens/sec for ``generate()``.
+
+The inference half of the Llama path (training throughput lives in
+``llama_bench.py``): prefill a prompt, then greedy-decode new tokens
+through the static-KV-cache ``lax.scan`` loop. Single-token decode is
+HBM-bandwidth-bound (every step reads all params + the KV cache), so
+the roofline is ``bandwidth / (param_bytes + kv_bytes_per_token·S)``
+— reported alongside the measurement. Sync is by host readback of the
+generated tokens.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.models.llama import generate
+
+HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="decode-bench")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=256)
+    args = p.parse_args(argv)
+
+    on_accel = jax.default_backend() in ("tpu", "gpu")
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32768, hidden_size=1536, intermediate_size=4096,
+            num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
+            max_seq_len=args.prompt_len + args.new_tokens,
+            remat=False, decode=True,
+        )
+    else:
+        cfg = LlamaConfig.tiny(decode=True, max_seq_len=64)
+        args.batch, args.prompt_len, args.new_tokens = 2, 8, 16
+
+    model = LlamaForCausalLM(cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.prompt_len), 0,
+        cfg.vocab_size,
+    )
+    import flax.linen as nn
+
+    params = nn.unbox(
+        model.init(jax.random.PRNGKey(0), prompt)["params"]
+    )
+    # inference-cast: serve bf16 weights (training keeps f32 masters) —
+    # decode reads every param every step, f32 weights would double the
+    # dominant bandwidth term
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x,
+        params,
+    )
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    # warm (compiles prefill + decode loop)
+    toks = generate(model, params, prompt, args.new_tokens)
+    jax.block_until_ready(toks)
+    int(toks[0, -1])  # host readback sync
+
+    t0 = time.perf_counter()
+    iters = 3
+    for i in range(iters):
+        toks = generate(model, params, prompt, args.new_tokens)
+        int(toks[0, -1])
+    elapsed = time.perf_counter() - t0
+
+    tok_per_sec = iters * args.batch * args.new_tokens / elapsed
+    per_step_ms = elapsed / (iters * args.new_tokens) * 1e3
+
+    # bandwidth roofline for batch-B single-token decode: params read
+    # once per STEP (shared across the batch), KV cache read per ROW
+    result = {
+        "metric": "llama_decode_tokens_per_sec",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec",
+        "batch": args.batch,
+        "per_step_ms": round(per_step_ms, 2),
+        "params": n_params,
+    }
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if on_accel and gen in HBM_GBPS:
+        param_bytes = 2 * n_params  # bf16 weights read each step
+        kv_bytes = (
+            2 * 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+            * cfg.max_seq_len * args.batch
+        )
+        roofline_ms = (param_bytes + kv_bytes) / (HBM_GBPS[gen] * 1e9) * 1e3
+        result["roofline_step_ms"] = round(roofline_ms, 2)
+        result["bandwidth_util"] = round(roofline_ms / per_step_ms, 3)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

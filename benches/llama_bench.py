@@ -38,6 +38,8 @@ PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0}
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="llama-bench")
     p.add_argument("--batch-per-chip", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=2048,
+                   help="training sequence length (long-context rows)")
     p.add_argument("--remat-policy", default="nothing_saveable",
                    choices=["nothing_saveable", "dots", "flash"])
     p.add_argument("--no-remat", action="store_true")
@@ -55,10 +57,12 @@ def main(argv=None) -> int:
         cfg = LlamaConfig(
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
-            max_seq_len=2048, remat=not args.no_remat,
+            max_seq_len=args.seq_len, remat=not args.no_remat,
             remat_policy=args.remat_policy, quant=args.quant,
         )
-        batch, seq, warmup, iters = args.batch_per_chip * n, 2048, 3, 10
+        batch, seq, warmup, iters = (
+            args.batch_per_chip * n, args.seq_len, 3, 10,
+        )
     else:
         cfg = LlamaConfig.tiny(remat=not args.no_remat,
                                remat_policy=args.remat_policy,
