@@ -49,24 +49,34 @@ def main(argv=None) -> int:
                    choices=["none", "int8", "int8_bwd"],
                    help="int8: W8A8 forward projections/MLP; int8_bwd: "
                         "int8 backward matmuls too (experimental)")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help=">0: top-2 MoE MLP with this many experts "
+                        "(intermediate_size shrinks to fit HBM)")
     args = p.parse_args(argv)
 
     n = len(jax.devices())
     on_accel = jax.default_backend() in ("tpu", "gpu")
     if on_accel:
-        cfg = LlamaConfig(
+        base = dict(
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
             max_seq_len=args.seq_len, remat=not args.no_remat,
             remat_policy=args.remat_policy, quant=args.quant,
         )
+        if args.num_experts:
+            # per-expert FFN shrinks so total params (x12 bytes AdamW)
+            # stay HBM-feasible on one 16 GB chip
+            base.update(num_experts=args.num_experts,
+                        intermediate_size=512)
+        cfg = LlamaConfig(**base)
         batch, seq, warmup, iters = (
             args.batch_per_chip * n, args.seq_len, 3, 10,
         )
     else:
         cfg = LlamaConfig.tiny(remat=not args.no_remat,
                                remat_policy=args.remat_policy,
-                               quant=args.quant)
+                               quant=args.quant,
+                               num_experts=args.num_experts)
         batch, seq, warmup, iters = 2 * n, 128, 1, 3
 
     mesh = build_mesh(MeshConfig(data=n))
@@ -80,18 +90,27 @@ def main(argv=None) -> int:
     )
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
 
+    from k8s_tpu.train import sum_sown_losses
+
+    # both branches mirror the production program: MoE router losses
+    # (sown into intermediates) reach the training loss
     if args.no_fused_ce:
         def loss_fn(state, params, b, rng):
-            logits = state.apply_fn({"params": params}, b["ids"])
-            return cross_entropy_loss(logits[:, :-1], b["ids"][:, 1:]), {}
+            logits, mut = state.apply_fn(
+                {"params": params}, b["ids"], mutable=["intermediates"]
+            )
+            ce = cross_entropy_loss(logits[:, :-1], b["ids"][:, 1:])
+            return ce + sum_sown_losses(mut.get("intermediates", {})), {}
     else:
         def loss_fn(state, params, b, rng):
-            hidden = state.apply_fn(
-                {"params": params}, b["ids"], return_hidden=True
+            hidden, mut = state.apply_fn(
+                {"params": params}, b["ids"], return_hidden=True,
+                mutable=["intermediates"],
             )
-            return fused_lm_head_cross_entropy(
+            ce = fused_lm_head_cross_entropy(
                 hidden[:, :-1], params["lm_head"]["kernel"], b["ids"][:, 1:]
-            ), {}
+            )
+            return ce + sum_sown_losses(mut.get("intermediates", {})), {}
 
     step = make_train_step(loss_fn, mesh, rules)
     rng = jax.random.PRNGKey(1)
@@ -115,7 +134,9 @@ def main(argv=None) -> int:
     # (MFU counts useful FLOPs only, the MLPerf convention)
     mfu = None
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
-    if on_accel and gen in PEAK_BF16_TFLOPS:
+    # MoE: 6*N_total over-counts ~4x (only top-k of E expert FFNs are
+    # active per token) — suppress rather than mislead
+    if on_accel and gen in PEAK_BF16_TFLOPS and not args.num_experts:
         mfu = round(
             6 * n_params * tokens_per_sec_chip / (PEAK_BF16_TFLOPS[gen] * 1e12),
             4,

@@ -1,12 +1,23 @@
 """Mixture-of-Experts layer with expert parallelism.
 
 Completes the EP row of SURVEY §2.5 (absent in the reference). A
-top-2-gated expert MLP whose expert dimension is sharded over the
-``expert`` mesh axis. The token→expert routing uses the dense
-"einsum dispatch" formulation: dispatch/combine one-hot einsums lower
-to all-to-all-shaped collectives under GSPMD, which is the
-compiler-friendly (static-shape, MXU-dense) way to express MoE on TPU
-— no scatter/gather, no dynamic shapes inside jit.
+top-k-gated expert MLP whose expert dimension is sharded over the
+``expert`` mesh axis.
+
+Token→expert routing is SORT-BASED with static shapes: flatten the
+(token, k) assignments k-major, stable-argsort by expert (k=0
+assignments win capacity slots first, then token order), compute each
+assignment's slot within its expert from the sorted running index, and
+scatter rows into the ``[E, C, D]`` expert buffers (out-of-capacity
+assignments scatter to an out-of-bounds index and are dropped by
+``mode="drop"``). Everything is fixed-shape, differentiable
+(scatter/gather transpose to each other), and O(T·K + E·C·D) memory.
+
+The first version of this layer used the GShard-style dense one-hot
+"einsum dispatch" ([T, K, E, C] dispatch/combine tensors). That is
+compiler-friendly but O(T²·k·capacity_factor) memory at fixed capacity
+factor — fine for unit-test shapes, 4 TB at bench scale (T = 16k,
+E = 8). The sort formulation is how MoE actually scales on TPU.
 """
 
 from __future__ import annotations
@@ -63,27 +74,30 @@ class MoeMlp(nn.Module):
             gate_vals.sum(axis=-1, keepdims=True), 1e-9
         )
 
-        # position of each (token, k) within its expert's capacity
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [T, K, E]
-        # priority: k=0 assignments first, then token order
-        flat = onehot.transpose(1, 0, 2).reshape(cfg.top_k * n_tok, e)
-        pos_flat = jnp.cumsum(flat, axis=0) - flat  # [K·T, E]
-        pos = pos_flat.reshape(cfg.top_k, n_tok, e).transpose(1, 0, 2)  # [T,K,E]
-        within_cap = (pos < capacity) & (onehot > 0)
-        slot = jnp.sum(pos * onehot, axis=-1)  # [T, K]
+        # sort-based dispatch, k-major so k=0 assignments claim
+        # capacity slots first (then token order — stable sort)
+        kt = cfg.top_k * n_tok
+        flat_expert = expert_idx.T.reshape(kt)          # [K·T], k-major
+        order = jnp.argsort(flat_expert, stable=True)   # sorted by expert
+        sorted_expert = flat_expert[order]
+        src_tok = order % n_tok                         # token of each entry
+        # slot within expert = sorted running index − expert's start
+        counts = jnp.zeros((e,), jnp.int32).at[flat_expert].add(1)
+        starts = jnp.cumsum(counts) - counts            # exclusive prefix
+        slot = jnp.arange(kt, dtype=jnp.int32) - starts[sorted_expert]
+        keep = slot < capacity
+        # out-of-capacity → index E*C, dropped by scatter mode="drop"
+        buf_idx = jnp.where(keep, sorted_expert * capacity + slot,
+                            e * capacity)
 
-        # dispatch tensor [T, K, E, C] → combine over (K)
-        slot_oh = jax.nn.one_hot(slot, capacity, dtype=x.dtype)  # [T,K,C]
-        keep = within_cap.any(-1).astype(x.dtype)  # [T, K]
-        dispatch = (
-            onehot.astype(x.dtype)[..., None]
-            * slot_oh[:, :, None, :]
-            * keep[..., None, None]
-        )  # [T, K, E, C]
-        combine = dispatch * gate_vals[..., None, None].astype(x.dtype)
-
-        # route tokens to expert buffers: [E, C, D]
-        expert_in = jnp.einsum("tkec,td->ecd", dispatch, tokens)
+        # route tokens into expert buffers [E, C, D] (unique buf_idx:
+        # one (expert, slot) pair per kept assignment)
+        expert_in = (
+            jnp.zeros((e * capacity, d), x.dtype)
+            .at[buf_idx]
+            .set(tokens[src_tok].astype(x.dtype), mode="drop")
+            .reshape(e, capacity, d)
+        )
         expert_in = nn.with_logical_constraint(expert_in, ("expert", None, "embed"))
 
         # expert MLPs (weights stacked on the expert axis)
@@ -109,14 +123,21 @@ class MoeMlp(nn.Module):
         h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
         expert_out = jnp.einsum("ecm,emd->ecd", h, w_down.astype(cfg.dtype))
 
-        # combine back to tokens
-        out = jnp.einsum("tkec,ecd->td", combine, expert_out)
-        out = out.reshape(b, s, d)
+        # combine back to tokens: gather each kept assignment's expert
+        # output, weight by its (renormalized) gate, scatter-add over k
+        gates_sorted = gate_vals.T.reshape(kt)[order].astype(x.dtype)
+        safe_idx = jnp.where(keep, buf_idx, 0)  # clamped read, masked below
+        picked = expert_out.reshape(e * capacity, d)[safe_idx]
+        weighted = picked * (gates_sorted * keep.astype(x.dtype))[:, None]
+        out = (
+            jnp.zeros((n_tok, d), x.dtype).at[src_tok].add(weighted)
+        ).reshape(b, s, d)
 
         # load-balancing auxiliary loss (Switch-style): mean prob ×
         # fraction routed, summed over experts
         me = probs.mean(axis=0)  # [E]
-        ce = onehot[:, 0, :].astype(jnp.float32).mean(axis=0)  # top-1 fraction
+        top1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+        ce = top1.mean(axis=0)  # top-1 routed fraction per expert
         aux_loss = cfg.router_aux_loss_weight * e * jnp.sum(me * ce)
         self.sow("intermediates", "router_aux_loss", aux_loss)
         # router z-loss: keeps logit magnitudes bounded so the f32

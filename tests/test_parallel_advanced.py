@@ -27,6 +27,44 @@ class TestMoe:
         aux = inter["intermediates"]["router_aux_loss"][0]
         assert float(aux) >= 0
 
+    def test_matches_dense_reference_when_capacity_ample(self):
+        """With capacity >= all assignments (no drops), the sort-based
+        dispatch must reproduce the per-token dense computation:
+        sum_k gate_k * SwiGLU_{expert_k}(x_t)."""
+        import flax.linen as nn
+
+        cfg = MoeConfig(
+            num_experts=4, hidden_size=32, intermediate_size=64,
+            top_k=2, expert_capacity_factor=4.0,  # capacity = all tokens
+        )
+        layer = MoeMlp(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+        v = nn.unbox(layer.init(jax.random.PRNGKey(1), x))
+        y = layer.apply(v, x)
+
+        p = v["params"]
+        tokens = x.reshape(-1, 32)
+        logits = tokens @ p["router"]["kernel"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, idx = jax.lax.top_k(probs, 2)
+        gates = gates / gates.sum(-1, keepdims=True)
+
+        def ffn(e, t):
+            h = jax.nn.silu(t @ p["w_gate"][e]) * (t @ p["w_up"][e])
+            return h @ p["w_down"][e]
+
+        ref = jnp.stack([
+            sum(
+                gates[t, k] * ffn(int(idx[t, k]), tokens[t])
+                for k in range(2)
+            )
+            for t in range(tokens.shape[0])
+        ]).reshape(x.shape)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2,  # layer computes in bf16
+        )
+
     def test_capacity_drops_overflow(self):
         # tiny capacity forces token drops; output stays finite
         cfg = MoeConfig(
