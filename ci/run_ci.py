@@ -19,13 +19,19 @@ import subprocess
 import sys
 import time
 
+# `python ci/run_ci.py` puts ci/ (not the repo root) on sys.path —
+# both this import and the subprocess stages need the root
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
 from k8s_tpu.tools.junit import TestCase, Timer, create_junit_xml_file
 
 
 def stage(name: str, cmd, artifacts: str, cases: list) -> bool:
     print(f"\n=== stage: {name} ===\n$ {' '.join(cmd)}")
     with Timer() as t:
-        proc = subprocess.run(cmd)
+        proc = subprocess.run(cmd, cwd=_ROOT)
     ok = proc.returncode == 0
     cases.append(
         TestCase("ci", name, t.elapsed, None if ok else f"exit {proc.returncode}")
@@ -41,6 +47,9 @@ def main(argv=None) -> int:
     p.add_argument("--with-bench", action="store_true")
     p.add_argument("--skip-slow", action="store_true")
     args = p.parse_args(argv)
+    # absolute: in-process junit writes and the cwd=_ROOT subprocess
+    # stages must agree on where artifacts land
+    args.artifacts_dir = os.path.abspath(args.artifacts_dir)
     os.makedirs(args.artifacts_dir, exist_ok=True)
     py = sys.executable
 
