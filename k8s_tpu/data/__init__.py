@@ -1,5 +1,9 @@
-"""Input pipelines: synthetic benchmark data + simple real loaders."""
+"""Input pipelines: synthetic benchmark data + real record loaders."""
 
+from k8s_tpu.data.records import (  # noqa: F401
+    image_record_batches,
+    write_image_shards,
+)
 from k8s_tpu.data.synthetic import (  # noqa: F401
     synthetic_image_batches,
     synthetic_mnist,

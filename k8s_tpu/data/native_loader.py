@@ -176,6 +176,18 @@ class NativeRecordLoader:
         if self._handle is not None:
             self._lib.ktpu_loader_close(self._handle)
             self._handle = None
+            self._ring = None  # safe to release only after close joins
+
+    def __del__(self):
+        # zero-copy mode registers numpy ring buffers with the C++
+        # producer threads; dropping the object without close() would
+        # free memory those threads still write into. close() joins
+        # them first. Guard: ctypes/libc may be torn down at
+        # interpreter exit.
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __enter__(self):
         return self

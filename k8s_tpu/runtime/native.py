@@ -24,9 +24,24 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _stale() -> bool:
+    """True when any native source is newer than the built artifacts —
+    an existence-only check would load a stale .so missing newly added
+    symbols after a pull."""
+    try:
+        built = min(os.path.getmtime(LIB_PATH), os.path.getmtime(SUPERVISOR_PATH))
+    except OSError:
+        return True
+    for name in os.listdir(NATIVE_DIR):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(NATIVE_DIR, name)) > built:
+                return True
+    return False
+
+
 def build_native(force: bool = False) -> None:
     with _lock:
-        if not force and os.path.exists(LIB_PATH) and os.path.exists(SUPERVISOR_PATH):
+        if not force and not _stale():
             return
         subprocess.run(
             ["make", "-C", NATIVE_DIR, "all"],

@@ -166,6 +166,58 @@ class TestPrograms:
         resnet_train.main(r)
         assert '"run": "resnet50"' in capsys.readouterr().out
 
+    def test_resnet_program_with_record_data(self, capsys, tmp_path):
+        # the REAL input pipeline end-to-end: record shards → native
+        # loader (zero-copy ring) → decode → sharded train step
+        import numpy as np
+
+        from k8s_tpu.data import write_image_shards
+        from k8s_tpu.programs import resnet_train
+
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (64, 64, 64, 3), dtype=np.uint8)
+        labels = rng.integers(0, 100, (64,))
+        write_image_shards(str(tmp_path), images, labels, num_shards=2)
+
+        r = self.FakeRdzv()
+        r.program_args = (
+            "--steps=2 --batch_size=8 --log_every=1 --tiny=1 "
+            f"--data_dir={tmp_path}"
+        )
+        resnet_train.main(r)
+        assert '"run": "resnet50"' in capsys.readouterr().out
+
+    def test_image_record_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from k8s_tpu.data import image_record_batches, write_image_shards
+
+        rng = np.random.default_rng(1)
+        # 23 % 5 != 0: one-pass mode must yield the short tail batch
+        # (drop_remainder defaults False when loop=False)
+        images = rng.integers(0, 256, (23, 8, 8, 3), dtype=np.uint8)
+        labels = rng.integers(0, 1000, (23,))
+        paths = write_image_shards(str(tmp_path), images, labels, num_shards=3)
+        it = image_record_batches(
+            paths, 5, 8, loop=False, normalize=False, num_threads=2
+        )
+        got_img, got_lab = [], []
+        for b in it:
+            got_img.append(b["images"])
+            got_lab.append(b["labels"])
+        got_img = np.concatenate(got_img).astype(np.uint8)
+        got_lab = np.concatenate(got_lab)
+        assert got_img.shape == (23, 8, 8, 3)
+        # order is shard-interleaved: match per-label (labels unique-ish
+        # is not guaranteed, so sort by serialized record)
+        want = {
+            (int(l), images[i].tobytes()) for i, l in enumerate(labels)
+        }
+        got = {
+            (int(l), got_img[i].tobytes()) for i, l in enumerate(got_lab)
+        }
+        assert want == got
+
     def test_bert_program_tiny(self, capsys):
         from k8s_tpu.programs import bert_train
 
