@@ -1,0 +1,100 @@
+"""Llama autoregressive generation as an operator workload — inference
+jobs through the same TpuJob lifecycle as training (a capability the
+reference never had: its operator only wired training clusters,
+SURVEY §0).
+
+Run config (``KTPU_PROGRAM_ARGS``):
+  --model=tiny|llama3-8b   model size (default tiny)
+  --batch_size=N           prompts per round (default 8)
+  --prompt_len=N           synthetic prompt length (default 32)
+  --new_tokens=N           tokens to decode per round (default 64)
+  --temperature=F          0 = greedy (default)
+  --steps=N                generation rounds (default 3)
+  --checkpoint_dir=...     restore trained params (trainer-compatible
+                           orbax layout); random init when empty
+
+Logs tokens/sec via MetricLogger; single-process decode (generation is
+not sharded here — batch-parallel decode across processes is just N
+independent jobs).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+from k8s_tpu.models.llama import generate
+from k8s_tpu.programs.common import MetricLogger, parse_run_config
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 3, "batch_size": 8})
+    extra = cfg.extra or {}
+    model_name = extra.get("model", "tiny")
+    prompt_len = int(extra.get("prompt_len", "32"))
+    new_tokens = int(extra.get("new_tokens", "64"))
+    temperature = float(extra.get("temperature", "0"))
+
+    max_seq = prompt_len + new_tokens
+    if model_name == "llama3-8b":
+        lcfg = LlamaConfig.llama3_8b(decode=True, remat=False,
+                                     max_seq_len=max_seq)
+    else:
+        # same head layout as llama_train's tiny config, so trainer
+        # checkpoints restore into the decode model
+        lcfg = LlamaConfig.tiny(
+            decode=True, max_seq_len=max(max_seq, 128),
+            num_heads=8, num_kv_heads=4, head_dim=16,
+        )
+    model = LlamaForCausalLM(lcfg)
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch_size, prompt_len), 0,
+        lcfg.vocab_size,
+    )
+    import flax.linen as nn
+
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
+    if cfg.checkpoint_dir:
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        # trainer checkpoints store a full TrainState; restore params
+        # from it into the decode model (same module tree)
+        mgr = CheckpointManager(cfg.checkpoint_dir)
+        try:
+            restored = mgr.restore_params(params)
+        finally:
+            mgr.close()  # read-only use: stop orbax background threads
+        if restored is None:
+            # an inference job pointed at an empty/missing checkpoint
+            # must FAIL, not silently serve random weights
+            raise FileNotFoundError(
+                f"no checkpoint found under {cfg.checkpoint_dir}"
+            )
+        params = restored
+    # serve bf16: decode re-reads every weight each step, f32 masters
+    # would double the bandwidth-bound step time
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params,
+    )
+
+    # warm round compiles prefill + decode loop (cached across rounds);
+    # the logger starts AFTER it so step 1's rate excludes compile time
+    toks = generate(model, params, prompt, new_tokens,
+                    temperature=temperature)
+    jax.block_until_ready(toks)
+    logger = MetricLogger(rdzv, f"llama-generate-{model_name}")
+    for step in range(1, cfg.steps + 1):
+        t0 = time.perf_counter()
+        toks = generate(model, params, prompt, new_tokens,
+                        temperature=temperature,
+                        rng=jax.random.PRNGKey(step))
+        int(toks[0, -1])  # host readback sync
+        dt = time.perf_counter() - t0
+        logger.log(step, {
+            "tokens_per_sec": round(cfg.batch_size * new_tokens / dt, 1),
+        })

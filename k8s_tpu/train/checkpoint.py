@@ -55,6 +55,36 @@ class CheckpointManager:
             step, args=self._ocp.args.StandardRestore(abstract)
         )
 
+    def restore_params(self, params_template: Any,
+                       step: Optional[int] = None) -> Any:
+        """Restore ONLY the params subtree from a full-TrainState
+        checkpoint (e.g. for serving: the decode model wants weights,
+        not optimizer moments). Materializes the raw saved tree on
+        host first — fine for serving-sized models; shard-aware full
+        restore (``restore``) is the path for resuming training."""
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            return None
+        raw = self.manager.restore(step)
+        params = raw["params"] if isinstance(raw, dict) else raw.params
+        template_leaves, treedef = jax.tree_util.tree_flatten(params_template)
+        leaves = jax.tree_util.tree_leaves(params)
+        if len(leaves) != len(template_leaves):
+            raise ValueError(
+                f"checkpoint params tree has {len(leaves)} leaves, "
+                f"template has {len(template_leaves)} — different model?"
+            )
+        for i, (got, want) in enumerate(zip(leaves, template_leaves)):
+            if tuple(got.shape) != tuple(want.shape):
+                # catch architecture mismatches here with a clear error
+                # instead of deep inside the first jitted apply
+                raise ValueError(
+                    f"checkpoint leaf {i} has shape {tuple(got.shape)}, "
+                    f"template expects {tuple(want.shape)} — different "
+                    "model configuration?"
+                )
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 

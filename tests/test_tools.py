@@ -218,6 +218,52 @@ class TestPrograms:
         }
         assert want == got
 
+    def test_llama_generate_program(self, capsys):
+        from k8s_tpu.programs import llama_generate
+
+        r = self.FakeRdzv()
+        r.program_args = (
+            "--steps=2 --batch_size=2 --prompt_len=8 --new_tokens=6 "
+            "--log_every=1"
+        )
+        llama_generate.main(r)
+        out = capsys.readouterr().out
+        assert '"run": "llama-generate-tiny"' in out
+        assert "tokens_per_sec" in out
+
+    def test_llama_generate_from_train_checkpoint(self, capsys, tmp_path):
+        # train → checkpoint → serve: the decode program restores the
+        # trainer's params from a full-TrainState orbax checkpoint
+        from k8s_tpu.programs import llama_generate, llama_train
+
+        r = self.FakeRdzv()
+        r.num_slices = 1
+        r.program_args = (
+            "--steps=2 --batch_size=8 --log_every=1 --strategy=dp "
+            f"--seq_len=16 --checkpoint_dir={tmp_path} --checkpoint_every=2"
+        )
+        llama_train.main(r)
+
+        r2 = self.FakeRdzv()
+        r2.program_args = (
+            "--steps=1 --batch_size=2 --prompt_len=4 --new_tokens=4 "
+            f"--checkpoint_dir={tmp_path} --log_every=1"
+        )
+        llama_generate.main(r2)
+        assert "tokens_per_sec" in capsys.readouterr().out
+
+        # an empty checkpoint dir must fail loudly, never silently
+        # serve random weights
+        import pytest
+
+        r3 = self.FakeRdzv()
+        r3.program_args = (
+            "--steps=1 --batch_size=2 --prompt_len=4 --new_tokens=4 "
+            f"--checkpoint_dir={tmp_path}/nonexistent --log_every=1"
+        )
+        with pytest.raises(FileNotFoundError):
+            llama_generate.main(r3)
+
     def test_bert_program_tiny(self, capsys):
         from k8s_tpu.programs import bert_train
 
