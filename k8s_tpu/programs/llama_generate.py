@@ -13,9 +13,10 @@ Run config (``KTPU_PROGRAM_ARGS``):
   --checkpoint_dir=...     restore trained params (trainer-compatible
                            orbax layout); random init when empty
 
-Logs tokens/sec via MetricLogger; single-process decode (generation is
-not sharded here — batch-parallel decode across processes is just N
-independent jobs).
+Logs tokens/sec via MetricLogger. Params are initialized SHARDED over
+a tensor-parallel mesh spanning the local devices (an 8B model's
+weights do not fit one chip — unsharded init would OOM before serving
+starts); the KV cache and activations follow via GSPMD propagation.
 """
 
 from __future__ import annotations
@@ -27,7 +28,22 @@ import jax.numpy as jnp
 
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
 from k8s_tpu.models.llama import generate
+from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.train.trainer_lib import shardings_from_logical
+
+
+def _tp_degree(n_devices: int, num_kv_heads: int) -> int:
+    """Largest power of two dividing both the device count and the kv
+    head count — kv heads are the binding TP constraint."""
+    t = 1
+    while (
+        t * 2 <= n_devices
+        and n_devices % (t * 2) == 0
+        and num_kv_heads % (t * 2) == 0
+    ):
+        t *= 2
+    return t
 
 
 def main(rdzv) -> None:
@@ -57,24 +73,47 @@ def main(rdzv) -> None:
     )
     import flax.linen as nn
 
-    params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
+    # weights live distributed over a TP mesh (never materialized on
+    # one device — load-bearing at 8B scale)
+    n = len(jax.devices())
+    mesh = build_mesh(
+        MeshConfig(tensor=_tp_degree(n, lcfg.num_kv_heads), data=-1)
+    )
+    rules = LogicalRules(LogicalRules.TP)
+
+    def boxed_init():
+        return model.init(jax.random.PRNGKey(0), prompt)
+
     if cfg.checkpoint_dir:
         from k8s_tpu.train.checkpoint import CheckpointManager
 
-        # trainer checkpoints store a full TrainState; restore params
-        # from it into the decode model (same module tree)
+        # restore path: no random init runs at all — an eval_shape
+        # template (shapes + shardings) is enough for the checkpoint
+        # weights to stream straight onto their device shards
+        shardings = nn.unbox(
+            shardings_from_logical(boxed_init, mesh, rules)
+        )["params"]
+        abstract = jax.eval_shape(lambda: nn.unbox(boxed_init()))["params"]
+        template = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, shardings,
+        )
         mgr = CheckpointManager(cfg.checkpoint_dir)
         try:
-            restored = mgr.restore_params(params)
+            params = mgr.restore_params(template)
         finally:
             mgr.close()  # read-only use: stop orbax background threads
-        if restored is None:
+        if params is None:
             # an inference job pointed at an empty/missing checkpoint
             # must FAIL, not silently serve random weights
             raise FileNotFoundError(
                 f"no checkpoint found under {cfg.checkpoint_dir}"
             )
-        params = restored
+    else:
+        from k8s_tpu.train.trainer_lib import init_sharded_variables
+
+        variables, _ = init_sharded_variables(boxed_init, mesh, rules)
+        params = variables["params"]
     # serve bf16: decode re-reads every weight each step, f32 masters
     # would double the bandwidth-bound step time
     params = jax.tree_util.tree_map(

@@ -58,32 +58,42 @@ class CheckpointManager:
     def restore_params(self, params_template: Any,
                        step: Optional[int] = None) -> Any:
         """Restore ONLY the params subtree from a full-TrainState
-        checkpoint (e.g. for serving: the decode model wants weights,
-        not optimizer moments). Materializes the raw saved tree on
-        host first — fine for serving-sized models; shard-aware full
-        restore (``restore``) is the path for resuming training."""
+        checkpoint (serving wants weights, not optimizer moments).
+
+        Key-matched partial restore: the optimizer state is never read
+        off disk, and each weight lands directly on the sharding its
+        template leaf carries (ShapeDtypeStruct with ``sharding=`` or a
+        placed array) — no host-side full-model materialization, which
+        is what makes restoring an 8B model for serving feasible.
+        Mismatched key paths or shapes fail loudly inside orbax."""
+        import os
+
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             return None
-        raw = self.manager.restore(step)
-        params = raw["params"] if isinstance(raw, dict) else raw.params
-        template_leaves, treedef = jax.tree_util.tree_flatten(params_template)
-        leaves = jax.tree_util.tree_leaves(params)
-        if len(leaves) != len(template_leaves):
-            raise ValueError(
-                f"checkpoint params tree has {len(leaves)} leaves, "
-                f"template has {len(template_leaves)} — different model?"
+
+        def to_abstract(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
             )
-        for i, (got, want) in enumerate(zip(leaves, template_leaves)):
-            if tuple(got.shape) != tuple(want.shape):
-                # catch architecture mismatches here with a clear error
-                # instead of deep inside the first jitted apply
-                raise ValueError(
-                    f"checkpoint leaf {i} has shape {tuple(got.shape)}, "
-                    f"template expects {tuple(want.shape)} — different "
-                    "model configuration?"
-                )
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        abstract = {
+            "params": jax.tree_util.tree_map(to_abstract, params_template)
+        }
+        restore_args = self._ocp.checkpoint_utils.construct_restore_args(
+            abstract
+        )
+        item_dir = os.path.join(str(self.manager.directory), str(step),
+                                "default")
+        out = self._ocp.PyTreeCheckpointer().restore(
+            item_dir,
+            args=self._ocp.args.PyTreeRestore(
+                abstract, restore_args=restore_args, partial_restore=True
+            ),
+        )
+        return out["params"]
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()

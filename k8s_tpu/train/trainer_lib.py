@@ -49,6 +49,20 @@ def shardings_from_logical(init_fn, mesh: Mesh, rules: LogicalRules):
     return shardings
 
 
+def init_sharded_variables(init_fn, mesh: Mesh, rules: LogicalRules):
+    """jit-initialize a boxed-variables ``init_fn`` with every leaf
+    placed per the rules (explicit out_shardings — nothing is ever
+    materialized on one device). Returns ``(variables, shardings)``,
+    both unboxed. Shared by training state creation and sharded
+    serving init."""
+    unboxed_shardings = nn.unbox(shardings_from_logical(init_fn, mesh, rules))
+    with nn.logical_axis_rules(rules.to_flax()):
+        variables = jax.jit(
+            lambda: nn.unbox(init_fn()), out_shardings=unboxed_shardings
+        )()
+    return variables, unboxed_shardings
+
+
 def create_sharded_state(
     model: nn.Module,
     optimizer: optax.GradientTransformation,
@@ -69,14 +83,9 @@ def create_sharded_state(
     def boxed_init():
         return model.init(rng, example_batch, **init_kwargs)
 
-    shardings = shardings_from_logical(boxed_init, mesh, rules)
-
-    def unboxed_init():
-        return nn.unbox(boxed_init())
-
-    unboxed_shardings = nn.unbox(shardings)
-    with nn.logical_axis_rules(rules.to_flax()):
-        variables = jax.jit(unboxed_init, out_shardings=unboxed_shardings)()
+    variables, unboxed_shardings = init_sharded_variables(
+        boxed_init, mesh, rules
+    )
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     param_shardings = unboxed_shardings["params"]
