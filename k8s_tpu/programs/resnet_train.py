@@ -39,7 +39,15 @@ def main(rdzv) -> None:
 
         from k8s_tpu.data.records import image_record_batches
 
-        paths = sorted(_glob.glob(f"{data_dir}/*.rec"))
+        all_paths = sorted(_glob.glob(f"{data_dir}/*.rec"))
+        # eval-*.rec shards are held out for --eval_every; the rest train
+        import os as _os
+
+        def _is_eval(p):
+            return _os.path.basename(p).startswith("eval-")
+
+        eval_paths = [p for p in all_paths if _is_eval(p)]
+        paths = [p for p in all_paths if not _is_eval(p)]
         n_proc = max(rdzv.num_processes, 1)
         if not paths:
             raise FileNotFoundError(f"no .rec shards under {data_dir}")
@@ -85,21 +93,83 @@ def main(rdzv) -> None:
         if restored is not None:
             state = restored
 
-    def loss_fn(state, params, b, rng):
-        images = b["images"]
+    def _prep_images(images):
         if images.dtype == jnp.uint8:
             # record batches arrive uint8 (4x less host→device traffic
             # than f32); normalize on device where bandwidth is free
-            images = images.astype(jnp.float32) / 127.5 - 1.0
+            return images.astype(jnp.float32) / 127.5 - 1.0
+        return images
+
+    def loss_fn(state, params, b, rng):
         logits, mutated = state.apply_fn(
             {"params": params, "batch_stats": state.batch_stats},
-            images, train=True, mutable=["batch_stats"],
+            _prep_images(b["images"]), train=True, mutable=["batch_stats"],
         )
         return cross_entropy_loss(logits, b["labels"]), {
             "batch_stats": mutated["batch_stats"]
         }
 
     step_fn = make_train_step(loss_fn, mesh, rules)
+
+    # held-out evaluation: --eval_every=N runs --eval_steps batches in
+    # inference mode (running batch stats) and logs loss + top-1 — the
+    # measurement side of the "ResNet-50 to 76% top-1" north star
+    eval_every = int((cfg.extra or {}).get("eval_every", "0"))
+    eval_steps = int((cfg.extra or {}).get("eval_steps", "4"))
+    if eval_every:
+        from k8s_tpu.train import make_eval_step
+
+        def eval_loss_fn(state, params, b, rng):
+            logits = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                _prep_images(b["images"]), train=False,
+            )
+            top1 = jnp.mean(
+                (jnp.argmax(logits, -1) == b["labels"]).astype(jnp.float32)
+            )
+            return cross_entropy_loss(logits, b["labels"]), {"top1": top1}
+
+        eval_step_fn = make_eval_step(eval_loss_fn, mesh, rules)
+        # held-out stream: eval shards when training from records,
+        # otherwise a different synthetic seed
+        if data_dir:
+            n_proc = max(rdzv.num_processes, 1)
+            if not eval_paths:
+                # real training data but no held-out shards: random
+                # synthetic eval would log noise AS the north-star
+                # metric — refuse instead
+                raise FileNotFoundError(
+                    f"--eval_every set but no eval-*.rec shards under "
+                    f"{data_dir} (write them with "
+                    "write_image_shards(prefix='eval'))"
+                )
+            if len(eval_paths) < n_proc:
+                # same guard as the train path: an empty per-process
+                # shard EOFs that rank and deadlocks the others
+                raise ValueError(
+                    f"{len(eval_paths)} eval shard(s) but {n_proc} "
+                    "processes — write at least one eval shard per "
+                    "process"
+                )
+            eval_data = image_record_batches(
+                eval_paths, cfg.batch_size, image_size,
+                shard_id=max(rdzv.process_id, 0),
+                num_shards=n_proc,
+            )
+        else:
+            eval_data = synthetic_image_batches(
+                cfg.batch_size, image_size,
+                num_classes=100 if tiny else 1000, seed=1,
+            )
+
+        def run_eval(state):
+            loss = top1 = 0.0
+            for _ in range(eval_steps):
+                m = eval_step_fn(state, next(eval_data), rng)
+                loss += float(m["loss"])
+                top1 += float(m["top1"])
+            return loss / eval_steps, top1 / eval_steps
+
     logger = MetricLogger(rdzv, "resnet50")
     rng = jax.random.PRNGKey(1)
     start = int(state.step)
@@ -107,6 +177,9 @@ def main(rdzv) -> None:
         state, metrics = step_fn(state, next(data), rng)
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(metrics["loss"])})
+        if eval_every and (step % eval_every == 0 or step == cfg.steps):
+            eval_loss, eval_top1 = run_eval(state)
+            logger.log(step, {"eval_loss": eval_loss, "eval_top1": eval_top1})
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
     if mgr is not None:
