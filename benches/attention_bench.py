@@ -41,32 +41,51 @@ def bench(fn, q, k, v, iters=50):
     return best / iters * 1000
 
 
-for seq in (1024, 2048, 4096, 8192):
-    B, HQ, HKV, D = 4, 12, 4, 128
-    q = jax.random.normal(jax.random.PRNGKey(0), (B, seq, HQ, D), jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (B, seq, HKV, D), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (B, seq, HKV, D), jnp.bfloat16)
+def main(argv=None) -> int:
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        seqs, iters, interpret = (1024, 2048, 4096, 8192), 50, False
+    else:
+        # off-TPU smoke (incl. GPU — the pallas kernels here are
+        # TPU-Mosaic): interpret mode, one tiny row, rows marked
+        # "interpret" so they can never be mistaken for measurements
+        seqs, iters, interpret = (256,), 1, True
 
-    fa = lambda q, k, v: flash_attention(q, k, v, causal=True, use_pallas=True)
-    ref = lambda q, k, v: mha_reference(q, k, v, causal=True)
-    fa_g = jax.grad(
-        lambda q, k, v: flash_attention(q, k, v, causal=True, use_pallas=True)
-        .astype(jnp.float32).sum(), argnums=(0, 1, 2))
-    ref_g = jax.grad(
-        lambda q, k, v: mha_reference(q, k, v, causal=True)
-        .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+    for seq in seqs:
+        B, HQ, HKV, D = (4, 12, 4, 128) if on_tpu else (1, 2, 1, 128)
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, seq, HQ, D), jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, seq, HKV, D), jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, seq, HKV, D), jnp.bfloat16)
 
-    row = {"seq": seq}
-    row["fwd_flash_ms"] = round(bench(fa, q, k, v), 3)
-    try:
-        row["fwd_xla_ms"] = round(bench(ref, q, k, v), 3)
-        row["fwd_speedup"] = round(row["fwd_xla_ms"] / row["fwd_flash_ms"], 2)
-    except Exception:
-        row["fwd_xla_ms"], row["fwd_speedup"] = None, "xla-oom"
-    row["fwdbwd_flash_ms"] = round(bench(fa_g, q, k, v), 3)
-    try:
-        row["fwdbwd_xla_ms"] = round(bench(ref_g, q, k, v), 3)
-        row["fwdbwd_speedup"] = round(row["fwdbwd_xla_ms"] / row["fwdbwd_flash_ms"], 2)
-    except Exception:
-        row["fwdbwd_xla_ms"], row["fwdbwd_speedup"] = None, "xla-oom"
-    print(json.dumps(row))
+        fa = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, use_pallas=True, interpret=interpret)
+        ref = lambda q, k, v: mha_reference(q, k, v, causal=True)
+        fa_g = jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, use_pallas=True, interpret=interpret)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+        ref_g = jax.grad(
+            lambda q, k, v: mha_reference(q, k, v, causal=True)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+        row = {"seq": seq}
+        if interpret:
+            row["mode"] = "interpret-smoke"  # wiring check, NOT perf
+        row["fwd_flash_ms"] = round(bench(fa, q, k, v, iters), 3)
+        try:
+            row["fwd_xla_ms"] = round(bench(ref, q, k, v, iters), 3)
+            row["fwd_speedup"] = round(row["fwd_xla_ms"] / row["fwd_flash_ms"], 2)
+        except Exception:
+            row["fwd_xla_ms"], row["fwd_speedup"] = None, "xla-oom"
+        row["fwdbwd_flash_ms"] = round(bench(fa_g, q, k, v, iters), 3)
+        try:
+            row["fwdbwd_xla_ms"] = round(bench(ref_g, q, k, v, iters), 3)
+            row["fwdbwd_speedup"] = round(row["fwdbwd_xla_ms"] / row["fwdbwd_flash_ms"], 2)
+        except Exception:
+            row["fwdbwd_xla_ms"], row["fwdbwd_speedup"] = None, "xla-oom"
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""The bench CLIs are driver-facing surfaces (docs/BENCHMARKS.md rows
+come from them) — smoke them on the CPU backend so they cannot rot.
+Each auto-shrinks off-accelerator; we only assert they run and emit
+their JSON line."""
+
+import json
+
+import pytest
+
+
+def _last_json_line(capsys):
+    lines = [
+        l for l in capsys.readouterr().out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert lines, "no JSON output"
+    return json.loads(lines[-1])
+
+
+class TestBenches:
+    def test_llama_bench(self, capsys):
+        from benches import llama_bench
+
+        assert llama_bench.main([]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "llama_train_tokens_per_sec_per_chip"
+        assert out["value"] > 0
+
+    def test_llama_bench_quant_and_unfused(self, capsys):
+        from benches import llama_bench
+
+        assert llama_bench.main(["--quant", "int8", "--no-fused-ce"]) == 0
+        assert _last_json_line(capsys)["value"] > 0
+
+    def test_bert_bench(self, capsys):
+        from benches import bert_bench
+
+        assert bert_bench.main([]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "bert_train_seqs_per_sec_per_chip"
+        assert out["value"] > 0
+
+    def test_decode_bench(self, capsys):
+        from benches import decode_bench
+
+        assert decode_bench.main([]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "llama_decode_tokens_per_sec"
+        assert out["value"] > 0
+
+    def test_loader_bench(self, capsys):
+        from benches import loader_bench
+
+        assert loader_bench.main(
+            ["--record-bytes", "1024", "--records-per-shard", "64",
+             "--shards", "2", "--batch", "8", "--epochs", "1"]
+        ) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "native_loader_throughput_mb_per_sec"
+        assert set(out["modes"]) == {
+            "copy+shuffle", "copy", "zero_copy+shuffle", "zero_copy"
+        }
+
+    def test_attention_bench(self, capsys):
+        from benches import attention_bench
+
+        assert attention_bench.main([]) == 0
+        out = _last_json_line(capsys)
+        assert out["seq"] == 256
+        assert out["mode"] == "interpret-smoke"
+        assert out["fwd_flash_ms"] > 0 and out["fwdbwd_flash_ms"] > 0
