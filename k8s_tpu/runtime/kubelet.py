@@ -132,6 +132,21 @@ class SubprocessExecutor:
         if cmd and cmd[0] == "python":
             cmd[0] = sys.executable
         full_env = {**os.environ, **self.extra_env, **env}
+        # a real pod's image has the package installed; the local
+        # subprocess must be able to import k8s_tpu (program dispatch,
+        # KTPU_PROGRAM=module:fn) even when the parent got it via
+        # pytest's rootdir rather than PYTHONPATH
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        prev = full_env.get("PYTHONPATH", "")
+        if repo_root not in prev.split(os.pathsep):
+            # APPEND: this is only a fallback for when the package
+            # isn't otherwise importable — prepending would shadow a
+            # user's own PYTHONPATH overrides with repo_root's contents
+            full_env["PYTHONPATH"] = (
+                (prev + os.pathsep if prev else "") + repo_root
+            )
         stdout = None
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)

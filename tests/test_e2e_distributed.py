@@ -23,8 +23,10 @@ from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
 from k8s_tpu import spec as S
 
 
-@pytest.mark.integration
-def test_distributed_smoke_job(tmp_path):
+def _run_two_worker_job(tmp_path, name, extra_env=None, timeout=240):
+    """Shared harness: operator + local kubelet with real subprocess
+    pods, one bare 2-worker TpuJob (the operator synthesizes the
+    launcher — default-PS analogue). Returns (job, worker0_log)."""
     cluster = InMemoryCluster()
     client = KubeClient(cluster)
     jc = TpuJobClient(cluster)
@@ -35,37 +37,60 @@ def test_distributed_smoke_job(tmp_path):
             "KTPU_FORCE_PLATFORM": "cpu",
             "KTPU_NUM_CPU_DEVICES": "2",
             "KTPU_INIT_TIMEOUT": "60",
+            **(extra_env or {}),
         },
     )
     kubelet = LocalKubelet(client, executor)
     kubelet.start()
     controller.start()
     try:
-        # pure default job: a bare 2-worker spec, no template — the
-        # operator synthesizes the launcher (default-PS analogue)
         j = S.TpuJob()
-        j.metadata.name = "smoke"
+        j.metadata.name = name
         j.metadata.namespace = "default"
         j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
-        t0 = time.monotonic()
         jc.create(j)
-        job = controller.wait_for_job("default", "smoke", timeout=180)
-        first_step_latency = time.monotonic() - t0
+        job = controller.wait_for_job("default", name, timeout=timeout)
         assert job.status.state == S.TpuJobState.SUCCEEDED, _logs(tmp_path)
-        # both workers ran and the smoke check passed on worker 0
-        log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0)
-        assert '"event": "smoke_ok"' in log0, log0
-        assert '"devices": 4' in log0  # 2 procs × 2 devices aggregated
-        print(f"create→done latency: {first_step_latency:.1f}s")
+        return job, _read_worker_log(tmp_path, job.spec.runtime_id, 0, name=name)
     finally:
         controller.stop()
         kubelet.stop()
 
 
-def _read_worker_log(tmp_path, rid, idx):
+@pytest.mark.integration
+def test_distributed_smoke_job(tmp_path):
+    t0 = time.monotonic()
+    job, log0 = _run_two_worker_job(tmp_path, "smoke", timeout=180)
+    first_step_latency = time.monotonic() - t0
+    # both workers ran and the smoke check passed on worker 0
+    assert '"event": "smoke_ok"' in log0, log0
+    assert '"devices": 4' in log0  # 2 procs × 2 devices aggregated
+    print(f"create→done latency: {first_step_latency:.1f}s")
+
+
+@pytest.mark.integration
+def test_distributed_training_job(tmp_path):
+    """Beyond the smoke check: an actual sharded TRAIN program runs
+    across 2 real processes (4 global CPU devices) — params replicated,
+    batch data-sharded, gradient psum over the loopback ring — and the
+    job reaches Succeeded with training metrics logged."""
+    _, log0 = _run_two_worker_job(
+        tmp_path, "train",
+        extra_env={
+            "KTPU_PROGRAM": "k8s_tpu.programs.mnist_train:main",
+            "KTPU_PROGRAM_ARGS": "--steps=3 --batch_size=8 --log_every=1",
+        },
+    )
+    assert '"run": "mnist"' in log0, log0
+    assert '"step": 3' in log0, log0
+
+
+def _read_worker_log(tmp_path, rid, idx, name="smoke"):
     import glob
 
-    pats = glob.glob(str(tmp_path / "logs" / f"smoke-worker-{rid}-{idx}-pod-*.log"))
+    pats = glob.glob(
+        str(tmp_path / "logs" / f"{name}-worker-{rid}-{idx}-pod-*.log")
+    )
     return "\n".join(open(p).read() for p in sorted(pats))
 
 
