@@ -44,6 +44,11 @@ log = logging.getLogger(__name__)
 
 DEFAULT_BACKOFF_LIMIT = 3
 
+# parent of the k8s_tpu package (source tree or install dir)
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -136,16 +141,13 @@ class SubprocessExecutor:
         # subprocess must be able to import k8s_tpu (program dispatch,
         # KTPU_PROGRAM=module:fn) even when the parent got it via
         # pytest's rootdir rather than PYTHONPATH
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
         prev = full_env.get("PYTHONPATH", "")
-        if repo_root not in prev.split(os.pathsep):
+        if _REPO_ROOT not in prev.split(os.pathsep):
             # APPEND: this is only a fallback for when the package
             # isn't otherwise importable — prepending would shadow a
             # user's own PYTHONPATH overrides with repo_root's contents
             full_env["PYTHONPATH"] = (
-                (prev + os.pathsep if prev else "") + repo_root
+                (prev + os.pathsep if prev else "") + _REPO_ROOT
             )
         stdout = None
         if self.log_dir:
