@@ -28,10 +28,25 @@ class BertConfig:
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
     dtype: jnp.dtype = jnp.bfloat16
+    # HuggingFace-compatible heads: MLM transform (dense+gelu+LN before
+    # the decoder, decoder with bias) and NSP pooler (dense+tanh on
+    # [CLS]) — required to import pretrained HF BERT weights
+    # (k8s_tpu/tools/hf_import.py). Off by default: the plain heads are
+    # leaner for from-scratch pretraining.
+    hf_head: bool = False
+    # encoder gelu variant: None derives from hf_head (HF BERT uses the
+    # exact erf gelu; the tanh approximation is marginally cheaper).
+    # Set explicitly when fine-tuning a checkpoint across head configs
+    # so the activation never changes out from under trained weights.
+    exact_gelu: "bool | None" = None
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def use_exact_gelu(self) -> bool:
+        return self.hf_head if self.exact_gelu is None else self.exact_gelu
 
     @staticmethod
     def base(**kw) -> "BertConfig":
@@ -85,7 +100,8 @@ class BertLayer(nn.Module):
         )(attn)
         x = ln1(x + attn)
         y = _dense(cfg.intermediate_size, ("embed", "mlp"), "fc_in", cfg.dtype)(x)
-        y = nn.gelu(y)
+        # exact erf gelu matches HF BERT weights (cfg.use_exact_gelu)
+        y = nn.gelu(y, approximate=not cfg.use_exact_gelu)
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc_out", cfg.dtype)(y)
         return ln2(x + y)
@@ -124,6 +140,37 @@ class BertForPretraining(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_embed")(x)
         for i in range(cfg.num_layers):
             x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        if cfg.hf_head:
+            # HF-compatible heads: the MLM transform runs BEFORE the
+            # decoder, so return_hidden hands back the transformed
+            # hidden states (feed fused CE with the decoder kernel AND
+            # its bias); NSP goes through the tanh pooler
+            t = nn.Dense(cfg.hidden_size, dtype=jnp.float32,
+                         name="mlm_transform")(x)
+            t = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                             name="mlm_transform_ln")(
+                nn.gelu(t, approximate=False)
+            )
+            pooled = nn.tanh(
+                nn.Dense(cfg.hidden_size, dtype=jnp.float32, name="pooler")(
+                    x[:, 0]
+                )
+            )
+            nsp_logits = nn.Dense(2, dtype=jnp.float32,
+                                  name="nsp_head")(pooled)
+            if return_hidden:
+                return t, nsp_logits
+            mlm_logits = nn.DenseGeneral(
+                features=cfg.vocab_size, dtype=jnp.float32,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+                ),
+                name="mlm_head",
+            )(t)
+            return mlm_logits, nsp_logits
+
         if return_hidden:
             nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_head")(x[:, 0])
             return x, nsp_logits

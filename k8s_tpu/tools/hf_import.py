@@ -35,6 +35,16 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _take(sd: Mapping[str, Any], name: str, shape) -> np.ndarray:
+    """Fetch + shape-check one weight (shared by all converters)."""
+    w = _np(sd[name])
+    if tuple(w.shape) != tuple(shape):
+        raise ValueError(
+            f"{name}: HF shape {tuple(w.shape)} != expected {shape}"
+        )
+    return w
+
+
 def convert_hf_llama(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     """Convert a HF Llama ``state_dict`` to a ``LlamaForCausalLM``
     params tree for ``cfg`` (``LlamaConfig``). Requires
@@ -50,15 +60,10 @@ def convert_hf_llama(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     )
     L = cfg.num_layers
 
-    sd = {k: v for k, v in state_dict.items()}
+    sd = dict(state_dict)
 
     def take(name, shape):
-        w = _np(sd[name])
-        if tuple(w.shape) != tuple(shape):
-            raise ValueError(
-                f"{name}: HF shape {tuple(w.shape)} != expected {shape}"
-            )
-        return w
+        return _take(sd, name, shape)
 
     def stack(fmt, convert):
         return jnp.asarray(
@@ -114,4 +119,96 @@ def convert_hf_llama(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
         "lm_head": {"kernel": jnp.asarray(
             take(head_name, (cfg.vocab_size, e)).T)},
     }
+    return params
+
+
+def convert_hf_bert(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """Convert a HF ``BertForPreTraining`` state_dict to a
+    ``BertForPretraining`` params tree. Requires
+    ``BertConfig(hf_head=True)`` (the HF MLM transform + NSP pooler
+    exist only in that mode)."""
+    if not getattr(cfg, "hf_head", False):
+        raise ValueError(
+            "convert_hf_bert needs BertConfig(hf_head=True) — the plain "
+            "heads have no HF-equivalent transform/pooler weights"
+        )
+    e, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    sd = dict(state_dict)
+
+    def take(name, shape):
+        return _take(sd, name, shape)
+
+    def dense(prefix, out_f, in_f):  # torch [out, in] -> flax kernel/bias
+        return {
+            "kernel": jnp.asarray(take(prefix + ".weight", (out_f, in_f)).T),
+            "bias": jnp.asarray(take(prefix + ".bias", (out_f,))),
+        }
+
+    def heads_dense(prefix):  # [H*d, E] -> kernel [E, H, d], bias [H, d]
+        return {
+            "kernel": jnp.asarray(
+                take(prefix + ".weight", (h * d, e)).T.reshape(e, h, d)
+            ),
+            "bias": jnp.asarray(take(prefix + ".bias", (h * d,)).reshape(h, d)),
+        }
+
+    def ln(prefix):
+        return {
+            "scale": jnp.asarray(take(prefix + ".weight", (e,))),
+            "bias": jnp.asarray(take(prefix + ".bias", (e,))),
+        }
+
+    params: Dict[str, Any] = {
+        "tok_embed": {"embedding": jnp.asarray(take(
+            "bert.embeddings.word_embeddings.weight", (cfg.vocab_size, e)))},
+        "pos_embed": {"embedding": jnp.asarray(take(
+            "bert.embeddings.position_embeddings.weight",
+            (cfg.max_seq_len, e)))},
+        "type_embed": {"embedding": jnp.asarray(take(
+            "bert.embeddings.token_type_embeddings.weight",
+            (cfg.type_vocab_size, e)))},
+        "ln_embed": ln("bert.embeddings.LayerNorm"),
+        "mlm_transform": dense("cls.predictions.transform.dense", e, e),
+        "mlm_transform_ln": ln("cls.predictions.transform.LayerNorm"),
+        "pooler": dense("bert.pooler.dense", e, e),
+        "nsp_head": dense("cls.seq_relationship", 2, e),
+    }
+    # decoder: weight may be tied to word embeddings; bias lives at
+    # cls.predictions.bias (and/or cls.predictions.decoder.bias)
+    dec_w = (
+        "cls.predictions.decoder.weight"
+        if "cls.predictions.decoder.weight" in sd
+        else "bert.embeddings.word_embeddings.weight"
+    )
+    dec_b = (
+        "cls.predictions.decoder.bias"
+        if "cls.predictions.decoder.bias" in sd
+        else "cls.predictions.bias"
+    )
+    params["mlm_head"] = {
+        "kernel": jnp.asarray(take(dec_w, (cfg.vocab_size, e)).T),
+        "bias": jnp.asarray(take(dec_b, (cfg.vocab_size,))),
+    }
+    p = "bert.encoder.layer.{}."
+    for i in range(cfg.num_layers):
+        q = p.format(i)
+        params[f"layer_{i}"] = {
+            "q_proj": heads_dense(q + "attention.self.query"),
+            "k_proj": heads_dense(q + "attention.self.key"),
+            "v_proj": heads_dense(q + "attention.self.value"),
+            "o_proj": {
+                "kernel": jnp.asarray(
+                    take(q + "attention.output.dense.weight", (e, e))
+                    .T.reshape(h, d, e)
+                ),
+                "bias": jnp.asarray(
+                    take(q + "attention.output.dense.bias", (e,))
+                ),
+            },
+            "ln_attn": ln(q + "attention.output.LayerNorm"),
+            "fc_in": dense(q + "intermediate.dense",
+                           cfg.intermediate_size, e),
+            "fc_out": dense(q + "output.dense", e, cfg.intermediate_size),
+            "ln_mlp": ln(q + "output.LayerNorm"),
+        }
     return params

@@ -295,6 +295,50 @@ class TestPrograms:
         rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
         assert rel < 2e-3, rel
 
+    def test_hf_bert_import_logit_equivalence(self):
+        # pretrained BERT weights: hf_head mode adds the HF MLM
+        # transform + NSP pooler, so a BertForPreTraining state_dict
+        # converts with full logit equivalence (MLM and NSP)
+        import jax.numpy as jnp
+        import numpy as np
+        import torch
+        from transformers import (
+            BertConfig as HfCfg,
+            BertForPreTraining as HfBert,
+        )
+
+        from k8s_tpu.models import BertConfig, BertForPretraining
+        from k8s_tpu.tools.hf_import import convert_hf_bert
+
+        hf_cfg = HfCfg(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, type_vocab_size=2,
+            layer_norm_eps=1e-12, hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        hf = HfBert(hf_cfg).eval()
+
+        cfg = BertConfig.tiny(dtype=jnp.float32, hf_head=True)
+        model = BertForPretraining(cfg)
+        params = convert_hf_bert(hf.state_dict(), cfg)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 512, (2, 16))
+        types = np.zeros((2, 16), np.int32)
+        with torch.no_grad():
+            out = hf(torch.tensor(ids), token_type_ids=torch.tensor(types))
+        got_mlm, got_nsp = model.apply(
+            {"params": params}, jnp.asarray(ids),
+            token_type_ids=jnp.asarray(types),
+        )
+        for got, want in (
+            (got_mlm, out.prediction_logits.numpy()),
+            (got_nsp, out.seq_relationship_logits.numpy()),
+        ):
+            rel = np.max(np.abs(np.asarray(got) - want)) / np.max(np.abs(want))
+            assert rel < 2e-3, rel
+
     def test_hf_llama_import_shape_mismatch_raises(self):
         import pytest as _pytest
         import torch
