@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=256)
+    p.add_argument("--quant", default="none", choices=["none", "int8"],
+                   help="int8: W8A8 projections/MLP — measured SLOWER "
+                        "for decode (see docs/BENCHMARKS.md); kept as "
+                        "a measurement knob")
     args = p.parse_args(argv)
 
     on_accel = jax.default_backend() in ("tpu", "gpu")
@@ -41,10 +45,11 @@ def main(argv=None) -> int:
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
             max_seq_len=args.prompt_len + args.new_tokens,
-            remat=False, decode=True,
+            remat=False, decode=True, quant=args.quant,
         )
     else:
-        cfg = LlamaConfig.tiny(decode=True, max_seq_len=64)
+        cfg = LlamaConfig.tiny(decode=True, max_seq_len=64,
+                               quant=args.quant)
         args.batch, args.prompt_len, args.new_tokens = 2, 8, 16
 
     model = LlamaForCausalLM(cfg)
@@ -89,6 +94,7 @@ def main(argv=None) -> int:
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec",
         "batch": args.batch,
+        "quant": args.quant,
         "per_step_ms": round(per_step_ms, 2),
         "params": n_params,
     }

@@ -47,6 +47,14 @@ class TestBenches:
         out = _last_json_line(capsys)
         assert out["metric"] == "llama_decode_tokens_per_sec"
         assert out["value"] > 0
+        assert out["quant"] == "none"
+
+    def test_decode_bench_int8(self, capsys):
+        from benches import decode_bench
+
+        assert decode_bench.main(["--quant", "int8"]) == 0
+        out = _last_json_line(capsys)
+        assert out["value"] > 0 and out["quant"] == "int8"
 
     def test_loader_bench(self, capsys):
         from benches import loader_bench
