@@ -12,6 +12,7 @@ generated tokens.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -33,10 +34,13 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--new-tokens", type=int, default=256)
-    p.add_argument("--quant", default="none", choices=["none", "int8"],
-                   help="int8: W8A8 projections/MLP — measured SLOWER "
-                        "for decode (see docs/BENCHMARKS.md); kept as "
-                        "a measurement knob")
+    p.add_argument("--quant", default="none",
+                   choices=["none", "int8", "int8_serving"],
+                   help="int8: dynamic W8A8 — measured SLOWER for "
+                        "decode (see docs/BENCHMARKS.md). int8_serving: "
+                        "weight-only offline quantization (kernels "
+                        "STORED int8 + per-channel scales) — halves "
+                        "the weight-read bytes that dominate decode")
     args = p.parse_args(argv)
 
     on_accel = jax.default_backend() in ("tpu", "gpu")
@@ -52,6 +56,10 @@ def main(argv=None) -> int:
                                quant=args.quant)
         args.batch, args.prompt_len, args.new_tokens = 2, 8, 16
 
+    serving_int8 = args.quant == "int8_serving"
+    init_cfg = (
+        dataclasses.replace(cfg, quant="none") if serving_int8 else cfg
+    )
     model = LlamaForCausalLM(cfg)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (args.batch, args.prompt_len), 0,
@@ -60,7 +68,9 @@ def main(argv=None) -> int:
     import flax.linen as nn
 
     params = nn.unbox(
-        model.init(jax.random.PRNGKey(0), prompt)["params"]
+        LlamaForCausalLM(init_cfg).init(
+            jax.random.PRNGKey(0), prompt
+        )["params"]
     )
     # inference-cast: serve bf16 weights (training keeps f32 masters) —
     # decode reads every param every step, f32 weights would double the
@@ -70,6 +80,13 @@ def main(argv=None) -> int:
         if x.dtype == jnp.float32 else x,
         params,
     )
+    if serving_int8:
+        from k8s_tpu.ops.quant import quantize_params_for_serving
+
+        # AFTER the cast: the converter's dequant scales must stay f32
+        # (a blanket bf16 cast of per-channel scales would add rounding
+        # the validated numerics never saw)
+        params = quantize_params_for_serving(params)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 
     # warm (compiles prefill + decode loop)
@@ -100,7 +117,10 @@ def main(argv=None) -> int:
     }
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
     if on_accel and gen in HBM_GBPS:
-        param_bytes = 2 * n_params  # bf16 weights read each step
+        # actual stored bytes (bf16 = 2 B; int8_serving kernels = 1 B)
+        param_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(params)
+        )
         kv_bytes = (
             2 * 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
             * cfg.max_seq_len * args.batch

@@ -136,6 +136,14 @@ def _quant_extra(quant: str) -> dict:
 
 
 def _dense(features, axes, name, dtype, quant="none"):
+    if quant == "int8_serving":
+        from k8s_tpu.ops.quant import Int8ServingDense
+
+        # weight-only int8 for decode: kernel STORED int8 (+ scale),
+        # params produced by quantize_params_for_serving
+        return Int8ServingDense(
+            features, n_in=1, dtype=dtype, axes=axes, name=name
+        )
     extra = _quant_extra(quant)
     return nn.DenseGeneral(
         features=features,
@@ -246,22 +254,32 @@ class LlamaAttention(nn.Module):
             )
         else:
             out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
-        out = nn.DenseGeneral(
-            features=cfg.hidden_size,
-            axis=(-2, -1),
-            use_bias=False,
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
-            ),
-            # o_proj deliberately NOT quantized: its K=H*D contraction
-            # is too small to amortize the quantize pass over a fresh
-            # input tensor (q/k/v and gate/up share their input's
-            # quantization via CSE) — measured -4% end-to-end when
-            # quantized vs excluded (docs/BENCHMARKS.md)
-            name="o_proj",
-        )(out)
+        if cfg.quant == "int8_serving":
+            from k8s_tpu.ops.quant import Int8ServingDense
+
+            out = Int8ServingDense(
+                cfg.hidden_size, n_in=2, dtype=cfg.dtype,
+                axes=("heads", "head_dim", "embed"), name="o_proj",
+            )(out)
+        else:
+            out = nn.DenseGeneral(
+                features=cfg.hidden_size,
+                axis=(-2, -1),
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=jnp.float32,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(),
+                    ("heads", "head_dim", "embed"),
+                ),
+                # o_proj deliberately NOT quantized in TRAINING int8
+                # mode: its K=H*D contraction is too small to amortize
+                # the dynamic quantize pass (measured -4% end-to-end,
+                # docs/BENCHMARKS.md). Serving mode quantizes it: the
+                # weights are pre-quantized, so reading them at 1 B is
+                # pure bandwidth win
+                name="o_proj",
+            )(out)
         return out
 
 
@@ -395,6 +413,13 @@ class LlamaForCausalLM(nn.Module):
             return x
         if last_logit_only:
             x = x[:, -1:]
+        if cfg.quant == "int8_serving":
+            from k8s_tpu.ops.quant import Int8ServingDense
+
+            return Int8ServingDense(
+                cfg.vocab_size, n_in=1, dtype=jnp.float32,
+                axes=("embed", "vocab"), name="lm_head",
+            )(x)
         logits = nn.DenseGeneral(
             features=cfg.vocab_size,
             use_bias=False,

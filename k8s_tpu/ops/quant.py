@@ -135,6 +135,30 @@ def _int8_dot_general_impl(
     return out.reshape(out_shape).astype(out_dtype)
 
 
+def int8_serving_matmul(x, kernel_q, scale, n_out_axes):
+    """Inference matmul against an int8-STORED kernel: dynamic per-row
+    activation quantization, int8×int8 MXU dot, dequant by the two
+    scale vectors. ``kernel_q [in..., out...]``, ``scale [out...]``;
+    contraction is over x's trailing axes vs the kernel's leading
+    (in) axes. HBM reads the weights at 1 byte/param — the decode
+    roofline's dominant term halved vs bf16."""
+    in_shape = kernel_q.shape[: kernel_q.ndim - n_out_axes]
+    out_shape = kernel_q.shape[kernel_q.ndim - n_out_axes:]
+    k = 1
+    for s in in_shape:
+        k *= s
+    x2d = x.reshape(-1, k)
+    w2d = kernel_q.reshape(k, -1)
+    qx, sx = _quantize_rows(x2d)
+    acc = jax.lax.dot_general(
+        qx, w2d, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * sx * scale.astype(jnp.float32).reshape(1, -1)
+    lead = x.shape[: x.ndim - len(in_shape)]
+    return out.reshape(*lead, *out_shape)
+
+
 def int8_dot_general(
     lhs: jax.Array,
     rhs: jax.Array,
@@ -153,6 +177,102 @@ def int8_dot_general(
         lhs, rhs, dimension_numbers, precision, preferred_element_type,
         _q8_matmul,
     )
+
+
+def _make_int8_serving_dense():
+    import flax.linen as nn
+    from typing import Optional, Tuple, Union
+
+    class Int8ServingDense(nn.Module):
+        """Dense layer with an int8-STORED kernel (+ per-out-channel
+        f32 scale) for weight-only-quantized serving. Same module names
+        as the bf16 path so :func:`quantize_params_for_serving` trees
+        drop in; param names are ``kernel_q``/``scale``.
+
+        ``n_in``: trailing axes of x that contract (1 everywhere except
+        o_proj's (heads, head_dim)). ``axes``: logical-axis names for
+        the full kernel (same tuples the bf16 DenseGeneral uses), so
+        sharded serving keeps its rule-table PartitionSpecs.
+        """
+
+        features: Union[int, Tuple[int, ...]]
+        n_in: int = 1
+        dtype: Optional[object] = None
+        axes: Optional[Tuple[str, ...]] = None
+
+        @nn.compact
+        def __call__(self, x):
+            feats = (
+                self.features if isinstance(self.features, tuple)
+                else (self.features,)
+            )
+            in_shape = x.shape[x.ndim - self.n_in:]
+            kq_init = nn.initializers.zeros
+            scale_init = nn.initializers.ones
+            if self.axes is not None:
+                kq_init = nn.with_logical_partitioning(kq_init, self.axes)
+                scale_init = nn.with_logical_partitioning(
+                    scale_init, self.axes[-len(feats):]
+                )
+            kq = self.param(
+                "kernel_q", kq_init, (*in_shape, *feats), jnp.int8
+            )
+            scale = self.param("scale", scale_init, feats, jnp.float32)
+            out = int8_serving_matmul(x, kq, scale, len(feats))
+            return out.astype(self.dtype or x.dtype)
+
+    return Int8ServingDense
+
+
+Int8ServingDense = _make_int8_serving_dense()
+
+
+def quantize_params_for_serving(params):
+    """Offline weight-only quantization for decode: rewrite a trained
+    Llama params tree into the ``quant="int8_serving"`` layout — every
+    projection/MLP kernel and lm_head becomes ``kernel_q`` (int8,
+    symmetric per-out-channel) + ``scale`` (f32). Decode is
+    weight-read-bound, so int8-stored weights halve the dominant
+    bandwidth term; activations are quantized dynamically per step
+    (tiny at [B, 1, E]).
+
+    Returns a NEW tree; non-quantized leaves (norms, embed) pass
+    through unchanged.
+    """
+    # module name -> (n trailing "out" axes, per-layer kernel ndim);
+    # extra LEADING axes (the nn.scan layer stack) are batch axes: the
+    # scale keeps them so flax's scan unstacking hands each layer its
+    # own per-channel scales
+    out_axes = {
+        "q_proj": (2, 3), "k_proj": (2, 3), "v_proj": (2, 3),  # [E,H,D]
+        "o_proj": (1, 3),                                      # [H,D,E]
+        "gate_proj": (1, 2), "up_proj": (1, 2), "down_proj": (1, 2),
+        "lm_head": (1, 2),                                     # [E, V]
+    }
+
+    def quantize_kernel(w, n_out, base_ndim):
+        w = jnp.asarray(w, jnp.float32)
+        n_batch = w.ndim - base_ndim  # scan-stacked leading axes
+        in_axes = tuple(range(n_batch, w.ndim - n_out))
+        amax = jnp.max(jnp.abs(w), axis=in_axes, keepdims=True)
+        scale = jnp.maximum(amax, _EPS) / 127.0
+        q = jnp.round(w / scale).astype(jnp.int8)
+        return q, jnp.squeeze(scale, axis=in_axes).astype(jnp.float32)
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k in out_axes and isinstance(v, dict) and "kernel" in v:
+                n_out, base = out_axes[k]
+                q, scale = quantize_kernel(v["kernel"], n_out, base)
+                out[k] = {"kernel_q": q, "scale": scale}
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)
 
 
 def int8_dot_general_bwd8(

@@ -56,6 +56,13 @@ class TestBenches:
         out = _last_json_line(capsys)
         assert out["value"] > 0 and out["quant"] == "int8"
 
+    def test_decode_bench_int8_serving(self, capsys):
+        from benches import decode_bench
+
+        assert decode_bench.main(["--quant", "int8_serving"]) == 0
+        out = _last_json_line(capsys)
+        assert out["value"] > 0 and out["quant"] == "int8_serving"
+
     def test_loader_bench(self, capsys):
         from benches import loader_bench
 

@@ -910,6 +910,35 @@ class TestInt8Quant:
             g_q[1], x.T @ gout, rtol=1e-5, atol=1e-5
         )
 
+    def test_int8_serving_matches_bf16_model(self):
+        """Weight-only serving quantization: quantize_params_for_serving
+        + the int8_serving model reproduce the bf16 model's logits to
+        int8 tolerance, including the scan-stacked per-layer scales."""
+        import flax.linen as fnn
+
+        from k8s_tpu.ops.quant import quantize_params_for_serving
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        model = LlamaForCausalLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+        ref = model.apply({"params": params}, ids)
+
+        import dataclasses as _dc
+
+        smodel = LlamaForCausalLM(_dc.replace(cfg, quant="int8_serving"))
+        sparams = quantize_params_for_serving(params)
+        # kernels really are int8-stored
+        kq = sparams["layers"]["block"]["attn"]["q_proj"]["kernel_q"]
+        assert kq.dtype == jnp.int8
+        got = smodel.apply({"params": sparams}, ids)
+        rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.05, rel
+        agree = float(jnp.mean(
+            (jnp.argmax(got, -1) == jnp.argmax(ref, -1)).astype(jnp.float32)
+        ))
+        assert agree > 0.9, agree
+
     @pytest.mark.parametrize("quant", ["int8", "int8_bwd"])
     def test_quantized_llama_trains(self, quant):
         mesh = build_mesh(MeshConfig(data=8))
