@@ -121,6 +121,19 @@ def main(rdzv) -> None:
         params,
     )
 
+    if extra.get("quant") == "int8_serving":
+        import dataclasses
+
+        from k8s_tpu.ops.quant import quantize_params_for_serving
+
+        # weight-only int8: kernels stored 1 B/param (+29% decode
+        # measured, docs/BENCHMARKS.md); numerics change — validate
+        # output quality per deployment
+        params = quantize_params_for_serving(params)
+        model = LlamaForCausalLM(
+            dataclasses.replace(lcfg, quant="int8_serving")
+        )
+
     # warm round compiles prefill + decode loop (cached across rounds);
     # the logger starts AFTER it so step 1's rate excludes compile time
     toks = generate(model, params, prompt, new_tokens,

@@ -371,6 +371,17 @@ class TestPrograms:
         assert '"run": "llama-generate-tiny"' in out
         assert "tokens_per_sec" in out
 
+    def test_llama_generate_int8_serving(self, capsys):
+        from k8s_tpu.programs import llama_generate
+
+        r = self.FakeRdzv()
+        r.program_args = (
+            "--steps=1 --batch_size=2 --prompt_len=8 --new_tokens=6 "
+            "--quant=int8_serving --log_every=1"
+        )
+        llama_generate.main(r)
+        assert "tokens_per_sec" in capsys.readouterr().out
+
     def test_llama_generate_from_train_checkpoint(self, capsys, tmp_path):
         # train → checkpoint → serve: the decode program restores the
         # trainer's params from a full-TrainState orbax checkpoint
