@@ -106,6 +106,26 @@ def test_distributed_fsdp_llama_job(tmp_path):
     assert '"step": 2' in log0, log0
 
 
+@pytest.mark.integration
+def test_distributed_ring_attention_job(tmp_path):
+    """Context parallelism across REAL processes: fsdp_tp_sp carves a
+    seq=2 axis out of the 2-process × 2-device mesh, so ring attention
+    rotates KV blocks across the process boundary (ppermute over
+    loopback — the ICI pattern at scale)."""
+    _, log0, _ = _run_two_worker_job(
+        tmp_path, "ring",
+        extra_env={
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=2 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp_tp_sp --seq_len=64"
+            ),
+        },
+    )
+    assert '"run": "llama-tiny-fsdp_tp_sp"' in log0, log0
+    assert '"step": 2' in log0, log0
+
+
 def _read_worker_log(tmp_path, rid, idx, name):
     import glob
 

@@ -257,32 +257,44 @@ class TestPrograms:
         }
         assert want == got
 
-    def test_hf_llama_import_logit_equivalence(self):
-        # bring-your-own-weights: a transformers Llama state_dict
-        # converted by hf_import must produce the SAME logits as the
-        # torch model (rotate-half RoPE, GQA head splits, kernel
-        # transposes all verified in one shot)
+    @pytest.mark.parametrize("family", ["llama", "mistral"])
+    def test_hf_causal_lm_import_logit_equivalence(self, family):
+        # bring-your-own-weights: a transformers state_dict converted by
+        # hf_import must produce the SAME logits as the torch model
+        # (rotate-half RoPE, GQA head splits, kernel transposes all
+        # verified in one shot). Mistral is Llama-architecture with the
+        # same HF module naming, so ONE converter serves both families
+        # (sliding window is inert below the window size).
         import jax.numpy as jnp
         import numpy as np
         import torch
-        from transformers import (
-            LlamaConfig as HfCfg,
-            LlamaForCausalLM as HfLlama,
-        )
 
         from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
         from k8s_tpu.tools.hf_import import convert_hf_llama
 
-        hf_cfg = HfCfg(
+        common = dict(
             vocab_size=512, hidden_size=128, intermediate_size=256,
             num_hidden_layers=2, num_attention_heads=4,
             num_key_value_heads=2, head_dim=32,
             max_position_embeddings=256, rope_theta=10000.0,
             rms_norm_eps=1e-5, tie_word_embeddings=False,
-            attention_bias=False, mlp_bias=False,
         )
+        if family == "llama":
+            from transformers import (
+                LlamaConfig as HfCfg,
+                LlamaForCausalLM as HfModel,
+            )
+
+            extra = dict(attention_bias=False, mlp_bias=False)
+        else:
+            from transformers import (
+                MistralConfig as HfCfg,
+                MistralForCausalLM as HfModel,
+            )
+
+            extra = dict(sliding_window=4096)
         torch.manual_seed(0)
-        hf = HfLlama(hf_cfg).eval()
+        hf = HfModel(HfCfg(**common, **extra)).eval()
 
         cfg = LlamaConfig.tiny(dtype=jnp.float32, rope_theta=10000.0)
         model = LlamaForCausalLM(cfg)
