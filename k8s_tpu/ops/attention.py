@@ -37,14 +37,32 @@ import jax
 import jax.numpy as jnp
 
 # Block-size defaults tuned on v5e (d=128, GQA 12/4, fwd+bwd, causal):
-# 512/1024 beats 128/128 by 2.4x at 2k seq and 3.2x at 8k — big blocks
-# amortize grid overhead and fill the MXU; see docs/BENCHMARKS.md.
-# Clamped per-call to the largest divisor of the sequence length
-# (see _fit_block) so off-multiple sequences shrink the block rather
-# than losing the pallas path.
+# big blocks amortize grid overhead and fill the MXU (512/1024 beats
+# the classic 128/128 by 2.4x at 2k and 3.2x at 8k), and the optimum
+# moves with sequence length — measured fwd+bwd: 512/1024 wins at
+# <=2k (1.41x over 1024/1024), 1024/1024 wins beyond (+7% at 4k,
+# +11% at 8-16k, +24% at 32k). ``block_q=None`` picks by seq; see
+# docs/BENCHMARKS.md. Blocks are clamped per-call to the largest
+# divisor of the sequence length (_fit_block) so off-multiple
+# sequences shrink the block rather than losing the pallas path.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+LONG_SEQ_BLOCK_Q = 1024
+LONG_SEQ_THRESHOLD = 4096
 NEG_INF = -1e30
+
+
+def resolve_blocks(sq: int, block_q, block_k):
+    """Seq-dependent block defaults (None → pick by seq; see the
+    tuning note above). Shared by flash_attention and the ring path
+    (which resolves against its LOCAL per-shard length)."""
+    if block_q is None:
+        block_q = (
+            LONG_SEQ_BLOCK_Q if sq >= LONG_SEQ_THRESHOLD else DEFAULT_BLOCK_Q
+        )
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
+    return block_q, block_k
 
 
 def _fit_block(block: int, seq: int, floor: int = 128) -> int:
@@ -597,8 +615,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
     segment_ids: Optional[jax.Array] = None,
@@ -628,6 +646,7 @@ def flash_attention(
             f"sk={sk}"
         )
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q, block_k = resolve_blocks(sq, block_q, block_k)
     # Mosaic tiling constraints: last dim must be lane-aligned (128) and
     # seq lens must fill whole blocks (a partial KV block would feed
     # padding garbage into the online softmax). Blocks shrink to fit the

@@ -44,11 +44,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from k8s_tpu.ops.attention import (
-    DEFAULT_BLOCK_K,
-    DEFAULT_BLOCK_Q,
     _flash_backward,
     _flash_forward,
     compute_dd,
+    resolve_blocks,
 )
 
 NEG_INF = -1e30
@@ -271,8 +270,8 @@ def ring_flash_attention_sharded(
     axis_name: str = "seq",
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ):
     """Per-device flash ring body — call inside ``shard_map``.
@@ -288,6 +287,8 @@ def ring_flash_attention_sharded(
             f"vs {k.shape[1]}"
         )
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    # seq-dependent block defaults against the LOCAL shard length
+    block_q, block_k = resolve_blocks(q.shape[1], block_q, block_k)
     return _ring_flash(
         q, k, v, axis_name, causal, scale, block_q, block_k, interpret
     )
