@@ -71,7 +71,14 @@ class maybe_profile:
 
 class MetricLogger:
     """Step-metrics logger: JSON lines on process 0 stdout (picked up
-    by `kubectl logs` / the kubelet log files) + steps/sec."""
+    by `kubectl logs` / the kubelet log files) + steps/sec.
+
+    When ``KTPU_TB_LOGDIR`` is set (the TpuJob's ``tensorboard.logDir``
+    — the operator ships a TensorBoard Deployment pointed at it),
+    scalars are ALSO written as TB event files under
+    ``<logdir>/<run_name>``, closing the reference's observability loop
+    (the reference relied on user code to emit TF summaries; here the
+    framework's own programs do it)."""
 
     def __init__(self, rdzv, run_name: str):
         self.enabled = rdzv.process_id <= 0
@@ -79,6 +86,20 @@ class MetricLogger:
         self._t0 = time.perf_counter()
         self._last_step = 0
         self._last_t = self._t0
+        self._tb = None
+        logdir = os.environ.get("KTPU_TB_LOGDIR", "")
+        # exactly worker 0 writes TB (process_id == 0): the control
+        # replica (-1) also logs to stdout, and two writers on one run
+        # dir would interleave duplicate scalars
+        if logdir and rdzv.process_id == 0:
+            try:
+                # torch is an optional dependency (setup.py extras
+                # "tensorboard"); absent → stdout JSONL only
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(os.path.join(logdir, run_name))
+            except Exception as e:  # TB writing is best-effort aux
+                print(f"tensorboard writer unavailable: {e}", flush=True)
 
     def log(self, step: int, metrics: Dict[str, float]) -> None:
         if not self.enabled:
@@ -97,3 +118,14 @@ class MetricLogger:
             ),
             flush=True,
         )
+        if self._tb is not None:
+            try:
+                for k, v in metrics.items():
+                    self._tb.add_scalar(k, float(v), step)
+                self._tb.add_scalar("steps_per_sec", steps_per_sec, step)
+                self._tb.flush()
+            except Exception as e:
+                # best-effort aux end to end: a full volume or network
+                # hiccup must never kill the training loop
+                print(f"tensorboard write failed, disabling: {e}", flush=True)
+                self._tb = None

@@ -71,6 +71,8 @@ class RendezvousSpec:
     slice_id: int = 0
     worker_hostnames: Optional[List[str]] = None  # within this slice
     cluster: Optional[Dict[str, List[str]]] = None  # full name map (debug/prober)
+    tb_log_dir: str = ""  # TpuJob tensorboard.logDir: programs write
+    # TB scalar events there (the deployment the operator ships reads it)
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -89,6 +91,8 @@ class RendezvousSpec:
             env["MEGASCALE_NUM_SLICES"] = str(self.num_slices)
             env["MEGASCALE_SLICE_ID"] = str(self.slice_id)
             env["MEGASCALE_COORDINATOR_ADDRESS"] = self.coordinator_address
+        if self.tb_log_dir:
+            env["KTPU_TB_LOGDIR"] = self.tb_log_dir
         return env
 
 
@@ -286,6 +290,10 @@ class TpuReplicaSet:
             slice_id=slice_id,
             worker_hostnames=slice_workers or None,
             cluster=cluster,
+            tb_log_dir=(
+                self.job.job.spec.tensorboard.log_dir
+                if self.job.job.spec.tensorboard is not None else ""
+            ),
         )
 
     # ------------------------------------------------------------- delete

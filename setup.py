@@ -15,6 +15,11 @@ setup(
     install_requires=["numpy", "pyyaml"],
     extras_require={
         "jax": ["jax", "flax", "optax", "orbax-checkpoint", "chex"],
+        # TB scalar event writing from MetricLogger (best-effort aux;
+        # absent → stdout JSONL only)
+        "tensorboard": ["torch"],
+        # HF pretrained-weight import (tools/hf_import.py)
+        "hf": ["torch", "transformers"],
     },
     entry_points={
         "console_scripts": [

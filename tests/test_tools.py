@@ -150,6 +150,21 @@ class TestPrograms:
         num_slices = 1
         program_args = ""
 
+    def test_metric_logger_writes_tensorboard_events(self, tmp_path,
+                                                     monkeypatch, capsys):
+        # KTPU_TB_LOGDIR set → step scalars land as TB event files
+        # under <logdir>/<run> (what the shipped TB Deployment serves)
+        pytest.importorskip("torch.utils.tensorboard")
+        from k8s_tpu.programs.common import MetricLogger
+
+        monkeypatch.setenv("KTPU_TB_LOGDIR", str(tmp_path))
+        logger = MetricLogger(self.FakeRdzv(), "tbrun")
+        logger.log(1, {"loss": 1.5})
+        logger.log(2, {"loss": 1.2})
+        files = glob.glob(str(tmp_path / "tbrun" / "events.out.tfevents.*"))
+        assert files, os.listdir(tmp_path)
+        assert os.path.getsize(files[0]) > 0
+
     def test_mnist_program(self, capsys):
         from k8s_tpu.programs import mnist_train
 

@@ -180,6 +180,18 @@ class TestReplicaSetMaterialization:
         # single-slice: no megascale env
         assert "MEGASCALE_NUM_SLICES" not in env
 
+    def test_tb_logdir_env_injected(self):
+        # tensorboard.logDir reaches worker env so program MetricLoggers
+        # write event files where the TB Deployment reads them
+        client, jc = make_env()
+        tj = make_job(client, jc, worker_replicas=2)
+        tj.job.spec.tensorboard = S.TensorBoardSpec(log_dir="gs://b/logs")
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        w0 = client.jobs.get("default", f"myjob-worker-{tj.job.spec.runtime_id}-0")
+        env = w0.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_TB_LOGDIR"] == "gs://b/logs"
+
     def test_coordinator_not_in_mesh(self):
         client, tj = self._created()
         c0 = client.jobs.get("default", "myjob-coordinator-abcd-0")
