@@ -1,0 +1,93 @@
+"""Operator health + metrics HTTP endpoint.
+
+The chart declares a liveness probe against the operator
+(``chart/templates/deployment.yaml`` -> ``.Values.operator.healthPort``);
+this module is the listener behind it. The reference had no health
+endpoint at all (liveness was "process up"); SURVEY §5 flags metrics as
+a gap to close, and ``controller/metrics.py`` provides the registry —
+this serves it.
+
+Routes:
+  ``/healthz``  -> 200 ``ok`` while the process is live (503 after
+                   ``HealthServer.set_unhealthy()``, e.g. lost leadership
+                   with no re-acquire).
+  ``/metrics``  -> Prometheus text exposition from the process-global
+                   :data:`k8s_tpu.controller.metrics.REGISTRY`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from k8s_tpu.controller import metrics
+
+log = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path in ("/healthz", "/", "/readyz"):
+            healthy = self.server.owner.healthy
+            body = b"ok\n" if healthy else b"unhealthy\n"
+            self.send_response(200 if healthy else 503)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/metrics":
+            body = self.server.owner.registry.expose().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, fmt, *args):  # kubelet probes every few seconds
+        log.debug("health: " + fmt, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    owner: "HealthServer"
+
+
+class HealthServer:
+    """Tiny embedded HTTP server for liveness + /metrics.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    available as :attr:`port` after :meth:`start`.
+    """
+
+    def __init__(self, port: int, registry: Optional[metrics.Registry] = None,
+                 host: str = "0.0.0.0"):
+        self.registry = registry or metrics.REGISTRY
+        self.healthy = True
+        self._server = _Server((host, port), _Handler)
+        self._server.owner = self
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HealthServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="ktpu-health"
+        )
+        self._thread.start()
+        log.info("health endpoint listening on :%d (/healthz, /metrics)", self.port)
+        return self
+
+    def set_unhealthy(self) -> None:
+        self.healthy = False
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -43,6 +43,9 @@ def parse_args(argv=None):
     p.add_argument("--chaos-level", type=int, default=-1,
                    help="chaos monkey aggressiveness; -1 disables")
     p.add_argument("--gc-interval", type=float, default=600.0)
+    p.add_argument("--health-port", type=int, default=8080,
+                   help="liveness + /metrics listener; matches the chart's "
+                        "livenessProbe. -1 disables, 0 = ephemeral port")
     p.add_argument("--namespace", default=None)
     p.add_argument("--local", action="store_true",
                    help="single-host mode: in-memory cluster + local kubelet")
@@ -79,6 +82,12 @@ def main(argv=None) -> int:
     client = get_cluster_client()
     job_client = TpuJobClient(client.cluster)
 
+    health = None
+    if args.health_port >= 0:
+        from k8s_tpu.controller.health import HealthServer
+
+        health = HealthServer(args.health_port).start()
+
     kubelet = None
     if args.local:
         from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
@@ -112,13 +121,19 @@ def main(argv=None) -> int:
         controller.stop()
 
     def on_stopped_leading():
+        # Reference main.go Fatalf-exits here; we additionally flip the
+        # liveness endpoint so the kubelet restarts us even if shutdown wedges.
         log.info("leader election lost")
+        if health is not None:
+            health.set_unhealthy()
 
     try:
         elector.run(on_started_leading, on_stopped_leading, stop=stop)
     finally:
         if kubelet is not None:
             kubelet.stop()
+        if health is not None:
+            health.stop()
     return 0
 
 

