@@ -48,6 +48,7 @@ def fused_lm_head_cross_entropy(
     z_loss: float = 0.0,
     target_chunk: int = 8192,
     bias: Optional[jax.Array] = None,  # [V] head bias (BERT-style heads)
+    compute_dtype: Optional[jnp.dtype] = None,
 ) -> jax.Array:
     """Mean token cross-entropy of ``softmax(hidden @ kernel + bias)``
     vs ``labels``, computed without materializing the full logits.
@@ -58,11 +59,18 @@ def fused_lm_head_cross_entropy(
     a bias (e.g. BERT's MLM head) MUST pass it — omitting it both
     shifts the loss and freezes the bias at its initialization (zero
     gradient).
+
+    ``compute_dtype`` sets the head-matmul input dtype. The default
+    (``hidden.dtype``, i.e. bf16 in training) differs from the unfused
+    DenseGeneral heads, which compute in f32 — a deliberate speed
+    default since accumulation stays f32 either way; pass
+    ``jnp.float32`` for bit-closer parity with the unfused loss (small
+    vocabs, parity tests).
     """
     e, v = kernel.shape
     num_chunks = _pick_num_chunks(v, target_chunk)
     vc = -(-v // num_chunks)  # chunk size, last chunk possibly padded
-    cdt = hidden.dtype
+    cdt = compute_dtype if compute_dtype is not None else hidden.dtype
 
     pad = num_chunks * vc - v
     if pad:

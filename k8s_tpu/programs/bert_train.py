@@ -39,7 +39,10 @@ def main(rdzv) -> None:
     )
 
     # default on: MLM head fused into the CE (no [B,S,V] logits);
-    # fused_ce=0 falls back to the materialized-logits loss
+    # fused_ce=0 falls back to the materialized-logits loss. NOTE the
+    # fused head matmul runs in the activations' dtype (bf16), not the
+    # unfused DenseGeneral's f32 — pass compute_dtype=jnp.float32 to
+    # fused_lm_head_cross_entropy for bit-closer parity.
     fused_ce = (cfg.extra or {}).get("fused_ce", "1") not in ("0", "false")
 
     def loss_fn(state, params, b, rng):

@@ -91,7 +91,9 @@ def main(rdzv) -> None:
 
     # default on: fuses the lm_head matmul into the loss so the
     # [B, S, V] logits never materialize — required headroom at 128k
-    # vocab, and less HBM traffic at any vocab
+    # vocab, and less HBM traffic at any vocab. The fused head matmul
+    # runs in bf16 (vs the unfused lm_head's f32); accumulation is f32
+    # either way — see fused_lm_head_cross_entropy(compute_dtype=...).
     fused_ce = extra.get("fused_ce", "1") not in ("0", "false")
 
     def loss_fn(state, params, b, rng):

@@ -151,21 +151,57 @@ def main(rdzv) -> None:
                     "processes — write at least one eval shard per "
                     "process"
                 )
-            eval_data = image_record_batches(
-                eval_paths, cfg.batch_size, image_size,
-                shard_id=max(rdzv.process_id, 0),
-                num_shards=n_proc,
-            )
+            # All SPMD processes must call eval_step_fn in lockstep, so
+            # the number of eval batches must be agreed globally. Every
+            # process sees the same sorted eval_paths and the loader's
+            # file split is idx % num_shards, so each process computes
+            # every shard's full-batch count from file sizes alone — no
+            # collective needed.
+            from k8s_tpu.data.records import record_bytes as _rb
+
+            rb = _rb(image_size)
+
+            def _shard_batches(s):
+                recs = sum(
+                    _os.path.getsize(p) // rb
+                    for i, p in enumerate(eval_paths) if i % n_proc == s
+                )
+                return recs // cfg.batch_size
+
+            avail = min(_shard_batches(s) for s in range(n_proc))
+            if avail == 0:
+                raise ValueError(
+                    "an eval shard holds fewer than batch_size records "
+                    f"({cfg.batch_size}); a silent 0.0 eval metric would "
+                    "be worse than failing — write bigger eval shards or "
+                    "lower batch_size"
+                )
+            eval_steps = min(eval_steps, avail)
+
+            def make_eval_iter():
+                # Fresh iterator per eval invocation: every eval sees the
+                # SAME records from the start of the held-out set, not a
+                # rotating window of a looping stream. drop_remainder
+                # keeps batch shapes static across processes; up to
+                # batch_size-1 tail records per shard are not scored.
+                return image_record_batches(
+                    eval_paths, cfg.batch_size, image_size,
+                    shard_id=max(rdzv.process_id, 0),
+                    num_shards=n_proc, loop=False, drop_remainder=True,
+                )
         else:
-            eval_data = synthetic_image_batches(
-                cfg.batch_size, image_size,
-                num_classes=100 if tiny else 1000, seed=1,
-            )
+            def make_eval_iter():
+                # deterministic synthetic stream, same batches every eval
+                return synthetic_image_batches(
+                    cfg.batch_size, image_size,
+                    num_classes=100 if tiny else 1000, seed=1,
+                )
 
         def run_eval(state):
             loss = top1 = 0.0
-            for _ in range(eval_steps):
-                m = eval_step_fn(state, next(eval_data), rng)
+            it = make_eval_iter()
+            for _ in range(eval_steps):  # identical count on every process
+                m = eval_step_fn(state, next(it), rng)
                 loss += float(m["loss"])
                 top1 += float(m["top1"])
             return loss / eval_steps, top1 / eval_steps

@@ -294,7 +294,12 @@ def make_train_step(
                 body, (g0, l0.astype(jnp.float32), to_f32(aux0), 1), rest
             )
             aux = jax.tree_util.tree_map(
-                lambda s, ref: (s / accum_steps).astype(ref.dtype),
+                # cast back only for floating leaves; an integer leaf
+                # (e.g. a count metric) would be silently truncated
+                # toward zero, so its mean stays f32
+                lambda s, ref: (s / accum_steps).astype(ref.dtype)
+                if jnp.issubdtype(jnp.asarray(ref).dtype, jnp.floating)
+                else s / accum_steps,
                 aux_sum, aux0,
             )
             # cast back to the per-leaf gradient dtype (g_sum is the f32

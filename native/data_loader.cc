@@ -96,11 +96,11 @@ struct Loader {
   bool acquire(Batch* b) {
     std::unique_lock<std::mutex> lk(mu);
     cv_put.wait(lk, [&] {
-      if (closed) return true;
+      if (closed || error) return true;
       if ((int)queue.size() >= queue_depth) return false;
       return ring.empty() || !ring_free.empty();
     });
-    if (closed) return false;
+    if (closed || error) return false;  // error: all producers wind down
     if (!ring.empty()) {
       b->slot = ring_free.front();
       ring_free.pop_front();
@@ -119,6 +119,7 @@ struct Loader {
           // call returns -ENOMEM instead of a clean (short) EOF
           error = 12;  // ENOMEM
           cv_get.notify_all();
+          cv_put.notify_all();  // wake peer producers so they exit too
           return false;
         }
       }
@@ -132,8 +133,10 @@ struct Loader {
     // re-enforce the queue bound here too: acquire() gates entry, but
     // N producers can each hold one assembled batch — without this
     // wait the ready queue could grow to depth-1+N batches
-    cv_put.wait(lk, [&] { return closed || (int)queue.size() < queue_depth; });
-    if (closed) {
+    cv_put.wait(lk, [&] {
+      return closed || error || (int)queue.size() < queue_depth;
+    });
+    if (closed || error) {
       if (b.slot < 0 && b.data) std::free(b.data);
       return false;
     }
@@ -335,9 +338,11 @@ int wait_next(Loader* L, int timeout_ms, Batch* out) {
         return L->closed || L->error || !L->queue.empty() || L->eof;
       });
   if (!ok) return -110;
+  // fatal producer error jumps the queue: batches assembled before the
+  // failure are not silently consumable after it
+  if (L->error) return -L->error;  // e.g. -12 ENOMEM, not a clean EOF
   if (L->queue.empty()) {
     if (L->closed) return -9;
-    if (L->error) return -L->error;  // e.g. -12 ENOMEM, not a clean EOF
     return 0;
   }
   *out = L->queue.front();
