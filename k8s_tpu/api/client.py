@@ -32,9 +32,10 @@ T = TypeVar("T", bound=K8sObject)
 
 class _TypedResource:
     """CRUD for one kind, converting between dataclasses and the dict
-    store."""
+    store. ``cluster`` is any backend with the InMemoryCluster method
+    surface (in-memory or :class:`k8s_tpu.api.restcluster.RestCluster`)."""
 
-    def __init__(self, cluster: InMemoryCluster, kind: str, cls: Type[T]):
+    def __init__(self, cluster, kind: str, cls: Type[T]):
         self._cluster = cluster
         self.kind = kind
         self.cls = cls
@@ -73,8 +74,10 @@ class _TypedResource:
 class KubeClient:
     """The one client object threaded through controller/trainer."""
 
-    def __init__(self, cluster: Optional[InMemoryCluster] = None):
-        self.cluster = cluster or InMemoryCluster()
+    def __init__(self, cluster=None):
+        # in-memory by default; any backend with the same method surface
+        # (RestCluster against a real apiserver) drops in unchanged
+        self.cluster = cluster if cluster is not None else InMemoryCluster()
         self.pods = _TypedResource(self.cluster, "Pod", Pod)
         self.services = _TypedResource(self.cluster, "Service", Service)
         self.jobs = _TypedResource(self.cluster, "Job", Job)
@@ -103,9 +106,31 @@ class KubeClient:
 
 
 def get_cluster_client(kubeconfig: Optional[str] = None) -> KubeClient:
-    """Bootstrap helper (reference GetClusterConfig k8sutil.go:45-65):
-    in-cluster / kubeconfig when running against a real apiserver, else
-    an in-memory cluster for local mode."""
-    # The real-apiserver adapter requires the `kubernetes` package; this
-    # environment ships without it, so local mode is the default.
+    """Bootstrap helper (reference GetClusterConfig, k8sutil.go:45-65 —
+    KUBECONFIG-env branch first, then in-cluster). Resolution order:
+
+    1. ``KTPU_APISERVER_URL`` env — an explicit apiserver URL (e.g. a
+       :mod:`k8s_tpu.api.apiserver` dev server, or a ``kubectl proxy``)
+    2. ``kubeconfig`` arg, then ``KUBECONFIG`` env, then
+       ``~/.kube/config`` if present
+    3. in-cluster serviceaccount (KUBERNETES_SERVICE_HOST + token mount)
+    4. in-memory cluster (local/test mode)
+    """
+    import os
+
+    from k8s_tpu.api import restcluster
+
+    url = os.environ.get("KTPU_APISERVER_URL")
+    if url:
+        return KubeClient(restcluster.RestCluster(url))
+    path = kubeconfig or os.environ.get("KUBECONFIG")
+    if not path:
+        default = os.path.expanduser("~/.kube/config")
+        if os.path.exists(default):
+            path = default
+    if path:
+        return KubeClient(restcluster.kubeconfig_config(path))
+    in_cluster = restcluster.in_cluster_config()
+    if in_cluster is not None:
+        return KubeClient(in_cluster)
     return KubeClient()

@@ -12,14 +12,17 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from k8s_tpu.api.cluster import InMemoryCluster, Watcher
+from k8s_tpu.api.cluster import Watcher
 from k8s_tpu.spec import CRD_KIND, CRD_GROUP, CRD_VERSION, TpuJob, crd_name
 
 
 class TpuJobClient:
-    """CRUD + watch for TpuJob custom resources."""
+    """CRUD + watch for TpuJob custom resources. ``cluster`` is any
+    backend with the InMemoryCluster method surface (in-memory, or
+    :class:`k8s_tpu.api.restcluster.RestCluster` against a real
+    apiserver — the reference's raw-REST client analogue)."""
 
-    def __init__(self, cluster: InMemoryCluster):
+    def __init__(self, cluster):
         self._cluster = cluster
 
     def create_crd_definition(self) -> None:

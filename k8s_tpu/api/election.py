@@ -18,7 +18,6 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from k8s_tpu.api import errors
-from k8s_tpu.api.cluster import InMemoryCluster
 
 LEADER_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
 LOCK_KIND = "Endpoints"
@@ -59,7 +58,7 @@ class LeaderElectionRecord:
 class LeaderElector:
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster,  # InMemoryCluster surface; RestCluster gives real CAS
         namespace: str,
         name: str,
         identity: str,

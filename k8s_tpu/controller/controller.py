@@ -167,10 +167,16 @@ class Controller:
 
     def stop(self) -> None:
         self._stop.set()
-        for tj in self.jobs.values():
-            tj.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # stop reconcilers only after the pump thread is down: run() /
+        # find_all_jobs may still be adding jobs concurrently, and a job
+        # added after an early stop loop would leak its thread. Join so
+        # stop() really quiesces the process.
+        for tj in list(self.jobs.values()):
+            tj.stop()
+        for tj in list(self.jobs.values()):
+            tj.join(timeout=5)
 
     def wait_for_job(
         self, namespace: str, name: str, timeout: float = 300.0, poll: float = 0.05

@@ -47,6 +47,11 @@ def parse_args(argv=None):
                    help="liveness + /metrics listener; matches the chart's "
                         "livenessProbe. -1 disables, 0 = ephemeral port")
     p.add_argument("--namespace", default=None)
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path for out-of-cluster runs (reference "
+                        "developer_guide.md local-run path); default: "
+                        "KTPU_APISERVER_URL / KUBECONFIG env, in-cluster "
+                        "serviceaccount, then local in-memory mode")
     p.add_argument("--local", action="store_true",
                    help="single-host mode: in-memory cluster + local kubelet")
     p.add_argument("--version", action="store_true")
@@ -79,7 +84,9 @@ def main(argv=None) -> int:
         log.error("MY_POD_NAMESPACE and MY_POD_NAME must be set")
         return 1
 
-    client = get_cluster_client()
+    # --local forces the in-memory backend: the in-process kubelet hangs
+    # off its synchronous hooks, which no remote apiserver can provide
+    client = KubeClient() if args.local else get_cluster_client(args.kubeconfig)
     job_client = TpuJobClient(client.cluster)
 
     health = None

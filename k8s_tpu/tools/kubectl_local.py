@@ -1,15 +1,22 @@
-"""kubectl-style CLI for local mode.
+"""kubectl-style CLI.
 
 The reference's user surface is ``kubectl create -f tf_job.yaml``
-(README quickstart). Local mode has no apiserver, so this CLI gives
-the same verbs against a LocalWorld that lives for the command's
-duration: ``create`` runs the job to completion (with real launcher
-subprocesses), ``validate`` checks a manifest offline.
+(README quickstart). Two modes:
+
+- default: verbs against a LocalWorld that lives for the command's
+  duration — ``create`` runs the job to completion (with real launcher
+  subprocesses), ``validate`` checks a manifest offline.
+- ``--server URL`` (or ``KTPU_APISERVER_URL``): create/get/delete
+  TpuJobs against a running apiserver (a real cluster via kubectl
+  proxy, or ``python -m k8s_tpu.api.apiserver``) where a separately
+  running operator reconciles them — the reference's actual
+  deployment shape.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from k8s_tpu.client.job_client import load_tpu_job_yaml
@@ -38,9 +45,43 @@ def cmd_validate(args) -> int:
     return 0
 
 
+def _remote_client(server: str):
+    from k8s_tpu.api.crd_client import TpuJobClient
+    from k8s_tpu.api.restcluster import RestCluster
+
+    return TpuJobClient(RestCluster(server))
+
+
+def _wait_remote(jc, namespace: str, name: str, timeout: float) -> int:
+    import time
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        j = jc.get(namespace, name)
+        key = (j.status.phase, j.status.state)
+        if key != last:
+            print(f"  phase={j.status.phase or 'None'} state={j.status.state}")
+            last = key
+        if j.status.phase in (S.TpuJobPhase.DONE, S.TpuJobPhase.FAILED):
+            return 0 if j.status.state == S.TpuJobState.SUCCEEDED else 1
+        time.sleep(1.0)
+    print("timeout waiting for job")
+    return 1
+
+
 def cmd_create(args) -> int:
     with open(args.file) as f:
         text = f.read()
+    if args.server:
+        jc = _remote_client(args.server)
+        job = load_tpu_job_yaml(text)
+        ns = job.metadata.namespace or "default"
+        jc.create(job)
+        print(f"tpujob.tpu.k8s.io/{job.metadata.name} created")
+        if args.wait:
+            return _wait_remote(jc, ns, job.metadata.name, args.timeout)
+        return 0
     with LocalWorld(subprocess_pods=not args.simulate, log_dir=args.log_dir) as world:
         job = world.api.create_from_yaml(text)
         print(f"tpujob.tpu.k8s.io/{job.metadata.name} created")
@@ -58,19 +99,52 @@ def cmd_create(args) -> int:
     return 0
 
 
+def cmd_get(args) -> int:
+    jc = _remote_client(args.server)
+    if args.name:
+        j = jc.get(args.namespace, args.name)
+        jobs = [j]
+    else:
+        jobs = jc.list(args.namespace)
+    print(f"{'NAME':24} {'PHASE':10} {'STATE':10}")
+    for j in jobs:
+        print(f"{j.metadata.name:24} {j.status.phase or 'None':10} "
+              f"{j.status.state or '-':10}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    jc = _remote_client(args.server)
+    jc.delete(args.namespace, args.name)
+    print(f"tpujob.tpu.k8s.io/{args.name} deleted")
+    return 0
+
+
 def main(argv=None) -> int:
+    default_server = os.environ.get("KTPU_APISERVER_URL", "")
     p = argparse.ArgumentParser(prog="ktpu")
     sub = p.add_subparsers(dest="cmd", required=True)
-    c = sub.add_parser("create", help="create a TpuJob in a local world and run it")
+    c = sub.add_parser("create", help="create a TpuJob (local world, or --server)")
     c.add_argument("-f", "--file", required=True)
     c.add_argument("--wait", action="store_true", default=True)
     c.add_argument("--timeout", type=float, default=600.0)
     c.add_argument("--simulate", action="store_true", help="simulated pods")
     c.add_argument("--log-dir", default="/tmp/ktpu-logs")
+    c.add_argument("--server", default=default_server,
+                   help="apiserver URL (default: $KTPU_APISERVER_URL)")
     v = sub.add_parser("validate", help="validate a TpuJob manifest")
     v.add_argument("-f", "--file", required=True)
+    g = sub.add_parser("get", help="list/get TpuJobs on an apiserver")
+    g.add_argument("name", nargs="?", default=None)
+    g.add_argument("-n", "--namespace", default="default")
+    g.add_argument("--server", default=default_server, required=not default_server)
+    d = sub.add_parser("delete", help="delete a TpuJob on an apiserver")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="default")
+    d.add_argument("--server", default=default_server, required=not default_server)
     args = p.parse_args(argv)
-    return {"create": cmd_create, "validate": cmd_validate}[args.cmd](args)
+    return {"create": cmd_create, "validate": cmd_validate,
+            "get": cmd_get, "delete": cmd_delete}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -230,7 +230,13 @@ class TrainingJob:
                 self.create_resources(config)
             except Exception as e:
                 log.error("job %s: create resources: %s", self.fullname, e)
-            state, replica_statuses = self.get_status()
+            try:
+                state, replica_statuses = self.get_status()
+            except Exception as e:
+                # a transient apiserver error must not kill the reconciler
+                # thread — leave status as-is and retry next tick
+                log.error("job %s: get status: %s", self.fullname, e)
+                return
             self.status.replica_statuses = replica_statuses
             if state == TpuJobState.FAILED:
                 self.status.phase = TpuJobPhase.DONE

@@ -47,6 +47,16 @@ class TestMetrics:
         client, jc = KubeClient(cluster), TpuJobClient(cluster)
         tj = make_job(client, jc)
         jc.create(tj.job)
+        # quiesce any reconciler thread leaked by an earlier test before
+        # sampling the process-global counter
+        import threading as _t
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and any(
+            t.name.startswith("trainingjob-") for t in _t.enumerate()
+        ):
+            _time.sleep(0.05)
         before = metrics.RECONCILES.get()
         tj.reconcile(S.ControllerConfig())
         assert metrics.RECONCILES.get() == before + 1
