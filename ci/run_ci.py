@@ -5,9 +5,13 @@ The in-process analogue of the reference's Airflow DAG shape
 
     checks (lint) → unit tests → e2e → [bench] → teardown-always
 
-Each stage records junit XML under ``--artifacts-dir`` (the Gubernator
-layout of ``py/prow.py`` reduced to its artifact contract: junit files
-+ a ``finished.json`` verdict).
+Artifacts follow the Gubernator GCS layout of ``py/prow.py``:
+``started.json`` {timestamp, repos{repo: sha}, pull?} (:77-112),
+per-stage junit XML, a combined ``build-log.txt`` (:175-188), a
+``finished.json`` verdict {timestamp, result, metadata} (:115-143) —
+and, on a green postsubmit with ``--results-store``, the
+``<job>/latest_green.json`` {status, job, sha} pointer (:191-207) that
+the continuous releaser polls (``k8s_tpu/tools/release.py``).
 """
 
 from __future__ import annotations
@@ -28,16 +32,42 @@ if _ROOT not in sys.path:
 from k8s_tpu.tools.junit import TestCase, Timer, create_junit_xml_file
 
 
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=_ROOT,
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except Exception:
+        return ""
+
+
 def stage(name: str, cmd, artifacts: str, cases: list) -> bool:
-    print(f"\n=== stage: {name} ===\n$ {' '.join(cmd)}")
-    with Timer() as t:
-        proc = subprocess.run(cmd, cwd=_ROOT)
+    """Run one stage, teeing output into build-log.txt (the Gubernator
+    build log, prow.py:175-188)."""
+    header = f"\n=== stage: {name} ===\n$ {' '.join(cmd)}\n"
+    print(header, end="", flush=True)
+    with open(os.path.join(artifacts, "build-log.txt"), "ab") as logf:
+        logf.write(header.encode())
+        with Timer() as t:
+            # stream: tee each chunk live to console + build log (a
+            # buffered stage would look hung and lose its output on a
+            # timeout-kill)
+            proc = subprocess.Popen(cmd, cwd=_ROOT, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+            for chunk in iter(lambda: proc.stdout.read(4096), b""):
+                sys.stdout.buffer.write(chunk)
+                sys.stdout.flush()
+                logf.write(chunk)
+            proc.wait()
+        footer = f"=== {name}: {'ok' if proc.returncode == 0 else 'FAILED'} ({t.elapsed:.1f}s)\n"
+        print(footer, end="", flush=True)
+        logf.write(footer.encode())
     ok = proc.returncode == 0
     cases.append(
         TestCase("ci", name, t.elapsed, None if ok else f"exit {proc.returncode}")
     )
     create_junit_xml_file(cases, os.path.join(artifacts, "junit_ci.xml"))
-    print(f"=== {name}: {'ok' if ok else 'FAILED'} ({t.elapsed:.1f}s)")
     return ok
 
 
@@ -46,12 +76,30 @@ def main(argv=None) -> int:
     p.add_argument("--artifacts-dir", default="build/ci-artifacts")
     p.add_argument("--with-bench", action="store_true")
     p.add_argument("--skip-slow", action="store_true")
+    p.add_argument("--job-name", default="ci")
+    p.add_argument("--only-checks", action="store_true",
+                   help="run just the py-checks stage (harness smoke)")
+    p.add_argument("--results-store", default="",
+                   help="artifact-store root: on success, write "
+                        "<job>/latest_green.json there (the pointer the "
+                        "continuous releaser polls)")
     args = p.parse_args(argv)
     # absolute: in-process junit writes and the cwd=_ROOT subprocess
     # stages must agree on where artifacts land
     args.artifacts_dir = os.path.abspath(args.artifacts_dir)
     os.makedirs(args.artifacts_dir, exist_ok=True)
     py = sys.executable
+    sha = _git_sha()
+
+    # started.json (reference prow.py:77-112)
+    started = {"timestamp": int(time.time()),
+               "repos": {"k8s-tpu/k8s-tpu": sha}}
+    pull = os.environ.get("PULL_REFS", "")
+    if pull:
+        started["pull"] = pull
+    with open(os.path.join(args.artifacts_dir, "started.json"), "w") as f:
+        json.dump(started, f)
+    open(os.path.join(args.artifacts_dir, "build-log.txt"), "w").close()
 
     cases: list = []
     ok = True
@@ -60,26 +108,36 @@ def main(argv=None) -> int:
         "py-checks", [py, "-m", "compileall", "-q", "k8s_tpu", "tests"],
         args.artifacts_dir, cases,
     )
-    pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q",
-                  f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
-    if args.skip_slow:
-        pytest_cmd += ["-m", "not integration"]
-    ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
-    ok = ok and stage(
-        "e2e",
-        [py, "-m", "k8s_tpu.tools.e2e", "--num-jobs", "2",
-         "--junit-path", f"{args.artifacts_dir}/junit_e2e.xml"],
-        args.artifacts_dir, cases,
-    )
-    if args.with_bench and ok:
-        ok = stage("bench", [py, "bench.py"], args.artifacts_dir, cases)
+    if not args.only_checks:
+        pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q",
+                      f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
+        if args.skip_slow:
+            pytest_cmd += ["-m", "not integration"]
+        ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
+        ok = ok and stage(
+            "e2e",
+            [py, "-m", "k8s_tpu.tools.e2e", "--num-jobs", "2",
+             "--junit-path", f"{args.artifacts_dir}/junit_e2e.xml"],
+            args.artifacts_dir, cases,
+        )
+        if args.with_bench and ok:
+            ok = stage("bench", [py, "bench.py"], args.artifacts_dir, cases)
 
-    # finished.json verdict (reference py/prow.py:100-143)
+    # finished.json verdict (reference py/prow.py:115-143)
     with open(os.path.join(args.artifacts_dir, "finished.json"), "w") as f:
         json.dump(
-            {"timestamp": int(time.time()), "result": "SUCCESS" if ok else "FAILURE"},
+            {"timestamp": int(time.time()),
+             "result": "SUCCESS" if ok else "FAILURE",
+             "metadata": {}},
             f,
         )
+    if ok and args.results_store and not args.only_checks:
+        # green-postsubmit pointer (reference prow.py:191-207). Never
+        # written for --only-checks: a sha that only passed compileall
+        # must not become the continuous releaser's next release.
+        from k8s_tpu.tools.release import ArtifactStore, publish_green
+
+        publish_green(ArtifactStore(args.results_store), args.job_name, sha)
     return 0 if ok else 1
 
 

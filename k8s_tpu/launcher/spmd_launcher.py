@@ -149,6 +149,36 @@ def run_program(rdzv):
     return fn(rdzv)
 
 
+# Markers of coordination failures: a peer died and the runtime
+# surfaced it as a distributed-layer error. These are SLICE faults a
+# gang restart can fix — the exit-code contract must report them
+# retryable (143), not as a permanent user error (1). Deliberately
+# NARROW: each marker is a phrase the JAX/gRPC distributed layer emits,
+# not a generic word ("timeout", "peer") a user exception might contain
+# — a misclassified user error would burn the whole gang-restart budget
+# on deterministic failures.
+_RETRYABLE_MARKERS = (
+    "deadline_exceeded", "deadline exceeded",
+    "unavailable:",              # grpc absl::Status: UNAVAILABLE: ...
+    "coordination service", "distributed runtime",
+    "heartbeat", "preemption",
+    "connection reset", "connection refused", "failed to connect",
+    "socket closed", "broken pipe",
+)
+
+
+def is_retryable_error(e):
+    """Classify a program exception: coordination failures → retryable.
+    User code errors (shape mismatch, assertion) stay permanent.
+    Network-layer Python exceptions are retryable by class; runtime
+    errors (XlaRuntimeError) only when the message carries a
+    coordination marker — an XLA OOM or invalid-argument is the user's."""
+    text = f"{type(e).__name__}: {e}".lower()
+    if isinstance(e, (ConnectionError, TimeoutError)):
+        return True
+    return any(m in text for m in _RETRYABLE_MARKERS)
+
+
 def main(argv=None):
     rdzv = Rendezvous()
     t0 = time.time()
@@ -186,6 +216,13 @@ def main(argv=None):
             )
         return EX_OK
     except Exception as e:
+        if is_retryable_error(e):
+            # a peer died out from under us mid-collective: the gang
+            # restart path recovers this; exiting permanent would
+            # misclassify a slice fault as a user error
+            print(f"program failed (retryable coordination fault): {e}",
+                  file=sys.stderr, flush=True)
+            return EX_RETRYABLE
         print(f"program failed: {e}", file=sys.stderr, flush=True)
         return EX_PERMANENT
     finally:

@@ -88,6 +88,12 @@ def main(rdzv) -> None:
         restored = mgr.restore(state)
         if restored is not None:
             state = restored
+            # machine-readable resume marker: the gang-restart e2e
+            # asserts training continued PAST the checkpoint
+            import json as _json
+
+            print(_json.dumps({"event": "restored",
+                               "step": int(state.step)}), flush=True)
 
     # default on: fuses the lm_head matmul into the loss so the
     # [B, S, V] logits never materialize — required headroom at 128k
@@ -123,8 +129,15 @@ def main(rdzv) -> None:
     step_fn = make_train_step(loss_fn, mesh, rules, accum_steps=cfg.accum_steps)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)
+    # pacing knob for chaos/e2e tests: widens the mid-training window a
+    # fault can land in (tiny-model CPU steps are sub-millisecond)
+    step_sleep = float(extra.get("step_sleep", "0"))
     start = int(state.step)
     for step in range(start + 1, cfg.steps + 1):
+        if step_sleep:
+            import time as _time
+
+            _time.sleep(step_sleep)
         state, metrics = step_fn(state, next(data), rng)
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(metrics["loss"])})

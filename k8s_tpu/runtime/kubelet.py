@@ -167,7 +167,10 @@ class SubprocessExecutor:
                         proc.kill()
                     return 143
                 time.sleep(0.05)
-            return proc.returncode
+            rc = proc.returncode
+            # container runtimes report death-by-signal as 128+N
+            # (SIGKILL -> 137); Popen reports it as -N
+            return 128 - rc if rc < 0 else rc
         finally:
             if stdout:
                 stdout.close()
@@ -238,7 +241,15 @@ class LocalKubelet:
     def _maybe_launch(self, job: Job) -> None:
         key = (job.metadata.namespace, job.metadata.name)
         with self._lock:
-            if key in self._stops:
+            existing = self._stops.get(key)
+            if existing is not None:
+                if existing.is_set():
+                    # previous instance of this name is still winding
+                    # down (delete->recreate, e.g. a gang restart):
+                    # retry once it frees the key
+                    t = threading.Timer(0.25, self._relaunch_if_current, args=(job,))
+                    t.daemon = True
+                    t.start()
                 return
             stop = threading.Event()
             self._stops[key] = stop
@@ -249,11 +260,33 @@ class LocalKubelet:
         self._threads.append(t)
         t.start()
 
+    def _relaunch_if_current(self, job: Job) -> None:
+        """Deferred retry for a recreated same-name Job: only launch if
+        the Job object still exists (it may have been deleted again)."""
+        try:
+            current = self.client.jobs.get(job.metadata.namespace, job.metadata.name)
+        except errors.ApiError:
+            return
+        if current.metadata.uid == job.metadata.uid:
+            self._maybe_launch(current)
+
     # ------------------------------------------------------------ pod runs
 
     def _run_job(self, job: Job, stop: threading.Event) -> None:
+        try:
+            self._run_job_inner(job, stop)
+        finally:
+            # free the key so a recreated batch Job with the same name
+            # (gang restart) launches again
+            with self._lock:
+                self._stops.pop((job.metadata.namespace, job.metadata.name), None)
+
+    def _run_job_inner(self, job: Job, stop: threading.Event) -> None:
         ns = job.metadata.namespace
-        backoff = job.spec.backoff_limit or DEFAULT_BACKOFF_LIMIT
+        # backoffLimit=0 is meaningful (gang replicas: restart is the
+        # reconciler's job, not the pod's) — only None means default
+        backoff = (DEFAULT_BACKOFF_LIMIT if job.spec.backoff_limit is None
+                   else job.spec.backoff_limit)
         restarts = 0
         last_state: Optional[ContainerState] = None
         while not stop.is_set():

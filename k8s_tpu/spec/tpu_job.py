@@ -163,6 +163,12 @@ class TpuJobSpec(K8sObject):
     image: str = field(default="", metadata={"json": "jaxImage"})
     termination_policy: Optional[TerminationPolicySpec] = None
     tpu: Optional[TpuSpec] = None
+    # Slice-granular recovery budget: how many whole-gang restarts the
+    # reconciler may perform before declaring the job Failed. The
+    # reference restarted replicas independently via the batch-Job
+    # controller (replicas.go:216-229) — wrong for TPU slices, where
+    # one host's death must restart every process of the slice together.
+    max_gang_restarts: int = 3
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -412,6 +418,7 @@ class TpuJobStatus(K8sObject):
     conditions: List[TpuJobCondition] = field(default_factory=list)
     state: str = TpuJobState.UNKNOWN
     replica_statuses: List[ReplicaStatus] = field(default_factory=list)
+    gang_restarts: int = 0  # whole-slice restarts performed so far
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def is_failed(self) -> bool:

@@ -1,9 +1,22 @@
 """Release builder.
 
-Analogue of reference ``py/release.py`` (:116-280) +
-``py/build_and_push_image.py``: image tag ``v<date>-<githash>`` with a
-dirty-diff suffix, docker-context assembly, chart packaging, and a
-``latest_release.json`` manifest. Runs docker/gcloud when present;
+Analogue of reference ``py/release.py`` + ``py/build_and_push_image.py``:
+
+- image tag ``v<date>-<githash>`` with a dirty-diff suffix
+  (build_and_push_image.py:14-32), built locally via docker (the GCB
+  branch of reference release.py:116-190 is cloud-specific; the local
+  branch is ported) and also tagged ``:latest``
+- chart re-version + package + publish to an :class:`ArtifactStore`
+  under ``<version>/`` AND a ``latest/`` alias, plus a
+  ``latest_release.json`` {sha, target, image} manifest
+  (release.py:193-280)
+- continuous mode (``--check-interval-secs``): poll the store's
+  ``latest_green.json`` (written by CI on a green postsubmit,
+  py/prow.py:191-207) and cut a release whenever the green sha moves —
+  the in-cluster releaser loop of ``release/releaser.yaml:20-25``
+
+The store is pluggable: a local directory stands in for the GCS bucket
+(same layout), so the whole flow is testable without cloud access.
 ``--dry-run`` emits the plan (used by tests and airgapped CI).
 """
 
@@ -13,6 +26,7 @@ import argparse
 import hashlib
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tarfile
@@ -48,14 +62,21 @@ def image_tag(repo_dir: str, now: Optional[time.struct_time] = None) -> str:
     return "v{}-{}".format(time.strftime("%Y%m%d", now), get_git_hash(repo_dir))
 
 
-def build_operator_image(repo_dir: str, registry: str, dry_run: bool = False) -> str:
+def build_operator_image(repo_dir: str, registry: str, dry_run: bool = False,
+                         push: bool = True) -> str:
+    """Local docker build (the reference's non-GCB branch,
+    release.py:175-190): versioned tag + a ``:latest`` alias."""
     tag = image_tag(repo_dir)
     image = f"{registry}/tpu-operator:{tag}"
+    latest = f"{registry}/tpu-operator:latest"
     run(
         ["docker", "build", "-t", image, "-f", "images/operator/Dockerfile", "."],
         dry_run=dry_run, cwd=repo_dir,
     )
-    run(["docker", "push", image], dry_run=dry_run)
+    run(["docker", "tag", image, latest], dry_run=dry_run)
+    if push:
+        run(["docker", "push", image], dry_run=dry_run)
+        run(["docker", "push", latest], dry_run=dry_run)
     return image
 
 
@@ -102,14 +123,181 @@ def write_release_manifest(out_dir: str, image: str, chart_path: str) -> str:
     return path
 
 
+class ArtifactStore:
+    """Pluggable release/CI artifact store with the reference's GCS
+    bucket layout; the default backend is a local directory (a real GCS
+    backend is the same three methods over gsutil/google-cloud-storage,
+    deliberately not imported here — zero cloud deps in-tree)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, target: str) -> str:
+        return os.path.join(self.root, target)
+
+    def upload_file(self, local_path: str, target: str) -> str:
+        dest = self._path(target)
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        shutil.copyfile(local_path, dest)
+        return dest
+
+    def upload_string(self, content: str, target: str) -> str:
+        dest = self._path(target)
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        with open(dest, "w") as f:
+            f.write(content)
+        return dest
+
+    def read(self, target: str) -> Optional[str]:
+        try:
+            with open(self._path(target)) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+def publish_release(store: ArtifactStore, image: str, chart_archive: str,
+                    sha: str, version: str) -> dict:
+    """Publish a cut release to the store (reference release.py:193-280):
+    chart under ``<version>/`` and the ``latest/`` alias, then the
+    ``latest_release.json`` pointer {sha, target, image}."""
+    versioned = f"{version}/{os.path.basename(chart_archive)}"
+    store.upload_file(chart_archive, versioned)
+    store.upload_file(chart_archive, "latest/tpu-job-operator-latest.tgz")
+    manifest = {
+        "sha": sha,
+        "target": versioned,
+        "image": image,
+        "timestamp": int(time.time()),
+    }
+    store.upload_string(json.dumps(manifest, indent=2), "latest_release.json")
+    return manifest
+
+
+def get_last_release_sha(store: ArtifactStore) -> str:
+    raw = store.read("latest_release.json")
+    if not raw:
+        return ""
+    try:
+        return json.loads(raw).get("sha", "")
+    except ValueError:
+        return ""
+
+
+def get_latest_green_sha(store: ArtifactStore, job_name: str = "ci") -> str:
+    """The green-postsubmit pointer CI maintains
+    (reference prow.py:191-207)."""
+    raw = store.read(os.path.join(job_name, "latest_green.json"))
+    if not raw:
+        return ""
+    try:
+        return json.loads(raw).get("sha", "")
+    except ValueError:
+        return ""
+
+
+def publish_green(store: ArtifactStore, job_name: str, sha: str) -> str:
+    """Write the green-postsubmit pointer (reference prow.py:191-207).
+    Called by ``ci/run_ci.py`` after a FULL green pipeline."""
+    return store.upload_string(
+        json.dumps({"status": "passing", "job": job_name, "sha": sha}),
+        os.path.join(job_name, "latest_green.json"),
+    )
+
+
+def cut_release(repo_dir: str, out_dir: str, registry: str, store: ArtifactStore,
+                chart_version: str = "0.1.0", dry_run: bool = False,
+                sha: Optional[str] = None) -> dict:
+    """One full release: image (+:latest), chart, publish. ``sha``
+    overrides the recorded sha (continuous mode records the GREEN sha it
+    was asked to release, so the loop converges — the reference clones
+    that sha first, release.py:436-462; locally the checkout is the repo)."""
+    tag = image_tag(repo_dir)
+    sha = sha or get_git_hash(repo_dir)
+    if dry_run:
+        image = f"{registry}/tpu-operator:{tag}"
+    else:
+        image = build_operator_image(repo_dir, registry)
+    chart = package_chart(repo_dir, out_dir, f"{chart_version}+{tag}")
+    write_release_manifest(out_dir, image, chart)
+    return publish_release(store, image, chart, sha, tag)
+
+
+def continuous_release(repo_dir: str, out_dir: str, registry: str,
+                       store: ArtifactStore, check_interval_secs: float,
+                       chart_version: str = "0.1.0", dry_run: bool = False,
+                       max_iterations: Optional[int] = None,
+                       job_name: str = "ci") -> int:
+    """The in-cluster releaser loop (reference releaser.yaml:20-25 +
+    release.py build_lastgreen): whenever CI's green sha moves past the
+    last released sha, cut a release. ``max_iterations`` bounds the loop
+    for tests; None = forever. ``job_name`` must match the CI run's
+    ``--job-name`` (the green pointer lives under ``<job>/``)."""
+    released = 0
+    i = 0
+    while max_iterations is None or i < max_iterations:
+        i += 1
+        green = get_latest_green_sha(store, job_name)
+        last = get_last_release_sha(store)
+        if green and green != last:
+            print(f"green sha moved ({last or '<none>'} -> {green}); releasing")
+            try:
+                cut_release(repo_dir, out_dir, registry, store,
+                            chart_version, dry_run=dry_run, sha=green)
+                released += 1
+            except Exception as e:
+                # a forever loop must survive transient build/push
+                # failures; retry at the next poll
+                print(f"release of {green} failed (will retry): {e}",
+                      file=sys.stderr)
+        elif green:
+            print(f"already released {green}")
+        else:
+            print("no latest_green.json yet")
+        if max_iterations is not None and i >= max_iterations:
+            break
+        time.sleep(check_interval_secs)
+    return released
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ktpu-release")
     p.add_argument("--registry", default="ghcr.io/k8s-tpu")
     p.add_argument("--out-dir", default="build/release")
     p.add_argument("--repo-dir", default=".")
     p.add_argument("--chart-version", default="0.1.0")
+    p.add_argument("--store", default="",
+                   help="artifact-store root (local dir standing in for "
+                        "the GCS releases bucket); publishes chart + "
+                        "latest/ alias + latest_release.json there")
+    p.add_argument("--check-interval-secs", type=float, default=0,
+                   help="continuous mode: poll the store's "
+                        "latest_green.json and release when it moves "
+                        "(the in-cluster releaser loop); requires --store")
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--job-name", default="ci",
+                   help="CI job whose latest_green.json to follow")
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
+
+    if args.check_interval_secs:
+        if not args.store:
+            p.error("--check-interval-secs requires --store")
+        store = ArtifactStore(args.store)
+        continuous_release(
+            args.repo_dir, args.out_dir, args.registry, store,
+            args.check_interval_secs, args.chart_version,
+            dry_run=args.dry_run, max_iterations=args.max_iterations,
+            job_name=args.job_name,
+        )
+        return 0
+
+    if args.store:
+        cut_release(args.repo_dir, args.out_dir, args.registry,
+                    ArtifactStore(args.store), args.chart_version,
+                    dry_run=args.dry_run)
+        return 0
 
     tag = image_tag(args.repo_dir)
     print(f"release tag: {tag}")

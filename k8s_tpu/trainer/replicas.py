@@ -136,6 +136,13 @@ class TpuReplicaSet:
         l[L.TASK_INDEX_LABEL] = str(index)
         return l
 
+    @property
+    def is_gang(self) -> bool:
+        """In-mesh replicas (the SPMD gang). Control replicas
+        (COORDINATOR/TensorBoard) are not part of the device mesh and
+        keep independent restart semantics."""
+        return self.spec.replica_type == WORKER
+
     # ------------------------------------------------------------- create
 
     def create(self, config) -> None:
@@ -195,7 +202,13 @@ class TpuReplicaSet:
                 labels=dict(self.task_labels(index)),
                 owner_references=[self.job.job.as_owner()],
             ),
-            spec=JobSpec(completions=1, parallelism=1, template=template),
+            # In-mesh (gang) replicas get backoffLimit=0: a retryable
+            # exit is a SLICE event, recovered by the reconciler's
+            # whole-gang restart, never by a per-pod batch-Job restart
+            # that would leave peers blocked in dead collectives.
+            # Control replicas keep per-pod restart semantics.
+            spec=JobSpec(completions=1, parallelism=1, template=template,
+                         backoff_limit=0 if self.is_gang else None),
         )
         try:
             self.client.jobs.create(job)
@@ -297,6 +310,53 @@ class TpuReplicaSet:
         )
 
     # ------------------------------------------------------------- delete
+
+    def delete_compute(self) -> None:
+        """Gang-restart teardown: bulk-delete this set's batch Jobs and
+        Pods but KEEP the per-index Services (stable DNS/ports for the
+        re-spawned gang) and the launcher ConfigMap. The kubelet sees
+        the Job deletions and terminates the processes — including
+        survivors blocked in a dead collective."""
+        sel = dict(self.default_labels())
+        self.client.jobs.delete_collection(self.namespace, sel)
+        self.client.pods.delete_collection(self.namespace, sel)
+
+    def degraded_indices(self) -> List[int]:
+        """Indices whose process died with a RETRYABLE exit — the gang
+        event the reconciler turns into a whole-slice restart. A batch
+        Job marked failed whose newest pod's (last) termination is
+        retryable qualifies; permanent exits do not (they fail the job
+        through the normal status path)."""
+        from k8s_tpu.trainer.training import is_retryable_termination_state
+
+        out: List[int] = []
+        for index in range(self.spec.replicas or 0):
+            try:
+                job = self.client.jobs.get(self.namespace, self.job_name(index))
+            except errors.NotFoundError:
+                continue
+            if job.status.succeeded >= 1 or job.status.failed < 1:
+                continue
+            pods = self.client.pods.list(
+                self.namespace, dict(self.task_labels(index))
+            )
+            for pod in pods:
+                for cs in pod.status.container_statuses:
+                    if cs.name != CONTAINER_NAME:
+                        continue
+                    term = None
+                    if cs.state is not None and cs.state.terminated is not None:
+                        term = cs.state.terminated
+                    if cs.last_state is not None and cs.last_state.terminated is not None:
+                        term = cs.last_state.terminated
+                    if term is not None and term.exit_code != 0 and \
+                            is_retryable_termination_state(term):
+                        out.append(index)
+                        break
+                else:
+                    continue
+                break
+        return out
 
     def delete(self) -> None:
         """Teardown (reference replicas.go:299-356): bulk-delete Jobs and

@@ -190,6 +190,62 @@ class TrainingJob:
             return state, statuses
         return TpuJobState.RUNNING, statuses
 
+    def _maybe_gang_restart(self) -> Optional[str]:
+        """Slice-granular recovery (SURVEY §7.2 hard part #1). One
+        retryable worker exit ⇒ delete and recreate ALL pods of the
+        gang: the dead worker's peers are blocked in (or about to fail
+        out of) collectives, so only a coherent whole-slice restart —
+        with workers restoring from the latest checkpoint — makes
+        progress. Returns ``"restarted"`` if a restart was initiated,
+        ``"exhausted"`` if the budget is spent (job must fail), or
+        ``None`` if the gang is healthy.
+
+        The reference restarted replicas independently
+        (replicas.go:216-229, README:204-214) — acceptable for
+        PS/worker, wrong for TPU slices.
+        """
+        degraded = [
+            (r, idxs) for r in self.replicas
+            if r.is_gang and (idxs := r.degraded_indices())
+        ]
+        if not degraded:
+            return None
+        if self.status.gang_restarts >= self.job.spec.max_gang_restarts:
+            names = [f"{r.spec.replica_type}{idxs}" for r, idxs in degraded]
+            self.status.reason = (
+                f"gang restart budget exhausted "
+                f"({self.job.spec.max_gang_restarts}) after {names}"
+            )
+            return "exhausted"
+        self.status.gang_restarts += 1
+        self.status.append_condition(
+            "GangRestart",
+            reason=f"retryable worker exit at "
+                   f"{[(r.spec.replica_type, i) for r, i in degraded]}",
+        )
+        log.warning(
+            "job %s: gang restart %d/%d (degraded: %s)",
+            self.fullname, self.status.gang_restarts,
+            self.job.spec.max_gang_restarts,
+            [(r.spec.replica_type, i) for r, i in degraded],
+        )
+        self.client.record_event(
+            self.job.metadata.namespace,
+            {"kind": "TpuJob", "name": self.name},
+            "GangRestart",
+            f"restarting all gang pods "
+            f"({self.status.gang_restarts}/{self.job.spec.max_gang_restarts})",
+            etype="Warning",
+        )
+        # the WHOLE slice goes down together, not just the degraded set
+        for r in self.replicas:
+            if r.is_gang:
+                try:
+                    r.delete_compute()
+                except Exception as e:
+                    log.error("job %s: gang teardown: %s", self.fullname, e)
+        return "restarted"
+
     def update_crd_status(self) -> None:
         """Write status back iff changed (reference updateTPRStatus,
         training.go:331-347)."""
@@ -237,6 +293,20 @@ class TrainingJob:
                 # thread — leave status as-is and retry next tick
                 log.error("job %s: get status: %s", self.fullname, e)
                 return
+            # Gang policy runs even when the aggregate state looks FAILED:
+            # when a worker dies retryably (e.g. SIGKILL 137), its peers
+            # exit out of dead collectives with code 1 ("JAX distributed
+            # service detected fatal errors") — collateral, not a user
+            # error. If ANY gang index terminated retryably, the slice
+            # restart takes precedence; a genuine user error yields exit
+            # 1 on all workers with no retryable index and still fails.
+            if state in (TpuJobState.RUNNING, TpuJobState.FAILED):
+                gang = self._maybe_gang_restart()
+                if gang == "restarted":
+                    self.update_crd_status()
+                    return  # next tick recreates the gang
+                if gang == "exhausted":
+                    state = TpuJobState.FAILED
             self.status.replica_statuses = replica_statuses
             if state == TpuJobState.FAILED:
                 self.status.phase = TpuJobPhase.DONE

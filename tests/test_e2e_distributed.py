@@ -11,6 +11,7 @@ itself proves every process joined the mesh (the matmul-on-every-device
 trick of ``tf_smoke.py:52-60``).
 """
 
+import json
 import time
 
 import pytest
@@ -142,3 +143,88 @@ def _logs(tmp_path):
     for p in glob.glob(str(tmp_path / "logs" / "*.log")):
         out.append(f"--- {p} ---\n" + open(p).read())
     return "\n".join(out)
+
+
+@pytest.mark.integration
+def test_gang_restart_mid_training_kill(tmp_path):
+    """The designed fault path (SURVEY §7.2 hard part #1): SIGKILL one
+    REAL worker subprocess MID-TRAINING (after a checkpoint exists).
+    The kubelet reports 137, the reconciler gang-restarts the whole
+    slice, the fresh gang restores from the orbax checkpoint and the
+    job still reaches Succeeded with steps resuming past the restore
+    point — never re-running from step 0."""
+    import os
+    import signal
+
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    controller = Controller(client, jc, S.ControllerConfig(), reconcile_interval=0.1)
+    ckpt_dir = tmp_path / "ckpt"
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=12 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp --seq_len=32 "
+                f"--checkpoint_dir={ckpt_dir} --checkpoint_every=2 "
+                "--step_sleep=0.4"
+            ),
+        },
+    )
+    kubelet = LocalKubelet(client, executor)
+    kubelet.start()
+    controller.start()
+    try:
+        j = S.TpuJob()
+        j.metadata.name = "chaos"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+        jc.create(j)
+
+        # wait until training is past step 4 (checkpoints at 2 and 4
+        # committed or committing) with both workers alive
+        deadline = time.monotonic() + 240
+        rid = None
+        while time.monotonic() < deadline:
+            try:
+                cur = jc.get("default", "chaos")
+                rid = cur.spec.runtime_id or rid
+            except Exception:
+                pass
+            log0 = _read_worker_log(tmp_path, rid, 0, "chaos") if rid else ""
+            if '"step": 5' in log0:
+                break
+            assert '"state": "Failed"' not in log0
+            time.sleep(0.2)
+        else:
+            raise AssertionError("training never reached step 5\n" + _logs(tmp_path))
+
+        # SIGKILL one live worker subprocess — a hard mid-training fault
+        victims = [p for p in executor._procs if p.poll() is None]
+        assert len(victims) == 2, "expected 2 live worker processes"
+        os.kill(victims[1].pid, signal.SIGKILL)
+
+        job = controller.wait_for_job("default", "chaos", timeout=300)
+        assert job.status.state == S.TpuJobState.SUCCEEDED, (
+            json.dumps(job.status.to_dict(), indent=1), _logs(tmp_path))
+        # recovery went through the designed slice path, exactly once
+        assert job.status.gang_restarts == 1, job.to_dict()
+        assert any(c.type == "GangRestart" for c in job.status.conditions)
+        # the fresh gang restored from a checkpoint and resumed PAST it
+        log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "chaos")
+        restored = [
+            json.loads(l)["step"] for l in log0.splitlines()
+            if '"event": "restored"' in l
+        ]
+        assert restored and restored[-1] >= 2, log0
+        assert '"step": 12' in log0, log0
+        ev_reasons = {e.reason for e in client.events.list("default")}
+        assert "GangRestart" in ev_reasons
+    finally:
+        controller.stop()
+        kubelet.stop()

@@ -475,3 +475,117 @@ class TestTensorBoard:
         tj.create_resources(S.ControllerConfig())
         tj.delete_resources()
         assert client.deployments.list("default") == []
+
+
+class TestGangRestart:
+    """Slice-granular recovery (SURVEY §7.2 hard part #1): one
+    retryable worker exit ⇒ the reconciler deletes and recreates ALL
+    gang pods, bounded by spec.max_gang_restarts."""
+
+    def _degrade_worker(self, client, tj, index, exit_code=137, reason=""):
+        name = f"myjob-worker-abcd-{index}"
+        bjob = client.jobs.get("default", name)
+        bjob.status.failed = 1
+        client.jobs.update(bjob)
+        pod = Pod()
+        pod.metadata.name = name + "-pod-0"
+        pod.metadata.namespace = "default"
+        pod.metadata.labels = dict(bjob.metadata.labels)
+        pod.status = PodStatus(
+            phase="Failed",
+            container_statuses=[
+                ContainerStatus(
+                    name="jax",
+                    state=ContainerState(
+                        terminated=ContainerStateTerminated(
+                            exit_code=exit_code, reason=reason)
+                    ),
+                )
+            ],
+        )
+        client.pods.create(pod)
+
+    def _world(self, workers=2):
+        client, jc = make_env()
+        tj = make_job(client, jc, worker_replicas=workers)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        return client, jc, tj, cfg
+
+    def test_worker_jobs_get_backoff_zero(self):
+        client, _, tj, _ = self._world()
+        worker = client.jobs.get("default", "myjob-worker-abcd-0")
+        assert worker.spec.backoff_limit == 0  # gang: reconciler restarts
+        coord = client.jobs.get("default", "myjob-coordinator-abcd-0")
+        assert coord.spec.backoff_limit is None  # control: per-pod restart
+
+    def test_retryable_worker_exit_restarts_whole_gang(self):
+        client, jc, tj, cfg = self._world(workers=2)
+        assert len(client.jobs.list("default")) == 3  # 1 coord + 2 workers
+        self._degrade_worker(client, tj, 1)
+        tj.reconcile(cfg)
+        # ALL worker jobs+pods deleted, coordinator untouched
+        names = {j.metadata.name for j in client.jobs.list("default")}
+        assert names == {"myjob-coordinator-abcd-0"}
+        assert client.pods.list("default", {L.JOB_TYPE_LABEL: "WORKER"}) == []
+        assert tj.status.gang_restarts == 1
+        assert any(c.type == "GangRestart" for c in tj.status.conditions)
+        # CRD status carries the restart count
+        assert jc.get("default", "myjob").status.gang_restarts == 1
+        # services survive (stable DNS for the re-spawned gang)
+        assert any(
+            s.metadata.name == "myjob-worker-abcd-1"
+            for s in client.services.list("default")
+        )
+        # next pass recreates the gang
+        tj.reconcile(cfg)
+        names = {j.metadata.name for j in client.jobs.list("default")}
+        assert "myjob-worker-abcd-0" in names and "myjob-worker-abcd-1" in names
+
+    def test_permanent_worker_exit_fails_without_gang_restart(self):
+        client, jc, tj, cfg = self._world()
+        self._degrade_worker(client, tj, 0, exit_code=1)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 0
+        assert tj.status.state == S.TpuJobState.FAILED
+
+    def test_oom_is_permanent_even_at_137(self):
+        client, jc, tj, cfg = self._world()
+        self._degrade_worker(client, tj, 0, exit_code=137, reason="OOMKilled")
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 0
+        assert tj.status.state == S.TpuJobState.FAILED
+
+    def test_budget_exhaustion_fails_job(self):
+        client, jc, tj, cfg = self._world()
+        tj.job.spec.max_gang_restarts = 1
+        self._degrade_worker(client, tj, 0)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        tj.reconcile(cfg)  # recreate
+        self._degrade_worker(client, tj, 1)
+        tj.reconcile(cfg)
+        assert tj.status.state == S.TpuJobState.FAILED
+        assert "budget exhausted" in tj.status.reason
+        assert jc.get("default", "myjob").status.state == S.TpuJobState.FAILED
+
+    def test_collateral_permanent_exit_does_not_mask_gang_restart(self):
+        # Worker 0 SIGKILLed (137, retryable); worker 1 exits 1 because
+        # "the JAX distributed service detected fatal errors" — the
+        # collateral of its peer's death, not a user error. The slice
+        # restart must win over the permanent-looking exit.
+        client, jc, tj, cfg = self._world(workers=2)
+        self._degrade_worker(client, tj, 0, exit_code=137)
+        self._degrade_worker(client, tj, 1, exit_code=1)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        assert tj.status.state != S.TpuJobState.FAILED
+        # and a pure user error (exit 1 everywhere, no retryable index)
+        # still fails permanently
+        client2, jc2, tj2, cfg2 = self._world(workers=2)
+        self._degrade_worker(client2, tj2, 0, exit_code=1)
+        self._degrade_worker(client2, tj2, 1, exit_code=1)
+        tj2.reconcile(cfg2)
+        assert tj2.status.gang_restarts == 0
+        assert tj2.status.state == S.TpuJobState.FAILED
