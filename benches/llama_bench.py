@@ -41,7 +41,7 @@ def main(argv=None) -> int:
     p.add_argument("--seq-len", type=int, default=2048,
                    help="training sequence length (long-context rows)")
     p.add_argument("--remat-policy", default="nothing_saveable",
-                   choices=["nothing_saveable", "dots", "flash"])
+                   choices=["nothing_saveable", "dots", "flash", "flash_qkv"])
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--no-fused-ce", action="store_true",
                    help="materialize full [B,S,V] logits in the loss")

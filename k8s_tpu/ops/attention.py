@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -63,6 +64,41 @@ def resolve_blocks(sq: int, block_q, block_k):
     if block_k is None:
         block_k = DEFAULT_BLOCK_K
     return block_q, block_k
+
+
+# Tuning-only overrides, read ONCE at import: they are baked into the
+# traced backward, so in-process changes would be silently ignored by
+# the jit cache — each tuning point needs a fresh process (the scan
+# scripts fork one per combo). End-to-end results so far are negative
+# at every tried point (docs/BENCHMARKS.md ceiling analysis), so the
+# default — inherit the forward's jointly-tuned blocks — stands.
+_BWD_BLOCK_Q_OVERRIDE = int(os.environ.get("KTPU_FLASH_BWD_BLOCK_Q", "0") or 0)
+_BWD_BLOCK_K_OVERRIDE = int(os.environ.get("KTPU_FLASH_BWD_BLOCK_K", "0") or 0)
+
+
+def resolve_bwd_blocks(sq: int, fwd_block_q, fwd_block_k, sk: Optional[int] = None):
+    """Backward-kernel tiles: the forward's blocks unless the
+    ``KTPU_FLASH_BWD_BLOCK_Q/K`` tuning overrides are set. Overrides
+    must divide the sequence exactly — a partial block would feed
+    padding garbage into the online-softmax recompute, silently
+    corrupting gradients, so refuse instead."""
+    sk = sk if sk is not None else sq
+    bq, bk = fwd_block_q, fwd_block_k
+    if _BWD_BLOCK_Q_OVERRIDE:
+        if _BWD_BLOCK_Q_OVERRIDE <= 0 or sq % _BWD_BLOCK_Q_OVERRIDE:
+            raise ValueError(
+                f"KTPU_FLASH_BWD_BLOCK_Q={_BWD_BLOCK_Q_OVERRIDE} does not "
+                f"divide sq={sq}"
+            )
+        bq = _BWD_BLOCK_Q_OVERRIDE
+    if _BWD_BLOCK_K_OVERRIDE:
+        if _BWD_BLOCK_K_OVERRIDE <= 0 or sk % _BWD_BLOCK_K_OVERRIDE:
+            raise ValueError(
+                f"KTPU_FLASH_BWD_BLOCK_K={_BWD_BLOCK_K_OVERRIDE} does not "
+                f"divide sk={sk}"
+            )
+        bk = _BWD_BLOCK_K_OVERRIDE
+    return bq, bk
 
 
 def _fit_block(block: int, seq: int, floor: int = 128) -> int:
@@ -592,8 +628,12 @@ def _flash_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k, interpret)
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, segment_ids, out, lse = res
+    # the backward kernels may want different tiles than the forward
+    # (dq streams KV, dkv streams Q — opposite stationarity); see
+    # resolve_bwd_blocks for the measured per-seq defaults
+    bwd_bq, bwd_bk = resolve_bwd_blocks(q.shape[1], block_q, block_k)
     dq, dk, dv = _flash_backward(
-        q, k, v, compute_dd(out, g), lse, g, causal, scale, block_q, block_k,
+        q, k, v, compute_dd(out, g), lse, g, causal, scale, bwd_bq, bwd_bk,
         interpret, segment_ids=segment_ids,
     )
     # integer segment ids carry no gradient: float0 cotangent

@@ -48,6 +48,7 @@ from k8s_tpu.ops.attention import (
     _flash_forward,
     compute_dd,
     resolve_blocks,
+    resolve_bwd_blocks,
 )
 
 NEG_INF = -1e30
@@ -218,8 +219,13 @@ def _ring_flash_bwd(
 
     def block_bwd(k_blk, v_blk, blk_causal):
         # per-block P recomputed from the global lse → exact global grads
+        # same bwd-block resolution (incl. tuning overrides) as the
+        # single-device path, against the LOCAL per-shard lengths
+        bwd_bq, bwd_bk = resolve_bwd_blocks(
+            q.shape[1], block_q, block_k, sk=k_blk.shape[1]
+        )
         return _flash_backward(
-            q, k_blk, v_blk, dd, lse, g, blk_causal, scale, block_q, block_k,
+            q, k_blk, v_blk, dd, lse, g, blk_causal, scale, bwd_bq, bwd_bk,
             interpret, grads_f32=True,
         )
 
