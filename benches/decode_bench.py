@@ -41,6 +41,14 @@ def main(argv=None) -> int:
                         "weight-only offline quantization (kernels "
                         "STORED int8 + per-channel scales) — halves "
                         "the weight-read bytes that dominate decode")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="keep the layer loop scanned in decode (default "
+                        "unrolls: a scanned stacked cache carry costs "
+                        "full-cache copies + per-layer slab DS/DUS)")
+    p.add_argument("--fused-proj", action="store_true",
+                   help="one qkv GEMM + one gate/up GEMM per layer "
+                        "(fuse_params_for_decode); decode latency is "
+                        "fusion-count-bound, so fewer dispatches win")
     args = p.parse_args(argv)
 
     on_accel = jax.default_backend() in ("tpu", "gpu")
@@ -50,6 +58,7 @@ def main(argv=None) -> int:
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
             max_seq_len=args.prompt_len + args.new_tokens,
             remat=False, decode=True, quant=args.quant,
+            scan_layers=args.scan_layers,
         )
     else:
         cfg = LlamaConfig.tiny(decode=True, max_seq_len=64,
@@ -57,8 +66,13 @@ def main(argv=None) -> int:
         args.batch, args.prompt_len, args.new_tokens = 2, 8, 16
 
     serving_int8 = args.quant == "int8_serving"
-    init_cfg = (
-        dataclasses.replace(cfg, quant="none") if serving_int8 else cfg
+    if args.fused_proj:
+        # serve with fused qkv/gate_up GEMMs; params are initialized in
+        # the CANONICAL layout and rewritten, proving the real serving
+        # path (trained checkpoint -> fuse_params_for_decode)
+        cfg = dataclasses.replace(cfg, fused_proj=True)
+    init_cfg = dataclasses.replace(
+        cfg, quant="none" if serving_int8 else cfg.quant, fused_proj=False
     )
     model = LlamaForCausalLM(cfg)
     prompt = jax.random.randint(
@@ -80,6 +94,10 @@ def main(argv=None) -> int:
         if x.dtype == jnp.float32 else x,
         params,
     )
+    if args.fused_proj:
+        from k8s_tpu.models import fuse_params_for_decode
+
+        params = fuse_params_for_decode(params)
     if serving_int8:
         from k8s_tpu.ops.quant import quantize_params_for_serving
 

@@ -16,5 +16,7 @@ from k8s_tpu.models.bert import BertConfig, BertForPretraining  # noqa: F401
 from k8s_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,
+    fuse_params_for_decode,
     generate,
+    unroll_params_for_decode,
 )

@@ -72,6 +72,13 @@ class LlamaConfig:
     # loss when quantized — see the o_proj comment below and
     # k8s_tpu/ops/quant.py)
     quant: str = "none"
+    # Fused projections: q/k/v as ONE [E, (Hq+2Hkv)*D] GEMM and
+    # gate/up as ONE [E, 2F] GEMM (params "qkv_proj"/"gate_up_proj";
+    # convert a canonical tree with fuse_params_for_decode). Math-
+    # identical — the win is decode, where the per-step latency is
+    # fusion-count-bound: 3 fewer GEMM dispatches per layer and x read
+    # once per fused pair.
+    fused_proj: bool = False
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -166,30 +173,58 @@ def _dense(features, axes, name, dtype, quant="none"):
 
 
 def _cached_attention(q, k_all, v_all, mask, scale):
-    """Decode-mode attention against the full static cache.
+    """Prefill/fallback attention against the full static cache.
 
-    q [B, s, Hq, D] (s = prefill chunk or 1), k/v [B, max_seq, Hkv, D],
-    mask [B, s, max_seq] bool (True = visible). Bandwidth-bound einsum
-    — the right shape for single-token decode, where a flash kernel
-    has nothing to block."""
+    q [B, s, Hq, D], k/v HEAD-MAJOR [B, Hkv, max_seq, D], mask
+    [B, s, max_seq] bool (True = visible). Bandwidth-bound einsum —
+    single-token decode instead goes through the fused pallas kernel
+    (:func:`k8s_tpu.ops.attention.decode_attention_update`)."""
     b, s, hq, d = q.shape
-    _, smax, hkv, _ = k_all.shape
+    _, hkv, smax, _ = k_all.shape
     groups = hq // hkv
-    # k/v stay in cache dtype (bf16): casting the full [B, max_seq]
-    # cache to f32 would double the HBM traffic of a bandwidth-bound
-    # op — preferred_element_type gives f32 accumulation without copies
+    # k/v stay in cache dtype (bf16) on TPU: casting the full
+    # [B, max_seq] cache to f32 would double the HBM traffic of a
+    # bandwidth-bound op — preferred_element_type gives f32
+    # accumulation without copies. The CPU backend cannot execute
+    # bf16 x bf16 -> f32 dots (DotThunk limitation), so tests upcast.
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else q.dtype
     qf = (q.astype(jnp.float32) * scale).reshape(b, s, hkv, groups, d)
     logits = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qf.astype(q.dtype), k_all,
+        "bqhgd,bhkd->bhgqk", qf.astype(cdt), k_all.astype(cdt),
         preferred_element_type=jnp.float32,
     )
     logits = jnp.where(mask[:, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs.astype(q.dtype), v_all,
+        "bhgqk,bhkd->bqhgd", probs.astype(cdt), v_all.astype(cdt),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
+def _use_pallas_decode(head_dim: int, max_seq_len: int) -> bool:
+    """Pallas decode kernel gate. Deliberately conservative:
+
+    - TPU backend only (tests exercise the kernel in interpret mode)
+    - single device only: the kernel is a plain pallas_call with no
+      GSPMD partitioning rule, so under tensor-parallel serving it
+      would force replication (or fail to lower) — the XLA cached-
+      attention path is shardable and stays the multi-chip route
+    - head_dim 128-aligned and cache length 8-aligned: the only shapes
+      the Mosaic compilation is validated for (the bench model); the
+      tiny e2e model (head_dim 16) falls back to XLA
+    - ``KTPU_DISABLE_PALLAS_DECODE=1`` force-disables (escape hatch)
+    """
+    import os
+
+    if os.environ.get("KTPU_DISABLE_PALLAS_DECODE"):
+        return False
+    if head_dim % 128 or max_seq_len % 8:
+        return False
+    try:
+        return jax.default_backend() == "tpu" and len(jax.devices()) == 1
+    except Exception:
+        return False
 
 
 class LlamaAttention(nn.Module):
@@ -200,12 +235,17 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         b, s, _ = x.shape
         h, kv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype,
-                   cfg.quant)(x)
-        k = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "k_proj",
-                   cfg.dtype, cfg.quant)(x)
-        v = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "v_proj",
-                   cfg.dtype, cfg.quant)(x)
+        if cfg.fused_proj:
+            qkv = _dense((h + 2 * kv, d), ("embed", "heads", "head_dim"),
+                         "qkv_proj", cfg.dtype, cfg.quant)(x)
+            q, k, v = jnp.split(qkv, [h, h + kv], axis=-2)
+        else:
+            q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj",
+                       cfg.dtype, cfg.quant)(x)
+            k = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "k_proj",
+                       cfg.dtype, cfg.quant)(x)
+            v = _dense((kv, d), ("embed", "kv_heads", "head_dim"), "v_proj",
+                       cfg.dtype, cfg.quant)(x)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
@@ -225,37 +265,55 @@ class LlamaAttention(nn.Module):
                 raise NotImplementedError(
                     "packed segments are not supported in decode mode"
                 )
-            # static-shape KV cache: prefill writes s entries at the
-            # current index, decode appends one per step; attention
-            # always spans the full cache with a visibility mask
+            # Static-shape KV cache, HEAD-MAJOR [B, Hkv, S, D]: each
+            # (batch, head)'s keys are a contiguous [S, D] slab — the
+            # layout the fused decode kernel streams, and a better
+            # einsum layout for the XLA path too. Prefill writes s
+            # entries at the current index; decode appends one per
+            # step through the fused kernel (attention + in-place
+            # single-row cache update — the XLA fallback's functional
+            # update copies the whole cache every step).
             ck = self.variable(
                 "cache", "cached_key",
-                jnp.zeros, (b, cfg.max_seq_len, kv, d), cfg.dtype,
+                jnp.zeros, (b, kv, cfg.max_seq_len, d), cfg.dtype,
             )
             cv = self.variable(
                 "cache", "cached_value",
-                jnp.zeros, (b, cfg.max_seq_len, kv, d), cfg.dtype,
+                jnp.zeros, (b, kv, cfg.max_seq_len, d), cfg.dtype,
             )
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(ck.value.dtype), (0, cur, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cv.value.dtype), (0, cur, 0, 0)
-            )
+            kh = k.transpose(0, 2, 1, 3).astype(ck.value.dtype)  # [B,Hkv,s,D]
+            vh = v.transpose(0, 2, 1, 3).astype(cv.value.dtype)
+            use_fused = s == 1 and _use_pallas_decode(d, cfg.max_seq_len)
+            if use_fused:
+                from k8s_tpu.ops.attention import decode_attention_update
+
+                out, ck.value, cv.value = decode_attention_update(
+                    q[:, 0], kh[:, :, 0], vh[:, :, 0],
+                    ck.value, cv.value, cur,
+                    scale=1.0 / math.sqrt(d),
+                )
+                out = out[:, None]  # [B, 1, Hq, D]
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kh, (0, 0, cur, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vh, (0, 0, cur, 0)
+                )
+                q_pos = cur + jnp.arange(s)  # global positions, this chunk
+                k_pos = jnp.arange(cfg.max_seq_len)
+                mask = jnp.broadcast_to(
+                    k_pos[None, None, :] <= q_pos[None, :, None],
+                    (b, s, cfg.max_seq_len),
+                )
+                out = _cached_attention(
+                    q, ck.value, cv.value, mask, 1.0 / math.sqrt(d)
+                )
             idx.value = cur + s
-            q_pos = cur + jnp.arange(s)  # global positions of this chunk
-            k_pos = jnp.arange(cfg.max_seq_len)
-            mask = jnp.broadcast_to(
-                k_pos[None, None, :] <= q_pos[None, :, None],
-                (b, s, cfg.max_seq_len),
-            )
-            out = _cached_attention(
-                q, ck.value, cv.value, mask, 1.0 / math.sqrt(d)
-            )
         elif cfg.attention == "ring":
             from k8s_tpu.parallel.ring_attention import ring_attention
 
@@ -305,10 +363,15 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = _dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj",
-                      cfg.dtype, cfg.quant)(x)
-        up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj",
-                    cfg.dtype, cfg.quant)(x)
+        if cfg.fused_proj:
+            gate_up = _dense(2 * cfg.intermediate_size, ("embed", "mlp"),
+                             "gate_up_proj", cfg.dtype, cfg.quant)(x)
+            gate, up = jnp.split(gate_up, 2, axis=-1)
+        else:
+            gate = _dense(cfg.intermediate_size, ("embed", "mlp"),
+                          "gate_proj", cfg.dtype, cfg.quant)(x)
+            up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj",
+                        cfg.dtype, cfg.quant)(x)
         y = nn.silu(gate) * up
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
         return _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.dtype,
@@ -455,6 +518,55 @@ def _pick_token(logits_last, r, temperature):
     return jax.random.categorical(
         r, logits_last / temperature, axis=-1
     ).astype(jnp.int32)
+
+
+def unroll_params_for_decode(params, num_layers: int):
+    """Stacked (``scan_layers=True``, trained) params tree → per-layer
+    (``scan_layers=False``) layout for serving. Decode with an
+    UNROLLED layer loop is the big decode win: a scanned stacked cache
+    carry costs full-cache copies plus per-layer slab dynamic-slice/
+    update traffic every step (measured 56% → 75% of the decode
+    bandwidth roofline at batch 8; docs/BENCHMARKS.md)."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    block = params["layers"]["block"]
+    for i in range(num_layers):
+        out[f"layer_{i}"] = jax.tree_util.tree_map(lambda x: x[i], block)
+    return out
+
+
+def fuse_params_for_decode(params):
+    """Rewrite a canonical (trained) params tree into the
+    ``fused_proj=True`` layout: q/k/v kernels concatenated on the heads
+    axis into ``qkv_proj`` and gate/up on the features axis into
+    ``gate_up_proj``. Math-identical; the scan-stacked leading layer
+    axis passes through. Compose BEFORE quantize_params_for_serving."""
+
+    def rewrite(d):
+        if not isinstance(d, dict):
+            return d
+        if {"q_proj", "k_proj", "v_proj"} <= set(d):
+            out = {k: v for k, v in d.items()
+                   if k not in ("q_proj", "k_proj", "v_proj")}
+            out["qkv_proj"] = {
+                "kernel": jnp.concatenate(
+                    [d["q_proj"]["kernel"], d["k_proj"]["kernel"],
+                     d["v_proj"]["kernel"]], axis=-2,
+                )
+            }
+            return {k: rewrite(v) for k, v in out.items()}
+        if {"gate_proj", "up_proj"} <= set(d):
+            out = {k: v for k, v in d.items()
+                   if k not in ("gate_proj", "up_proj")}
+            out["gate_up_proj"] = {
+                "kernel": jnp.concatenate(
+                    [d["gate_proj"]["kernel"], d["up_proj"]["kernel"]],
+                    axis=-1,
+                )
+            }
+            return {k: rewrite(v) for k, v in out.items()}
+        return {k: rewrite(v) for k, v in d.items()}
+
+    return rewrite(params)
 
 
 # module-level jits keyed on (model, static shapes): defining these

@@ -726,3 +726,136 @@ def flash_attention(
     if not use_pallas:
         return mha_reference(q, k, v, causal, scale, segment_ids=segment_ids)
     return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused single-token decode attention (+ in-place KV-cache append)
+# ---------------------------------------------------------------------------
+
+
+def _decode_attn_kernel(
+    pos_ref,   # scalar prefetch: [1] int32 current cache index
+    q_ref,     # [1, 1, G, D]   queries of one (batch, kv-head) group
+    kn_ref,    # [1, 1, D]      this step's key
+    vn_ref,    # [1, 1, D]      this step's value
+    kc_ref,    # [1, 1, S, D]   key cache slab (aliased with ko)
+    vc_ref,    # [1, 1, S, D]   value cache slab (aliased with vo)
+    o_ref,     # [1, 1, G, D]
+    ko_ref,    # [1, 1, 1, D]   single-row cache write at pos
+    vo_ref,    # [1, 1, 1, D]
+    *, scale: float,
+):
+    """One (batch, kv-head) cell: masked attention of the G grouped
+    queries against cache[0:pos] PLUS the incoming token (handled as an
+    explicit extra term so the kernel never depends on reading its own
+    write), and the single-row cache append. f32 math throughout."""
+    import jax.numpy as jnp  # self-contained for clarity
+
+    pos = pos_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
+    kcache = kc_ref[0, 0].astype(jnp.float32)            # [S, D]
+    s_cache = jax.lax.dot_general(                       # [G, S]
+        q, kcache, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    k_idx = jax.lax.broadcasted_iota(jnp.int32, s_cache.shape, 1)
+    s_cache = jnp.where(k_idx < pos, s_cache, NEG_INF)
+    kn = kn_ref[0, 0, 0].astype(jnp.float32)             # [D]
+    s_new = jnp.sum(q * kn[None, :], axis=1, keepdims=True)  # [G, 1]
+
+    m = jnp.maximum(jnp.max(s_cache, axis=1, keepdims=True), s_new)
+    p_cache = jnp.exp(s_cache - m)                       # [G, S]
+    p_new = jnp.exp(s_new - m)                           # [G, 1]
+    l = jnp.sum(p_cache, axis=1, keepdims=True) + p_new
+    vcache = vc_ref[0, 0].astype(jnp.float32)            # [S, D]
+    acc = jax.lax.dot_general(                           # [G, D]
+        p_cache, vcache, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    vn = vn_ref[0, 0, 0].astype(jnp.float32)
+    acc = acc + p_new * vn[None, :]
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    # cache append: Mosaic wants >=8-row blocks, so the write covers the
+    # aligned 8-row window around pos — 7 rows carry the original cache
+    # content (read from the aliased input slab), one carries the new
+    # token
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    aligned = (pos // 8) * 8
+    win_k = kc_ref[0, 0, pl.ds(aligned, 8), :]               # [8, D] bf16
+    win_v = vc_ref[0, 0, pl.ds(aligned, 8), :]
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+    is_new = row == (pos - aligned)
+    ko_ref[0, 0] = jnp.where(is_new, kn_ref[0, 0, 0][None, :], win_k)
+    vo_ref[0, 0] = jnp.where(is_new, vn_ref[0, 0, 0][None, :], win_v)
+
+
+def decode_attention_update(
+    q: jax.Array,        # [B, Hq, D] this step's queries
+    k_new: jax.Array,    # [B, Hkv, D]
+    v_new: jax.Array,    # [B, Hkv, D]
+    k_cache: jax.Array,  # [B, Hkv, S, D] head-major cache
+    v_cache: jax.Array,  # [B, Hkv, S, D]
+    pos,                 # scalar int32: append index (= tokens so far)
+    scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """Fused single-token decode attention with IN-PLACE cache append.
+
+    Returns ``(out [B, Hq, D], k_cache', v_cache')`` where the caches
+    are the same buffers updated at row ``pos`` (``input_output_aliases``
+    — a functional XLA update instead copies the whole cache every
+    step, which measured ~3.2 us per cache row per step on v5e, the
+    dominant decode overhead; see docs/BENCHMARKS.md decode section).
+    The incoming token's attention term is computed from ``k_new``/
+    ``v_new`` directly, so the kernel never reads the row it writes.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if s % 8:
+        raise ValueError(f"cache length {s} must be a multiple of 8")
+    groups = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q4 = q.reshape(b, hkv, groups, d)
+    kn = k_new[:, :, None]  # [B, Hkv, 1, D]
+    vn = v_new[:, :, None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            # index maps are in BLOCK units: window pos//8 of 8-row blocks
+            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[0] // 8, 0)),
+            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[0] // 8, 0)),
+        ],
+    )
+    kernel = functools.partial(_decode_attn_kernel, scale=scale)
+    out, k2, v2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # operand indices count the scalar-prefetch arg too:
+        # 4=k_cache -> output 1, 5=v_cache -> output 2
+        input_output_aliases={4: 1, 5: 2},
+        interpret=interpret,
+    )(
+        jnp.asarray([pos], jnp.int32).reshape(1),
+        q4, kn.reshape(b, hkv, 1, d), vn.reshape(b, hkv, 1, d),
+        k_cache, v_cache,
+    )
+    return out.reshape(b, hq, d), k2, v2

@@ -245,8 +245,10 @@ def quantize_params_for_serving(params):
     # own per-channel scales
     out_axes = {
         "q_proj": (2, 3), "k_proj": (2, 3), "v_proj": (2, 3),  # [E,H,D]
+        "qkv_proj": (2, 3),       # fused layout (fuse_params_for_decode)
         "o_proj": (1, 3),                                      # [H,D,E]
         "gate_proj": (1, 2), "up_proj": (1, 2), "down_proj": (1, 2),
+        "gate_up_proj": (1, 2),   # fused layout
         "lm_head": (1, 2),                                     # [E, V]
     }
 

@@ -54,17 +54,30 @@ def main(rdzv) -> None:
     new_tokens = int(extra.get("new_tokens", "64"))
     temperature = float(extra.get("temperature", "0"))
 
+    import dataclasses
+
+    # serve with the layer loop UNROLLED: the scanned stacked cache
+    # carry costs full-cache copies + per-layer slab DS/DUS every step
+    # (56% -> 75% of the decode bandwidth roofline when unrolled;
+    # docs/BENCHMARKS.md). unroll_layers=0 opts back into scan.
+    unroll = extra.get("unroll_layers", "1") not in ("0", "false")
     max_seq = prompt_len + new_tokens
     if model_name == "llama3-8b":
         lcfg = LlamaConfig.llama3_8b(decode=True, remat=False,
-                                     max_seq_len=max_seq)
+                                     max_seq_len=max_seq,
+                                     scan_layers=not unroll)
     else:
         # same head layout as llama_train's tiny config, so trainer
         # checkpoints restore into the decode model
         lcfg = LlamaConfig.tiny(
             decode=True, max_seq_len=max(max_seq, 128),
             num_heads=8, num_kv_heads=4, head_dim=16,
+            scan_layers=not unroll,
         )
+    # checkpoints are stacked (trained with scan_layers=True): restore
+    # through a scanned twin, then unroll for serving
+    restore_cfg = dataclasses.replace(lcfg, scan_layers=True)
+    restore_model = LlamaForCausalLM(restore_cfg)
     model = LlamaForCausalLM(lcfg)
 
     prompt = jax.random.randint(
@@ -82,7 +95,9 @@ def main(rdzv) -> None:
     rules = LogicalRules(LogicalRules.TP)
 
     def boxed_init():
-        return model.init(jax.random.PRNGKey(0), prompt)
+        # scanned layout: matches trained checkpoints; unrolled for
+        # serving after restore (unroll_params_for_decode)
+        return restore_model.init(jax.random.PRNGKey(0), prompt)
 
     if cfg.checkpoint_dir:
         from k8s_tpu.train.checkpoint import CheckpointManager
@@ -120,10 +135,12 @@ def main(rdzv) -> None:
         lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
         params,
     )
+    if unroll:
+        from k8s_tpu.models import unroll_params_for_decode
+
+        params = unroll_params_for_decode(params, lcfg.num_layers)
 
     if extra.get("quant") == "int8_serving":
-        import dataclasses
-
         from k8s_tpu.ops.quant import quantize_params_for_serving
 
         # weight-only int8: kernels stored 1 B/param (+29% decode

@@ -1,0 +1,104 @@
+"""Fused decode-attention kernel + serving param converters.
+
+The decode path's three serving transforms must be math-identical to
+the canonical model: the pallas fused attention/cache-append kernel
+(vs a numpy reference), ``unroll_params_for_decode`` (scan → per-layer)
+and ``fuse_params_for_decode`` (split → fused projections), both
+checked end-to-end through ``generate()``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from k8s_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    fuse_params_for_decode,
+    generate,
+    unroll_params_for_decode,
+)
+from k8s_tpu.ops.attention import decode_attention_update
+
+
+class TestDecodeKernel:
+    @pytest.mark.parametrize("pos", [0, 7, 17, 63])
+    def test_matches_reference_and_updates_in_window(self, pos):
+        B, HQ, HKV, D, S = 2, 12, 4, 128, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        q = jax.random.normal(ks[0], (B, HQ, D), jnp.bfloat16)
+        kn = jax.random.normal(ks[1], (B, HKV, D), jnp.bfloat16)
+        vn = jax.random.normal(ks[2], (B, HKV, D), jnp.bfloat16)
+        kc = jax.random.normal(ks[3], (B, HKV, S, D), jnp.bfloat16)
+        vc = jax.random.normal(ks[4], (B, HKV, S, D), jnp.bfloat16)
+        out, k2, v2 = decode_attention_update(
+            q, kn, vn, kc, vc, pos, interpret=True
+        )
+        # reference: softmax attention over cache[:pos] + the new token
+        scale = 1.0 / np.sqrt(D)
+        qf = np.asarray(q, np.float32).reshape(B, HKV, 3, D) * scale
+        kcat = np.concatenate(
+            [np.asarray(kc[:, :, :pos], np.float32),
+             np.asarray(kn, np.float32)[:, :, None]], axis=2)
+        vcat = np.concatenate(
+            [np.asarray(vc[:, :, :pos], np.float32),
+             np.asarray(vn, np.float32)[:, :, None]], axis=2)
+        s = np.einsum("bhgd,bhkd->bhgk", qf, kcat)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhgk,bhkd->bhgd", p, vcat).reshape(B, HQ, D)
+        assert np.abs(np.asarray(out, np.float32) - ref).max() < 2e-2
+        # cache: exactly row `pos` replaced, everything else untouched
+        knp = np.asarray(kc).copy()
+        knp[:, :, pos] = np.asarray(kn)
+        vnp = np.asarray(vc).copy()
+        vnp[:, :, pos] = np.asarray(vn)
+        assert np.array_equal(np.asarray(k2), knp)
+        assert np.array_equal(np.asarray(v2), vnp)
+
+    def test_rejects_unaligned_cache(self):
+        B, HQ, HKV, D = 1, 4, 2, 128
+        q = jnp.zeros((B, HQ, D), jnp.bfloat16)
+        kn = vn = jnp.zeros((B, HKV, D), jnp.bfloat16)
+        kc = vc = jnp.zeros((B, HKV, 60, D), jnp.bfloat16)  # 60 % 8 != 0
+        with pytest.raises(ValueError, match="multiple of 8"):
+            decode_attention_update(q, kn, vn, kc, vc, 0, interpret=True)
+
+
+class TestServingTransforms:
+    def _setup(self):
+        cfg = LlamaConfig.tiny(decode=True, max_seq_len=48)
+        model = LlamaForCausalLM(cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size
+        )
+        params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
+        ref = generate(model, params, prompt, 12)
+        return cfg, params, prompt, ref
+
+    def test_unroll_params_equivalent(self):
+        cfg, params, prompt, ref = self._setup()
+        m2 = LlamaForCausalLM(dataclasses.replace(cfg, scan_layers=False))
+        p2 = unroll_params_for_decode(params, cfg.num_layers)
+        assert (generate(m2, p2, prompt, 12) == ref).all()
+
+    def test_fuse_params_equivalent(self):
+        cfg, params, prompt, ref = self._setup()
+        m2 = LlamaForCausalLM(dataclasses.replace(cfg, fused_proj=True))
+        p2 = fuse_params_for_decode(params)
+        assert (generate(m2, p2, prompt, 12) == ref).all()
+
+    def test_unroll_plus_fuse_equivalent(self):
+        cfg, params, prompt, ref = self._setup()
+        m2 = LlamaForCausalLM(
+            dataclasses.replace(cfg, scan_layers=False, fused_proj=True)
+        )
+        p2 = fuse_params_for_decode(
+            unroll_params_for_decode(params, cfg.num_layers)
+        )
+        assert (generate(m2, p2, prompt, 12) == ref).all()
