@@ -45,6 +45,11 @@ def main(argv=None) -> int:
                    help="keep the layer loop scanned in decode (default "
                         "unrolls: a scanned stacked cache carry costs "
                         "full-cache copies + per-layer slab DS/DUS)")
+    p.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                   help="int8: cache stored int8 + per-row scales, "
+                        "dequantized in VMEM by the fused kernel — "
+                        "halves the cache-read term that dominates "
+                        "long-context decode")
     p.add_argument("--fused-proj", action="store_true",
                    help="one qkv GEMM + one gate/up GEMM per layer "
                         "(fuse_params_for_decode); decode latency is "
@@ -58,7 +63,7 @@ def main(argv=None) -> int:
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
             max_seq_len=args.prompt_len + args.new_tokens,
             remat=False, decode=True, quant=args.quant,
-            scan_layers=args.scan_layers,
+            scan_layers=args.scan_layers, kv_quant=args.kv_quant,
         )
     else:
         cfg = LlamaConfig.tiny(decode=True, max_seq_len=64,
@@ -107,20 +112,37 @@ def main(argv=None) -> int:
         params = quantize_params_for_serving(params)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 
-    # warm (compiles prefill + decode loop)
+    # warm (compiles prefill + decode loop, both new_tokens variants)
     toks = generate(model, params, prompt, args.new_tokens)
     jax.block_until_ready(toks)
     int(toks[0, -1])  # host readback sync
+    int(generate(model, params, prompt, 1)[0, -1])
 
-    t0 = time.perf_counter()
     iters = 3
+    t0 = time.perf_counter()
     for i in range(iters):
         toks = generate(model, params, prompt, args.new_tokens)
         int(toks[0, -1])
     elapsed = time.perf_counter() - t0
+    # prefill(+dispatch) isolated by differencing against a 1-token run,
+    # so per_step_ms is DECODE-only — at long prompts the one-shot
+    # metric buried multi-hundred-ms prefills in the per-step average
+    t0 = time.perf_counter()
+    for i in range(iters):
+        int(generate(model, params, prompt, 1)[0, -1])
+    prefill_elapsed = time.perf_counter() - t0
 
     tok_per_sec = iters * args.batch * args.new_tokens / elapsed
-    per_step_ms = elapsed / (iters * args.new_tokens) * 1e3
+    if args.new_tokens >= 16:
+        per_step_ms = (
+            (elapsed - prefill_elapsed)
+            / (iters * (args.new_tokens - 1)) * 1e3
+        )
+    else:
+        # differencing two near-equal timings over <16 steps is noise
+        # (and undefined at 1); fall back to the conflated average
+        per_step_ms = elapsed / (iters * args.new_tokens) * 1e3
+    prefill_ms = prefill_elapsed / iters * 1e3
 
     # bandwidth roofline for batch-B single-token decode: params read
     # once per STEP (shared across the batch), KV cache read per ROW
@@ -131,6 +153,8 @@ def main(argv=None) -> int:
         "batch": args.batch,
         "quant": args.quant,
         "per_step_ms": round(per_step_ms, 2),
+        "prefill_ms": round(prefill_ms, 1),
+        "kv_quant": args.kv_quant,
         "params": n_params,
     }
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
@@ -139,10 +163,17 @@ def main(argv=None) -> int:
         param_bytes = sum(
             x.nbytes for x in jax.tree_util.tree_leaves(params)
         )
+        kv_elem_bytes = 1 if args.kv_quant == "int8" else 2
         kv_bytes = (
-            2 * 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
-            * cfg.max_seq_len * args.batch
+            kv_elem_bytes * 2 * cfg.num_layers * cfg.num_kv_heads
+            * cfg.head_dim * cfg.max_seq_len * args.batch
         )
+        if args.kv_quant == "int8":
+            # per-row f32 scales are read too
+            kv_bytes += (
+                4 * 2 * cfg.num_layers * cfg.num_kv_heads
+                * cfg.max_seq_len * args.batch
+            )
         roofline_ms = (param_bytes + kv_bytes) / (HBM_GBPS[gen] * 1e9) * 1e3
         result["roofline_step_ms"] = round(roofline_ms, 2)
         result["bandwidth_util"] = round(roofline_ms / per_step_ms, 3)

@@ -79,6 +79,11 @@ class LlamaConfig:
     # fusion-count-bound: 3 fewer GEMM dispatches per layer and x read
     # once per fused pair.
     fused_proj: bool = False
+    # "int8": KV cache STORED int8 with per-row scales, dequantized in
+    # VMEM by the fused decode kernel — halves the cache-read bandwidth
+    # term that dominates long-context decode. Numerics change
+    # (per-row symmetric quantization of cached k/v); opt-in.
+    kv_quant: str = "none"
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -202,7 +207,8 @@ def _cached_attention(q, k_all, v_all, mask, scale):
     return out.reshape(b, s, hq, d).astype(q.dtype)
 
 
-def _use_pallas_decode(head_dim: int, max_seq_len: int) -> bool:
+def _use_pallas_decode(head_dim: int, max_seq_len: int,
+                       kv_q8: bool = False) -> bool:
     """Pallas decode kernel gate. Deliberately conservative:
 
     - TPU backend only (tests exercise the kernel in interpret mode)
@@ -219,7 +225,7 @@ def _use_pallas_decode(head_dim: int, max_seq_len: int) -> bool:
 
     if os.environ.get("KTPU_DISABLE_PALLAS_DECODE"):
         return False
-    if head_dim % 128 or max_seq_len % 8:
+    if head_dim % 128 or max_seq_len % (32 if kv_q8 else 8):
         return False
     try:
         return jax.default_backend() == "tpu" and len(jax.devices()) == 1
@@ -273,22 +279,54 @@ class LlamaAttention(nn.Module):
             # step through the fused kernel (attention + in-place
             # single-row cache update — the XLA fallback's functional
             # update copies the whole cache every step).
+            if cfg.kv_quant not in ("none", "int8"):
+                raise ValueError(
+                    f"unknown kv_quant {cfg.kv_quant!r}; expected "
+                    "'none' or 'int8'"
+                )
+            kv_q8 = cfg.kv_quant == "int8"
+            cache_dtype = jnp.int8 if kv_q8 else cfg.dtype
             ck = self.variable(
                 "cache", "cached_key",
-                jnp.zeros, (b, kv, cfg.max_seq_len, d), cfg.dtype,
+                jnp.zeros, (b, kv, cfg.max_seq_len, d), cache_dtype,
             )
             cv = self.variable(
                 "cache", "cached_value",
-                jnp.zeros, (b, kv, cfg.max_seq_len, d), cfg.dtype,
+                jnp.zeros, (b, kv, cfg.max_seq_len, d), cache_dtype,
             )
+            if kv_q8:
+                # per-row dequant scales ride alongside the int8 cache
+                # [B, Hkv, 1, S]: the trailing-(1, S) layout Mosaic
+                # accepts for full-row scale blocks
+                kscale = self.variable(
+                    "cache", "key_scale",
+                    jnp.zeros, (b, kv, 1, cfg.max_seq_len), jnp.float32,
+                )
+                vscale = self.variable(
+                    "cache", "value_scale",
+                    jnp.zeros, (b, kv, 1, cfg.max_seq_len), jnp.float32,
+                )
             idx = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             cur = idx.value
-            kh = k.transpose(0, 2, 1, 3).astype(ck.value.dtype)  # [B,Hkv,s,D]
-            vh = v.transpose(0, 2, 1, 3).astype(cv.value.dtype)
-            use_fused = s == 1 and _use_pallas_decode(d, cfg.max_seq_len)
-            if use_fused:
+            kh = k.transpose(0, 2, 1, 3).astype(cfg.dtype)  # [B,Hkv,s,D]
+            vh = v.transpose(0, 2, 1, 3).astype(cfg.dtype)
+            use_fused = s == 1 and _use_pallas_decode(
+                d, cfg.max_seq_len, kv_q8
+            )
+            if use_fused and kv_q8:
+                from k8s_tpu.ops.attention import decode_attention_update_q8
+
+                (out, ck.value, cv.value, kscale.value, vscale.value) = (
+                    decode_attention_update_q8(
+                        q[:, 0], kh[:, :, 0], vh[:, :, 0],
+                        ck.value, cv.value, kscale.value, vscale.value,
+                        cur, scale=1.0 / math.sqrt(d),
+                    )
+                )
+                out = out[:, None]
+            elif use_fused:
                 from k8s_tpu.ops.attention import decode_attention_update
 
                 out, ck.value, cv.value = decode_attention_update(
@@ -298,12 +336,35 @@ class LlamaAttention(nn.Module):
                 )
                 out = out[:, None]  # [B, 1, Hq, D]
             else:
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, kh, (0, 0, cur, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, vh, (0, 0, cur, 0)
-                )
+                if kv_q8:
+                    from k8s_tpu.ops.attention import quantize_kv_rows
+
+                    kq, ksr = quantize_kv_rows(kh)
+                    vq, vsr = quantize_kv_rows(vh)
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, kq, (0, 0, cur, 0)
+                    )
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, vq, (0, 0, cur, 0)
+                    )
+                    kscale.value = jax.lax.dynamic_update_slice(
+                        kscale.value, ksr[:, :, None], (0, 0, 0, cur)
+                    )
+                    vscale.value = jax.lax.dynamic_update_slice(
+                        vscale.value, vsr[:, :, None], (0, 0, 0, cur)
+                    )
+                    k_all = (ck.value.astype(jnp.float32)
+                             * kscale.value[:, :, 0, :, None]).astype(cfg.dtype)
+                    v_all = (cv.value.astype(jnp.float32)
+                             * vscale.value[:, :, 0, :, None]).astype(cfg.dtype)
+                else:
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, kh, (0, 0, cur, 0)
+                    )
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, vh, (0, 0, cur, 0)
+                    )
+                    k_all, v_all = ck.value, cv.value
                 q_pos = cur + jnp.arange(s)  # global positions, this chunk
                 k_pos = jnp.arange(cfg.max_seq_len)
                 mask = jnp.broadcast_to(
@@ -311,7 +372,7 @@ class LlamaAttention(nn.Module):
                     (b, s, cfg.max_seq_len),
                 )
                 out = _cached_attention(
-                    q, ck.value, cv.value, mask, 1.0 / math.sqrt(d)
+                    q, k_all, v_all, mask, 1.0 / math.sqrt(d)
                 )
             idx.value = cur + s
         elif cfg.attention == "ring":
@@ -576,15 +637,38 @@ def fuse_params_for_decode(params):
 # params/cache go through jit as ARGUMENTS: a jitted closure over
 # concrete weight arrays embeds them as HLO constants, which makes
 # compilation pathologically slow.
-@functools.partial(jax.jit, static_argnames=("model", "temperature"))
-def _prefill(model, params, prompt_ids, r, temperature):
+@functools.partial(jax.jit, static_argnames=("model", "temperature", "chunk"))
+def _prefill(model, params, prompt_ids, r, temperature, chunk=0):
+    """Prompt ingestion. ``chunk`` > 0 processes the prompt in chunks
+    through the cache path (an unrolled static loop): the fallback
+    attention materializes f32 scores [B, Hq, s, max_seq], so one-shot
+    prefill of a long prompt is O(plen·max_seq) memory — chunking caps
+    it at O(chunk·max_seq) (B=16 at 4 k context OOMs one-shot, fits
+    chunked)."""
     b, plen = prompt_ids.shape
-    positions = jnp.broadcast_to(jnp.arange(plen), (b, plen))
-    logits, mut = model.apply(
-        {"params": params}, prompt_ids, positions=positions,
-        last_logit_only=True, mutable=["cache"],
-    )
-    return mut["cache"], _pick_token(logits[:, -1], r, temperature)
+    cache = None
+    start = 0
+    sizes = []
+    if chunk and plen > chunk:
+        head = plen % chunk
+        sizes = ([head] if head else []) + [chunk] * (plen // chunk)
+    else:
+        sizes = [plen]
+    for size in sizes:
+        ids = jax.lax.slice_in_dim(prompt_ids, start, start + size, axis=1)
+        positions = jnp.broadcast_to(
+            start + jnp.arange(size), (b, size)
+        )
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        logits, mut = model.apply(
+            variables, ids, positions=positions,
+            last_logit_only=True, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        start += size
+    return cache, _pick_token(logits[:, -1], r, temperature)
 
 
 @functools.partial(
@@ -621,6 +705,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    prefill_chunk: int = 512,
 ) -> jax.Array:
     """Autoregressive generation with a static KV cache.
 
@@ -647,7 +732,8 @@ def generate(
         rng = jax.random.PRNGKey(0)
     rng, prefill_rng = jax.random.split(rng)
 
-    cache, tok = _prefill(model, params, prompt_ids, prefill_rng, temperature)
+    cache, tok = _prefill(model, params, prompt_ids, prefill_rng,
+                           temperature, chunk=prefill_chunk)
 
     if max_new_tokens == 1:
         return tok[:, None]

@@ -859,3 +859,157 @@ def decode_attention_update(
         k_cache, v_cache,
     )
     return out.reshape(b, hq, d), k2, v2
+
+
+def _decode_attn_kernel_q8(
+    pos_ref,    # scalar prefetch: [1] int32
+    q_ref,      # [1, 1, G, D]
+    kn_ref,     # [1, 1, 1, D] bf16 new key
+    vn_ref,     # [1, 1, 1, D] bf16 new value
+    kc_ref,     # [1, 1, S, D] int8 key cache (aliased)
+    vc_ref,     # [1, 1, S, D] int8 value cache (aliased)
+    ks_ref,     # [1, 1, S]    f32 per-row key scales (aliased)
+    vs_ref,     # [1, 1, S]    f32 per-row value scales (aliased)
+    o_ref,      # [1, 1, G, D]
+    ko_ref,     # [1, 1, 32, D] int8 32-row aligned window
+    vo_ref,     # [1, 1, 32, D]
+    kso_ref,    # [1, 1, 1, S] full scale row (tiny)
+    vso_ref,    # [1, 1, 1, S]
+    *, scale: float,
+):
+    """int8-KV variant: the cache is STORED int8 with per-row scales
+    and dequantized in VMEM — HBM reads halve, which is the decode
+    bandwidth term that grows with context. The current token's
+    attention term uses the exact bf16 k/v; its row is quantized here
+    and appended in place."""
+    pos = pos_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32) * scale              # [G, D]
+    # dequant folded into the SMALL [G, S] matrices, not the [S, D]
+    # cache: convert int8 -> f32 for the MXU (1 VPU op/element) and
+    # apply the per-row scales to the scores/probs afterwards (G*S
+    # elements, ~40x fewer than S*D)
+    s_cache = jax.lax.dot_general(
+        q, kc_ref[0, 0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * ks_ref[0, 0, 0][None, :]
+    k_idx = jax.lax.broadcasted_iota(jnp.int32, s_cache.shape, 1)
+    s_cache = jnp.where(k_idx < pos, s_cache, NEG_INF)
+    kn = kn_ref[0, 0, 0].astype(jnp.float32)
+    s_new = jnp.sum(q * kn[None, :], axis=1, keepdims=True)
+    m = jnp.maximum(jnp.max(s_cache, axis=1, keepdims=True), s_new)
+    p_cache = jnp.exp(s_cache - m)
+    p_new = jnp.exp(s_new - m)
+    l = jnp.sum(p_cache, axis=1, keepdims=True) + p_new
+    acc = jax.lax.dot_general(
+        p_cache * vs_ref[0, 0, 0][None, :],
+        vc_ref[0, 0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    vn = vn_ref[0, 0, 0].astype(jnp.float32)
+    acc = acc + p_new * vn[None, :]
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+    # quantize + append the new row (32-row aligned window: int8 native
+    # sublane tile), preserving the other 31 rows from the aliased slab
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    aligned = (pos // 32) * 32
+    row = jax.lax.broadcasted_iota(jnp.int32, (32, 1), 0)
+    is_new = row == (pos - aligned)
+
+    def q8(x):
+        amax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-6)
+        s8 = amax / 127.0
+        return jnp.round(x / s8).astype(jnp.int8), s8
+
+    kn_q, kn_s = q8(kn)
+    vn_q, vn_s = q8(vn)
+    win_k = kc_ref[0, 0, pl.ds(aligned, 32), :]
+    win_v = vc_ref[0, 0, pl.ds(aligned, 32), :]
+    ko_ref[0, 0] = jnp.where(is_new, kn_q[None, :], win_k)
+    vo_ref[0, 0] = jnp.where(is_new, vn_q[None, :], win_v)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (1, ks_ref.shape[3]), 1)[0]
+    kso_ref[0, 0, 0] = jnp.where(s_idx == pos, kn_s, ks_ref[0, 0, 0])
+    vso_ref[0, 0, 0] = jnp.where(s_idx == pos, vn_s, vs_ref[0, 0, 0])
+
+
+def decode_attention_update_q8(
+    q: jax.Array,        # [B, Hq, D] bf16
+    k_new: jax.Array,    # [B, Hkv, D] bf16
+    v_new: jax.Array,    # [B, Hkv, D] bf16
+    k_cache: jax.Array,  # [B, Hkv, S, D] int8
+    v_cache: jax.Array,  # [B, Hkv, S, D] int8
+    k_scale: jax.Array,  # [B, Hkv, 1, S] f32 per-row scales
+    v_scale: jax.Array,  # [B, Hkv, 1, S] f32
+    pos,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """int8-KV fused decode step. Returns
+    ``(out, k_cache', v_cache', k_scale', v_scale')`` with all four
+    cache arrays updated IN PLACE at row ``pos`` (the new row is
+    quantized in-kernel: per-row symmetric int8, scale = amax/127)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if s % 32:
+        raise ValueError(f"int8 cache length {s} must be a multiple of 32")
+    groups = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q4 = q.reshape(b, hkv, groups, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[0] // 32, 0)),
+            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[0] // 32, 0)),
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
+        ],
+    )
+    kernel = functools.partial(_decode_attn_kernel_q8, scale=scale)
+    out, k2, v2, ks2, vs2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        # operand indices incl. the scalar-prefetch arg:
+        # 4=k_cache->1, 5=v_cache->2, 6=k_scale->3, 7=v_scale->4
+        input_output_aliases={4: 1, 5: 2, 6: 3, 7: 4},
+        interpret=interpret,
+    )(
+        jnp.asarray([pos], jnp.int32).reshape(1),
+        q4, k_new[:, :, None], v_new[:, :, None],
+        k_cache, v_cache, k_scale, v_scale,
+    )
+    return out.reshape(b, hq, d), k2, v2, ks2, vs2
+
+
+def quantize_kv_rows(x: jax.Array):
+    """Per-row symmetric int8 for KV-cache storage: x [..., S, D] →
+    (int8 [..., S, D], f32 scales [..., S]). The XLA-side quantizer for
+    prefill writes; the decode kernel quantizes its own appends."""
+    amax = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1), 1e-6)
+    s8 = amax / 127.0
+    q = jnp.round(x.astype(jnp.float32) / s8[..., None]).astype(jnp.int8)
+    return q, s8

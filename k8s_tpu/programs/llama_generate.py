@@ -61,18 +61,20 @@ def main(rdzv) -> None:
     # (56% -> 75% of the decode bandwidth roofline when unrolled;
     # docs/BENCHMARKS.md). unroll_layers=0 opts back into scan.
     unroll = extra.get("unroll_layers", "1") not in ("0", "false")
+    kv_quant = extra.get("kv_quant", "none")  # "int8": int8 KV cache
     max_seq = prompt_len + new_tokens
     if model_name == "llama3-8b":
         lcfg = LlamaConfig.llama3_8b(decode=True, remat=False,
                                      max_seq_len=max_seq,
-                                     scan_layers=not unroll)
+                                     scan_layers=not unroll,
+                                     kv_quant=kv_quant)
     else:
         # same head layout as llama_train's tiny config, so trainer
         # checkpoints restore into the decode model
         lcfg = LlamaConfig.tiny(
             decode=True, max_seq_len=max(max_seq, 128),
             num_heads=8, num_kv_heads=4, head_dim=16,
-            scan_layers=not unroll,
+            scan_layers=not unroll, kv_quant=kv_quant,
         )
     # checkpoints are stacked (trained with scan_layers=True): restore
     # through a scanned twin, then unroll for serving

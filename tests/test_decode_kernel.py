@@ -102,3 +102,62 @@ class TestServingTransforms:
             unroll_params_for_decode(params, cfg.num_layers)
         )
         assert (generate(m2, p2, prompt, 12) == ref).all()
+
+
+class TestInt8KvCache:
+    def test_q8_kernel_matches_dequant_reference(self):
+        from k8s_tpu.ops.attention import (
+            decode_attention_update_q8,
+            quantize_kv_rows,
+        )
+
+        B, HQ, HKV, D, S = 2, 12, 4, 128, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        q = jax.random.normal(ks[0], (B, HQ, D), jnp.bfloat16)
+        kn = jax.random.normal(ks[1], (B, HKV, D), jnp.bfloat16)
+        vn = jax.random.normal(ks[2], (B, HKV, D), jnp.bfloat16)
+        kc, ksc = quantize_kv_rows(
+            jax.random.normal(ks[3], (B, HKV, S, D), jnp.bfloat16))
+        vc, vsc = quantize_kv_rows(
+            jax.random.normal(ks[4], (B, HKV, S, D), jnp.bfloat16))
+        pos = 33
+        out, k2, v2, ks2, vs2 = decode_attention_update_q8(
+            q, kn, vn, kc, vc, ksc[:, :, None], vsc[:, :, None], pos,
+            interpret=True)
+        ks2, vs2 = ks2[:, :, 0], vs2[:, :, 0]
+        scale = 1.0 / np.sqrt(D)
+        kdq = np.asarray(kc, np.float32) * np.asarray(ksc)[..., None]
+        vdq = np.asarray(vc, np.float32) * np.asarray(vsc)[..., None]
+        qf = np.asarray(q, np.float32).reshape(B, HKV, 3, D) * scale
+        kcat = np.concatenate(
+            [kdq[:, :, :pos], np.asarray(kn, np.float32)[:, :, None]], axis=2)
+        vcat = np.concatenate(
+            [vdq[:, :, :pos], np.asarray(vn, np.float32)[:, :, None]], axis=2)
+        s = np.einsum("bhgd,bhkd->bhgk", qf, kcat)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhgk,bhkd->bhgd", p, vcat).reshape(B, HQ, D)
+        assert np.abs(np.asarray(out, np.float32) - ref).max() < 2e-2
+        # the appended row dequantizes back to the new k within int8 error
+        row = (np.asarray(k2[:, :, pos], np.float32)
+               * np.asarray(ks2[:, :, pos])[..., None])
+        assert np.abs(row - np.asarray(kn, np.float32)).max() < 0.05
+        # untouched rows preserved (cache AND scales)
+        m = np.arange(S) != pos
+        assert np.array_equal(np.asarray(v2)[:, :, m], np.asarray(vc)[:, :, m])
+        assert np.array_equal(np.asarray(ks2)[:, :, m], np.asarray(ksc)[:, :, m])
+
+    def test_generate_with_int8_kv_close_to_bf16(self):
+        # XLA fallback path (CPU): int8 KV changes numerics slightly;
+        # greedy tokens should mostly agree with the bf16-cache run on
+        # a random tiny model
+        cfg = LlamaConfig.tiny(decode=True, max_seq_len=64)
+        model = LlamaForCausalLM(cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
+        ref = generate(model, params, prompt, 24)
+        m8 = LlamaForCausalLM(dataclasses.replace(cfg, kv_quant="int8"))
+        t8 = generate(m8, params, prompt, 24)
+        agree = float((ref == t8).mean())
+        assert agree > 0.7, f"greedy agreement {agree:.2f}"
