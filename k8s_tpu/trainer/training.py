@@ -77,6 +77,7 @@ class TrainingJob:
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._rejected_spec: Optional[dict] = None  # dedupe rejections
 
     # ------------------------------------------------------------ identity
 
@@ -390,8 +391,76 @@ class TrainingJob:
                 except Exception as e:
                     log.error("job %s: deleteResources error: %s", self.fullname, e)
                 return
-            # modify events are accepted but, like the reference
-            # (controller.go:154-159), spec mutation is not acted on.
+            if typ == _EVENT_MODIFY and _new is not None:
+                self._handle_modify(_new)
+
+    def _handle_modify(self, new_job: TpuJob) -> None:
+        """Spec-change policy for MODIFIED events. The reference left
+        this a TODO and silently ignored edits (controller.go:154-159)
+        — the one place matching it would preserve a known hole. Here:
+
+        - ``maxGangRestarts`` is MUTABLE: the fault budget may be
+          raised/lowered on a live job (a safe, reconciler-only knob).
+        - Everything else (replicas, templates, topology) is immutable
+          once running — resizing a TPU gang means new rendezvous info
+          for every process, i.e. a new job. Rejected LOUDLY with a
+          Warning event, and the stored spec is REVERTED to the running
+          configuration (the status write below carries the whole
+          object), so `kubectl get` never shows a spec the gang isn't
+          actually running — with no admission webhook, revert-and-warn
+          is the next-strongest enforcement.
+
+        Self-inflicted MODIFIED events (our own status writes) diff as
+        empty and fall through without noise.
+        """
+        old_d = self.job.spec.to_dict()
+        new_d = new_job.spec.to_dict()
+        if new_d.get("maxGangRestarts") != old_d.get("maxGangRestarts"):
+            log.info(
+                "job %s: maxGangRestarts %s -> %s", self.fullname,
+                self.job.spec.max_gang_restarts,
+                new_job.spec.max_gang_restarts,
+            )
+            self.job.spec.max_gang_restarts = new_job.spec.max_gang_restarts
+            old_d = self.job.spec.to_dict()
+        if new_d == old_d:
+            self._rejected_spec = None  # user reverted; re-arm reporting
+            return
+        if self._rejected_spec == new_d:
+            # already reported exactly this attempted spec: revert the
+            # store again (quietly) so it keeps matching reality
+            self._revert_spec()
+            return
+        self._rejected_spec = new_d
+        changed = sorted(
+            k for k in set(old_d) | set(new_d)
+            if old_d.get(k) != new_d.get(k)
+        )
+        log.warning(
+            "job %s: rejecting immutable spec change: %s",
+            self.fullname, changed,
+        )
+        self.status.append_condition(
+            "SpecChangeRejected", reason=f"immutable fields: {changed}"
+        )
+        self.client.record_event(
+            self.job.metadata.namespace,
+            {"kind": "TpuJob", "name": self.name},
+            "SpecChangeRejected",
+            f"spec fields {changed} are immutable on a running job; "
+            "reverting to the running configuration — delete and "
+            "recreate to resize",
+            etype="Warning",
+        )
+        # persists the condition AND reverts the stored spec (the write
+        # carries self.job, whose spec is the running one)
+        self.update_crd_status()
+
+    def _revert_spec(self) -> None:
+        try:
+            self.job = self.job_client.update(self.job)
+        except Exception as e:
+            log.warning("job %s: spec revert failed: %s", self.fullname, e)
 
     @property
     def finished(self) -> bool:

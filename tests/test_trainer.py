@@ -589,3 +589,62 @@ class TestGangRestart:
         tj2.reconcile(cfg2)
         assert tj2.status.gang_restarts == 0
         assert tj2.status.state == S.TpuJobState.FAILED
+
+
+class TestModifyEvents:
+    """Spec-change policy (the reference silently ignored MODIFIED,
+    controller.go:154-159 — an explicit TODO there): mutable knobs
+    apply, immutable changes are rejected loudly."""
+
+    def _running(self):
+        client, jc = make_env()
+        tj = make_job(client, jc, worker_replicas=2)
+        jc.create(tj.job)
+        cfg = S.ControllerConfig()
+        tj.reconcile(cfg)
+        return client, jc, tj, cfg
+
+    def test_max_gang_restarts_is_mutable(self):
+        client, jc, tj, cfg = self._running()
+        new = S.TpuJob.from_dict(tj.job.to_dict())
+        new.spec.max_gang_restarts = 7
+        tj._handle_modify(new)
+        assert tj.job.spec.max_gang_restarts == 7
+        # no rejection noise for a pure mutable-field change
+        assert not any(
+            c.type == "SpecChangeRejected" for c in tj.status.conditions
+        )
+
+    def test_immutable_change_rejected_with_event(self):
+        client, jc, tj, cfg = self._running()
+        new = S.TpuJob.from_dict(tj.job.to_dict())
+        new.spec.replica_specs[1].replicas = 5  # resize attempt
+        tj._handle_modify(new)
+        # unchanged behavior: still 2 workers materialized
+        assert tj.job.spec.replica_specs[1].replicas == 2
+        assert any(
+            c.type == "SpecChangeRejected" for c in tj.status.conditions
+        )
+        assert any(
+            e.reason == "SpecChangeRejected"
+            for e in client.events.list("default")
+        )
+        # the stored spec is REVERTED to the running configuration
+        assert jc.get("default", "myjob").spec.replica_specs[1].replicas == 2
+        # repeated identical modify: no event spam, but still reverted
+        n = len(client.events.list("default"))
+        tj._handle_modify(new)
+        assert len(client.events.list("default")) == n
+        # a DIFFERENT value for the same field is a new request: loud again
+        new2 = S.TpuJob.from_dict(tj.job.to_dict())
+        new2.spec.replica_specs[1].replicas = 8
+        tj._handle_modify(new2)
+        assert len(client.events.list("default")) == n + 1
+
+    def test_self_inflicted_modify_is_noise_free(self):
+        client, jc, tj, cfg = self._running()
+        same = S.TpuJob.from_dict(tj.job.to_dict())
+        tj._handle_modify(same)
+        assert not any(
+            c.type == "SpecChangeRejected" for c in tj.status.conditions
+        )
