@@ -38,8 +38,6 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-import urllib.error
-import urllib.request
 
 from k8s_tpu.api import errors, wire
 from k8s_tpu.api.cluster import WatchEvent
@@ -180,33 +178,83 @@ class RestCluster:
         self._ctx = ssl_context
         self._timeout = timeout
         self._last_rv = 0
+        self._local = threading.local()  # per-thread keep-alive conn
+        import urllib.parse
+
+        # a base-URL path prefix (proxied clusters, kubectl proxy
+        # sub-paths) must prefix every request target
+        self._path_prefix = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
         # kubelet-simulator hooks don't exist on a real cluster; the
         # attribute exists so local-mode code can feature-test it
         self.hooks: List[Any] = []
 
     # ------------------------------------------------------------ http
 
+    def _new_conn(self, timeout: float):
+        import http.client
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(self.base_url)
+        if parsed.scheme == "https":
+            return http.client.HTTPSConnection(
+                parsed.hostname, parsed.port or 443,
+                context=self._ctx, timeout=timeout,
+            )
+        return http.client.HTTPConnection(
+            parsed.hostname, parsed.port or 80, timeout=timeout,
+        )
+
     def _open(self, method: str, path: str, body: Optional[Dict[str, Any]] = None,
               params: Optional[Dict[str, str]] = None, stream: bool = False):
-        url = self.base_url + path
+        """One HTTP exchange over a THREAD-LOCAL persistent connection
+        (keep-alive): stdlib urllib opens a fresh TCP connection per
+        request, which capped the controller at ~40 reconcilers before
+        request latency starved the reconcile loop. A stale keep-alive
+        (server closed between requests) is retried once on a fresh
+        connection; streams get their own connection since the watch
+        holds it open indefinitely."""
+        import http.client
+
         q = wire.encode_query(params or {})
-        if q:
-            url += "?" + q
+        target = self._path_prefix + path + ("?" + q if q else "")
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
+        headers = {"Accept": "application/json"}
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            headers["Content-Type"] = "application/json"
         if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
+            headers["Authorization"] = f"Bearer {self._token}"
+
         # streams still need a read timeout: a connection dropped without
         # FIN/RST would otherwise hang the watch thread forever. Slightly
         # above the 300s server-side watch bound so normal timeouts win.
         timeout = 330.0 if stream else self._timeout
-        try:
-            return urllib.request.urlopen(req, timeout=timeout, context=self._ctx)
-        except urllib.error.HTTPError as e:
-            _raise_for_status(e.code, e.read())
+        if stream:
+            conn = self._new_conn(timeout)  # dedicated: held open by watch
+        else:
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._new_conn(timeout)
+                self._local.conn = conn
+        for attempt in (0, 1):
+            try:
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+                break
+            except (OSError, http.client.HTTPException):
+                # OSError covers Connection*/BrokenPipe/timeouts/DNS
+                conn.close()
+                conn = self._new_conn(timeout)
+                if not stream:
+                    self._local.conn = conn
+                # POST is not idempotent: a create may have committed
+                # before the connection died — surface the error rather
+                # than re-send and manufacture an AlreadyExists
+                if attempt or method == "POST":
+                    raise
+        if resp.status >= 400:
+            body_bytes = resp.read()  # drains; connection stays reusable
+            _raise_for_status(resp.status, body_bytes)
+        return resp
 
     def _call(self, method: str, path: str, body: Optional[Dict[str, Any]] = None,
               params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:

@@ -40,6 +40,9 @@ log = logging.getLogger(__name__)
 
 RECONCILE_INTERVAL = 8.0  # reference training.go:23
 EVENT_QUEUE_CAP = 100  # reference training.go:412
+# identical rejected spec edits re-report at most this often (caps the
+# event/condition churn of a GitOps loop re-applying a bad spec)
+REJECTION_REPORT_INTERVAL = 300.0
 
 _EVENT_DELETE = "delete"
 _EVENT_MODIFY = "modify"
@@ -78,6 +81,7 @@ class TrainingJob:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._rejected_spec: Optional[dict] = None  # dedupe rejections
+        self._rejected_at = 0.0
 
     # ------------------------------------------------------------ identity
 
@@ -424,14 +428,25 @@ class TrainingJob:
             self.job.spec.max_gang_restarts = new_job.spec.max_gang_restarts
             old_d = self.job.spec.to_dict()
         if new_d == old_d:
-            self._rejected_spec = None  # user reverted; re-arm reporting
+            # either the user reverted, or this is the self-inflicted
+            # MODIFIED from our own revert write — do NOT clear the
+            # dedupe state here: a GitOps loop re-applying the same bad
+            # spec every sync interleaves self-events between applies,
+            # and clearing would make every apply loud again (churning
+            # the 10-deep condition ring). The time window below re-arms
+            # reporting instead.
             return
-        if self._rejected_spec == new_d:
-            # already reported exactly this attempted spec: revert the
-            # store again (quietly) so it keeps matching reality
+        import time as _time
+
+        now = _time.monotonic()
+        if self._rejected_spec == new_d and \
+                now - self._rejected_at < REJECTION_REPORT_INTERVAL:
+            # same attempted spec within the window: revert the store
+            # again (quietly) so it keeps matching reality
             self._revert_spec()
             return
         self._rejected_spec = new_d
+        self._rejected_at = now
         changed = sorted(
             k for k in set(old_d) | set(new_d)
             if old_d.get(k) != new_d.get(k)
