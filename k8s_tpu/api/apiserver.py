@@ -36,7 +36,7 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from k8s_tpu.api import errors, wire
 from k8s_tpu.api.cluster import InMemoryCluster
@@ -112,41 +112,115 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         log.debug("apiserver: " + fmt, *args)
 
+    def _check_auth(self) -> bool:
+        """Bearer-token check (when the server was given tokens) —
+        simulates bound-SA-token expiry so the client's re-read-on-401
+        path is contract-testable."""
+        tokens = self.server.valid_tokens
+        if tokens is None:
+            return True
+        auth = self.headers.get("Authorization", "")
+        tok = auth[7:] if auth.startswith("Bearer ") else ""
+        if tok in tokens:
+            return True
+        # drain any body so the keep-alive connection stays in sync
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        if n:
+            self.rfile.read(n)
+        self._send_status(401, "Unauthorized", "invalid or expired token")
+        return False
+
+    def _send_api_error(self, e: Exception) -> None:
+        """Catch-all (round-2 advisor): every backend failure becomes a
+        structured metav1.Status, never a dropped keep-alive connection
+        that the client can only report as a transport error."""
+        if isinstance(e, errors.ApiError):
+            reason = type(e).__name__.removesuffix("Error") or "InternalError"
+            self._send_status(getattr(e, "code", 500) or 500, reason, str(e))
+        else:
+            self._send_status(500, "InternalError", f"{type(e).__name__}: {e}")
+
+    def _paginate(self, items, query):
+        """Serve ``limit``/``continue`` chunking (client-go style): the
+        continue token is an opaque base64 offset. Real-apiserver
+        caveat applies here too: pagination under concurrent writes is
+        only self-consistent per page."""
+        import base64
+
+        try:
+            limit = int(query.get("limit", "0") or 0)
+        except ValueError:
+            limit = 0
+        offset = 0
+        if query.get("continue"):
+            try:
+                offset = int(json.loads(
+                    base64.b64decode(query["continue"]).decode())["offset"])
+            except Exception:
+                raise errors.InvalidError("malformed continue token")
+        if not limit or offset + limit >= len(items):
+            return items[offset:], None
+        token = base64.b64encode(
+            json.dumps({"offset": offset + limit}).encode()).decode()
+        return items[offset:offset + limit], token
+
     def _req(self) -> Optional[_Request]:
         r = _parse_path(self.path)
         if r is None:
             self._send_status(404, "NotFound", f"no such path {self.path}")
+            return None
+        verb = self.command
+        if verb == "GET" and r.name is None:
+            verb = "WATCH" if r.query.get("watch") in ("true", "1") else "LIST"
+        with self.server.stats_lock:
+            self.server.stats[(verb, r.kind)] = \
+                self.server.stats.get((verb, r.kind), 0) + 1
         return r
 
     # ------------------------------------------------------------ verbs
 
     def do_GET(self):  # noqa: N802
+        if not self._check_auth():
+            return
         r = self._req()
         if r is None:
             return
+        if not r.is_crd_registry and r.name is None and \
+                r.query.get("watch") in ("true", "1"):
+            # dispatched OUTSIDE the catch-all: once the stream's 200 +
+            # chunked headers are out, a Status body cannot be injected
+            # — _serve_watch owns its error handling end to end
+            return self._serve_watch(r)
         try:
             if r.is_crd_registry:
                 return self._get_crd(r)
             if r.name is not None:
                 obj = self.cluster.get(r.kind, r.namespace or "default", r.name)
                 return self._send_json(200, wire.stamp_type_meta(r.kind, obj))
-            if r.query.get("watch") in ("true", "1"):
-                return self._serve_watch(r)
             sel = (wire.parse_label_selector(r.query["labelSelector"])
                    if "labelSelector" in r.query else None)
             items = self.cluster.list(r.kind, r.namespace, sel)
+            items, cont = self._paginate(items, r.query)
+            meta: Dict[str, Any] = {
+                "resourceVersion": str(self.cluster.resource_version)}
+            if cont:
+                meta["continue"] = cont
             return self._send_json(200, {
                 "kind": f"{r.kind}List",
                 "apiVersion": wire.ROUTES[r.kind].api_version,
-                "metadata": {"resourceVersion": str(self.cluster.resource_version)},
+                "metadata": meta,
                 "items": [wire.stamp_type_meta(r.kind, o) for o in items],
             })
         except errors.NotFoundError as e:
             self._send_status(404, "NotFound", str(e))
         except errors.OutdatedVersionError as e:
             self._send_status(410, "Gone", str(e))
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send_api_error(e)
 
     def do_POST(self):  # noqa: N802
+        if not self._check_auth():
+            return
         body = self._read_body()  # drain before any error response —
         # leftover body bytes would desync a keep-alive connection
         r = self._req()
@@ -163,10 +237,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(201, wire.stamp_type_meta(r.kind, created))
         except errors.AlreadyExistsError as e:
             self._send_status(409, "AlreadyExists", str(e))
-        except errors.ApiError as e:
-            self._send_status(e.code, "Invalid", str(e))
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send_api_error(e)
 
     def do_PUT(self):  # noqa: N802
+        if not self._check_auth():
+            return
         body = self._read_body()  # drain before any error response
         r = self._req()
         if r is None:
@@ -183,8 +259,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(404, "NotFound", str(e))
         except errors.ConflictError as e:
             self._send_status(409, "Conflict", str(e))
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send_api_error(e)
 
     def do_DELETE(self):  # noqa: N802
+        if not self._check_auth():
+            return
         r = self._req()
         if r is None:
             return
@@ -206,6 +286,8 @@ class _Handler(BaseHTTPRequestHandler):
             })
         except errors.NotFoundError as e:
             self._send_status(404, "NotFound", str(e))
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send_api_error(e)
 
     # ------------------------------------------------------------ CRDs
 
@@ -234,12 +316,15 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ watch
 
     def _serve_watch(self, r: _Request) -> None:
-        rv = r.query.get("resourceVersion")
-        timeout_s = float(r.query.get("timeoutSeconds", "0") or 0)
         try:
-            watcher = self.cluster.watch(
-                r.kind, r.namespace, int(rv) if rv not in (None, "", "0") else None
-            )
+            rv = r.query.get("resourceVersion")
+            timeout_s = float(r.query.get("timeoutSeconds", "0") or 0)
+            start_rv = int(rv) if rv not in (None, "", "0") else None
+        except ValueError:
+            return self._send_status(400, "BadRequest",
+                                     "bad resourceVersion/timeoutSeconds")
+        try:
+            watcher = self.cluster.watch(r.kind, r.namespace, start_rv)
         except errors.OutdatedVersionError as e:
             # real apiserver behavior: the stream opens, then reports
             # staleness as an ERROR frame carrying a 410 Status
@@ -258,6 +343,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         deadline = time.monotonic() + timeout_s if timeout_s else None
+        bookmarks = r.query.get("allowWatchBookmarks") in ("true", "1")
+        last_bookmark = time.monotonic()
         try:
             while not self.server.stopping:
                 ev = watcher.next(timeout=0.2)
@@ -267,14 +354,35 @@ class _Handler(BaseHTTPRequestHandler):
                     # (and re-dial) exactly like a real watch
                     if deadline is not None and time.monotonic() > deadline:
                         break
+                    if bookmarks and time.monotonic() - last_bookmark > 1.0:
+                        # idle progress marker: lets a quiet kind's
+                        # watcher re-dial from a fresh RV instead of an
+                        # ancient one that would 410 (real apiserver
+                        # sends these ~per minute; 1s here so tests see
+                        # them quickly)
+                        last_bookmark = time.monotonic()
+                        self._write_chunk(json.dumps({
+                            "type": "BOOKMARK",
+                            "object": {
+                                "kind": r.kind,
+                                "apiVersion": wire.ROUTES[r.kind].api_version,
+                                "metadata": {"resourceVersion": str(
+                                    self.cluster.resource_version)},
+                            },
+                        }) + "\n")
                     continue
                 frame = {
                     "type": ev.type,
                     "object": wire.stamp_type_meta(ev.kind, dict(ev.object)),
                 }
                 self._write_chunk(json.dumps(frame) + "\n")
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        except Exception as e:  # noqa: BLE001 - headers already sent:
+            # nothing structured can be written anymore; drop the
+            # connection cleanly and let the client re-dial (its EOF
+            # path). Pipe/reset errors are the normal client-vanished
+            # case, anything else gets logged.
+            if not isinstance(e, (BrokenPipeError, ConnectionResetError)):
+                log.warning("watch %s: stream aborted: %s", r.kind, e)
         finally:
             watcher.stop()
         try:
@@ -292,6 +400,19 @@ class _Server(ThreadingHTTPServer):
     daemon_threads = True
     cluster: InMemoryCluster
     stopping = False
+    # O(100) clients (operators, kubelets, user pollers) may connect in
+    # one burst; the socketserver default backlog of 5 RSTs the rest
+    request_queue_size = 256
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        # request bill per (verb, kind) — lets scale tests assert the
+        # operator's request RATE, not just its outcomes
+        self.stats: Dict[Tuple[str, str], int] = {}
+        self.stats_lock = threading.Lock()
+        # None = no auth; a set = every request must bear one of these
+        # tokens (simulates bound-SA-token expiry for contract tests)
+        self.valid_tokens = None
 
 
 class LocalApiServer:
@@ -299,14 +420,25 @@ class LocalApiServer:
     (possibly shared) InMemoryCluster over the real wire format."""
 
     def __init__(self, cluster: Optional[InMemoryCluster] = None, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", auth_tokens=None):
         self.cluster = cluster or InMemoryCluster()
         self._server = _Server((host, port), _Handler)
         self._server.cluster = self.cluster
+        if auth_tokens is not None:
+            self._server.valid_tokens = set(auth_tokens)
         self.host = host
         self.port = self._server.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def stats(self) -> Dict[Tuple[str, str], int]:
+        with self._server.stats_lock:
+            return dict(self._server.stats)
+
+    def set_auth_tokens(self, tokens) -> None:
+        """Rotate the accepted token set (simulates SA-token expiry)."""
+        self._server.valid_tokens = set(tokens) if tokens is not None else None
 
     def start(self) -> "LocalApiServer":
         self._thread = threading.Thread(

@@ -78,12 +78,33 @@ class KubeClient:
         # in-memory by default; any backend with the same method surface
         # (RestCluster against a real apiserver) drops in unchanged
         self.cluster = cluster if cluster is not None else InMemoryCluster()
+        # a watch-fed object cache (k8s_tpu.api.informer.Informer) the
+        # operator attaches via start_informer(); when present and
+        # synced, trainer reads go through it instead of the apiserver
+        self.informer = None
         self.pods = _TypedResource(self.cluster, "Pod", Pod)
         self.services = _TypedResource(self.cluster, "Service", Service)
         self.jobs = _TypedResource(self.cluster, "Job", Job)
         self.config_maps = _TypedResource(self.cluster, "ConfigMap", ConfigMap)
         self.deployments = _TypedResource(self.cluster, "Deployment", Deployment)
         self.events = _TypedResource(self.cluster, "Event", Event)
+
+    def start_informer(self, namespace=None, wait: bool = True):
+        """Attach and start a watch-fed cache (idempotent). The operator
+        calls this once at startup; local tools that do one-shot CRUD
+        never need it."""
+        if self.informer is None:
+            from k8s_tpu.api.informer import Informer
+
+            self.informer = Informer(self.cluster, namespace=namespace).start()
+            if wait:
+                self.informer.wait_for_sync()
+        return self.informer
+
+    def stop_informer(self) -> None:
+        if self.informer is not None:
+            self.informer.stop()
+            self.informer = None
 
     # -- events (the reference used a FakeRecorder, main.go:133 — a gap
     # SURVEY §5 says to close with real K8s Events) ----------------------
@@ -111,10 +132,18 @@ def get_cluster_client(kubeconfig: Optional[str] = None) -> KubeClient:
 
     1. ``KTPU_APISERVER_URL`` env — an explicit apiserver URL (e.g. a
        :mod:`k8s_tpu.api.apiserver` dev server, or a ``kubectl proxy``)
-    2. ``kubeconfig`` arg, then ``KUBECONFIG`` env, then
-       ``~/.kube/config`` if present
+    2. ``kubeconfig`` arg (the operator's ``--kubeconfig``), then
+       ``KUBECONFIG`` env — both EXPLICIT opt-ins
     3. in-cluster serviceaccount (KUBERNETES_SERVICE_HOST + token mount)
     4. in-memory cluster (local/test mode)
+
+    Real-cluster mode is never entered implicitly: a bare
+    ``~/.kube/config`` on the machine is NOT used unless named by (2)
+    — mutating whatever cluster a developer's kubeconfig happens to
+    point at (CRD creation, election, job adoption/GC) must be asked
+    for, not stumbled into (round-2 advisor finding). The reference
+    behaved the same way: KUBECONFIG env or in-cluster only
+    (``k8sutil.go:45-65``).
     """
     import os
 
@@ -124,10 +153,6 @@ def get_cluster_client(kubeconfig: Optional[str] = None) -> KubeClient:
     if url:
         return KubeClient(restcluster.RestCluster(url))
     path = kubeconfig or os.environ.get("KUBECONFIG")
-    if not path:
-        default = os.path.expanduser("~/.kube/config")
-        if os.path.exists(default):
-            path = default
     if path:
         return KubeClient(restcluster.kubeconfig_config(path))
     in_cluster = restcluster.in_cluster_config()

@@ -35,6 +35,38 @@ class OutdatedVersionError(ApiError):
     code = 410
 
 
+class UnauthorizedError(ApiError):
+    """401 — credentials missing/expired. Real clusters rotate bound
+    serviceaccount tokens (~1h); the REST client re-reads its token
+    source and retries once before surfacing this."""
+
+    code = 401
+
+
+class ForbiddenError(ApiError):
+    """403 — authenticated but RBAC-denied. NOT retryable: retrying a
+    403 just hammers the apiserver; it needs a ClusterRole fix."""
+
+    code = 403
+
+
+class InvalidError(ApiError):
+    """422 — the object failed server-side validation. Not retryable."""
+
+    code = 422
+
+
+class TooManyRequestsError(ApiError):
+    """429 — apiserver client-side throttling (APF). Retryable after
+    the Retry-After the server names."""
+
+    code = 429
+
+    def __init__(self, message: str = "", retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 def is_not_found(e: Exception) -> bool:
     return isinstance(e, NotFoundError)
 

@@ -1,0 +1,240 @@
+"""Watch-fed shared object cache — the informer the reference never had.
+
+The reference's hot loop polled the apiserver every 8s with
+O(replicas) round-trips per job (``pkg/trainer/replicas.go:432-467``:
+a batch-Job GET plus a Pod LIST per replica index), which SURVEY §7.2
+hard part #4 flags as the design that "won't scale to 128-host
+slices; use informers + pod-condition aggregation". This module is
+that informer: the operator opens ONE watch stream per kind, keeps a
+local cache of every object it manages, and the reconcilers read the
+cache — steady-state reconcile makes **zero** apiserver calls.
+
+Two feed mechanisms, chosen per backend:
+
+- :class:`k8s_tpu.api.cluster.InMemoryCluster` fires its ``hooks``
+  synchronously inside the commit, so the cache is updated *before*
+  the mutating call returns — a perfectly fresh cache for tests and
+  single-host local mode.
+- Any other backend (:class:`k8s_tpu.api.restcluster.RestCluster`
+  against a real apiserver or the local wire-format one) gets a
+  watch thread per kind: LIST to prime the cache, stream from the
+  list's resourceVersion, relist on 410 Gone — client-go reflector
+  semantics (the reference got these for free from client-go; we own
+  them).
+
+Cache readers must tolerate eventual consistency on the REST path:
+an object the reconciler just deleted may still be cached for a few
+milliseconds. The trainer handles that with delete tombstones
+(``trainer/replicas.py``) — the informer itself stays a dumb mirror.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from k8s_tpu.api import errors
+from k8s_tpu.api.cluster import InMemoryCluster, WatchEvent, _matches
+
+log = logging.getLogger(__name__)
+
+DEFAULT_KINDS = ("Job", "Pod", "Service", "ConfigMap", "Deployment")
+
+
+class _KindCache:
+    """Mirror of one kind: ``(namespace, name) -> object dict``."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.lock = threading.RLock()
+        self.objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.synced = threading.Event()
+
+    @staticmethod
+    def _rv(obj: Dict[str, Any]) -> int:
+        try:
+            return int((obj.get("metadata") or {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def apply(self, ev: WatchEvent) -> None:
+        key = (ev.namespace or "default", ev.name)
+        with self.lock:
+            if ev.type == "DELETED":
+                self.objects.pop(key, None)
+            elif ev.type in ("ADDED", "MODIFIED"):
+                cur = self.objects.get(key)
+                # never regress to an older copy (initial-list overlap)
+                if cur is None or self._rv(ev.object) >= self._rv(cur):
+                    self.objects[key] = copy.deepcopy(ev.object)
+
+    def replace(self, items: List[Dict[str, Any]]) -> None:
+        """Relist: the list snapshot becomes the whole cache (objects
+        deleted while the watch was down must vanish)."""
+        fresh = {
+            ((o.get("metadata") or {}).get("namespace", "default"),
+             (o.get("metadata") or {}).get("name", "")): copy.deepcopy(o)
+            for o in items
+        }
+        with self.lock:
+            self.objects = fresh
+
+    def get(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        with self.lock:
+            obj = self.objects.get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, namespace: Optional[str],
+             selector: Optional[Dict[str, str]]) -> List[Dict[str, Any]]:
+        with self.lock:
+            out = []
+            for (ns, _), obj in sorted(self.objects.items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector and not _matches(
+                    (obj.get("metadata") or {}).get("labels", {}) or {}, selector
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+
+class Informer:
+    """Shared watch-fed cache over the kinds the trainer manages."""
+
+    def __init__(self, cluster, kinds=DEFAULT_KINDS, namespace: Optional[str] = None):
+        self.cluster = cluster
+        self.namespace = namespace
+        self.caches: Dict[str, _KindCache] = {k: _KindCache(k) for k in kinds}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._hook = None
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Informer":
+        if self._started:
+            return self
+        self._started = True
+        if isinstance(self.cluster, InMemoryCluster):
+            # synchronous feed: the cache commits inside the cluster's
+            # own commit, so readers never observe staleness. Events
+            # fired while we prime from list() are BUFFERED and
+            # replayed after: applying them live could interleave a
+            # DELETED before its object's stale listed copy, leaving a
+            # phantom entry with no further event to evict it.
+            state = {"priming": True, "buffer": []}
+
+            def hook(ev: WatchEvent) -> None:
+                if ev.kind not in self.caches or (
+                    self.namespace is not None and ev.namespace != self.namespace
+                ):
+                    return
+                if state["priming"]:
+                    state["buffer"].append(ev)
+                    return
+                self.caches[ev.kind].apply(ev)
+
+            self._hook = hook
+            self.cluster.hooks.append(hook)
+            for kind, cache in self.caches.items():
+                for obj in self.cluster.list(kind, self.namespace):
+                    cache.apply(WatchEvent("ADDED", kind, obj))
+            # drain + flip under the cluster's commit lock (hooks fire
+            # while it is held, so no event can race the flip)
+            with self.cluster._lock:
+                for ev in state["buffer"]:
+                    self.caches[ev.kind].apply(ev)
+                state["priming"] = False
+            for cache in self.caches.values():
+                cache.synced.set()
+            return self
+        for kind in self.caches:
+            t = threading.Thread(
+                target=self._reflect, args=(kind,), daemon=True,
+                name=f"informer-{kind.lower()}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hook is not None and self._hook in getattr(self.cluster, "hooks", []):
+            self.cluster.hooks.remove(self._hook)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        import time
+
+        end = time.monotonic() + timeout
+        for cache in self.caches.values():
+            remaining = end - time.monotonic()
+            if remaining <= 0 or not cache.synced.wait(remaining):
+                return False
+        return True
+
+    @property
+    def synced(self) -> bool:
+        return all(c.synced.is_set() for c in self.caches.values())
+
+    # ------------------------------------------------------------ reflector
+
+    def _reflect(self, kind: str) -> None:
+        """client-go reflector loop: list → watch(rv) → apply; relist on
+        410; re-dial on stream errors (the RestWatcher already re-dials
+        EOFs internally — only staleness surfaces here)."""
+        cache = self.caches[kind]
+        backoff = 0.0
+        while not self._stop.is_set():
+            if backoff and self._stop.wait(backoff):
+                return
+            try:
+                lister = getattr(self.cluster, "list_with_rv", None)
+                if lister is not None:
+                    # the LIST's own resourceVersion is the watch
+                    # anchor; the client-wide high-water mark can be
+                    # AHEAD of this snapshot (other threads share the
+                    # client) and would skip events committed between
+                    items, rv = lister(kind, self.namespace)
+                else:
+                    items = self.cluster.list(kind, self.namespace)
+                    rv = self.cluster.resource_version
+                cache.replace(items)
+                cache.synced.set()
+                watcher = self.cluster.watch(kind, self.namespace, rv)
+            except Exception as e:
+                backoff = min(max(backoff * 2, 0.5), 15.0)
+                log.warning("informer %s: list/watch failed (%s); retry in %.1fs",
+                            kind, e, backoff)
+                continue
+            backoff = 0.0
+            try:
+                while not self._stop.is_set():
+                    ev = watcher.next(timeout=0.2)
+                    if ev is None:
+                        continue
+                    cache.apply(ev)
+            except errors.OutdatedVersionError:
+                log.info("informer %s: watch outdated; relisting", kind)
+            except Exception as e:
+                backoff = 1.0
+                log.warning("informer %s: watch error (%s); relisting", kind, e)
+            finally:
+                try:
+                    watcher.stop()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------ readers
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        return self.caches[kind].get(namespace, name)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        return self.caches[kind].list(namespace, label_selector)

@@ -45,13 +45,18 @@ from k8s_tpu.api.cluster import WatchEvent
 log = logging.getLogger(__name__)
 
 
-def _raise_for_status(code: int, body: bytes) -> None:
+def _raise_for_status(code: int, body: bytes,
+                      retry_after: Optional[str] = None) -> None:
     try:
         status = json.loads(body or b"{}")
     except ValueError:
         status = {}
     message = status.get("message", body.decode(errors="replace")[:200])
     reason = status.get("reason", "")
+    if code == 401:
+        raise errors.UnauthorizedError(message)
+    if code == 403:
+        raise errors.ForbiddenError(message)
     if code == 404:
         raise errors.NotFoundError(message)
     if code == 409:
@@ -60,7 +65,42 @@ def _raise_for_status(code: int, body: bytes) -> None:
         raise errors.AlreadyExistsError(message)
     if code == 410:
         raise errors.OutdatedVersionError(message)
+    if code == 422:
+        raise errors.InvalidError(message)
+    if code == 429:
+        try:
+            after = float(retry_after) if retry_after else 1.0
+        except ValueError:
+            after = 1.0
+        raise errors.TooManyRequestsError(message, retry_after=after)
     raise errors.ApiError(f"HTTP {code}: {message}")
+
+
+class FileTokenSource:
+    """Bound serviceaccount tokens rotate (~1h on real clusters); the
+    reference's client-go re-read them transparently
+    (``tf_job_client.go:56-86`` via rest.InClusterConfig). This source
+    re-reads the mounted token file with a short TTL cache, and
+    ``force=True`` (the 401-retry path) bypasses the cache."""
+
+    def __init__(self, path: str, ttl: float = 60.0):
+        self.path = path
+        self.ttl = ttl
+        self._cached: Optional[str] = None
+        self._read_at = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self, force: bool = False) -> Optional[str]:
+        with self._lock:
+            now = time.monotonic()
+            if force or self._cached is None or now - self._read_at > self.ttl:
+                try:
+                    with open(self.path) as f:
+                        self._cached = f.read().strip()
+                except OSError:
+                    pass  # keep the stale token; better than none
+                self._read_at = now
+            return self._cached
 
 
 class RestWatcher:
@@ -110,7 +150,11 @@ class RestWatcher:
             # EOF / server timeout: re-dial from last seen RV
 
     def _stream_once(self) -> None:
-        params = {"watch": "true", "timeoutSeconds": "300"}
+        params = {"watch": "true", "timeoutSeconds": "300",
+                  # BOOKMARK frames advance our re-dial RV on quiet
+                  # kinds, so an EOF re-dial doesn't start from an RV
+                  # old enough to 410
+                  "allowWatchBookmarks": "true"}
         if self._rv is not None:
             params["resourceVersion"] = str(self._rv)
         resp = self._cluster._open(
@@ -140,6 +184,10 @@ class RestWatcher:
                         self._rv = int(rv)
                     except ValueError:
                         pass
+                if frame.get("type") == "BOOKMARK":
+                    # progress marker only — consumed here (rv noted
+                    # above), never surfaced as an object event
+                    continue
                 self.q.put(WatchEvent(frame["type"], self.kind, obj))
 
     # -- consumer side (Watcher interface) ------------------------------
@@ -170,11 +218,22 @@ class RestWatcher:
 class RestCluster:
     """The InMemoryCluster method surface, over HTTP."""
 
-    def __init__(self, base_url: str, token: Optional[str] = None,
+    # paged LISTs: a real apiserver truncates large collections unless
+    # the client follows metadata.continue; client-go defaults 500
+    LIST_PAGE_LIMIT = 500
+    # 429 (API priority & fairness) retry budget
+    MAX_THROTTLE_RETRIES = 3
+
+    def __init__(self, base_url: str, token=None,
                  ssl_context: Optional[ssl.SSLContext] = None,
                  timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
-        self._token = token
+        # `token` is a str (static) or a callable(force: bool) -> str
+        # (rotating source, e.g. FileTokenSource for bound SA tokens)
+        if token is None or callable(token):
+            self._token_source = token
+        else:
+            self._token_source = lambda force=False: token
         self._ctx = ssl_context
         self._timeout = timeout
         self._last_rv = 0
@@ -218,11 +277,16 @@ class RestCluster:
         q = wire.encode_query(params or {})
         target = self._path_prefix + path + ("?" + q if q else "")
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Accept": "application/json"}
-        if data is not None:
-            headers["Content-Type"] = "application/json"
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
+
+        def headers_for(force_token: bool) -> Dict[str, str]:
+            h = {"Accept": "application/json"}
+            if data is not None:
+                h["Content-Type"] = "application/json"
+            if self._token_source is not None:
+                tok = self._token_source(force=force_token)
+                if tok:
+                    h["Authorization"] = f"Bearer {tok}"
+            return h
 
         # streams still need a read timeout: a connection dropped without
         # FIN/RST would otherwise hang the watch thread forever. Slightly
@@ -235,11 +299,13 @@ class RestCluster:
             if conn is None:
                 conn = self._new_conn(timeout)
                 self._local.conn = conn
-        for attempt in (0, 1):
+        conn_retried = auth_retried = False
+        force_token = False
+        while True:
             try:
-                conn.request(method, target, body=data, headers=headers)
+                conn.request(method, target, body=data,
+                             headers=headers_for(force_token))
                 resp = conn.getresponse()
-                break
             except (OSError, http.client.HTTPException):
                 # OSError covers Connection*/BrokenPipe/timeouts/DNS
                 conn.close()
@@ -248,20 +314,45 @@ class RestCluster:
                     self._local.conn = conn
                 # POST is not idempotent: a create may have committed
                 # before the connection died — surface the error rather
-                # than re-send and manufacture an AlreadyExists
-                if attempt or method == "POST":
+                # than re-send and manufacture an AlreadyExists.
+                # NOTE a retried PUT can also observe its OWN committed
+                # first attempt: a CAS PUT (election renew) that died
+                # mid-response gets 409 Conflict from its own write. The
+                # elector treats that as indeterminate and re-reads the
+                # lock before conceding (election.py) — same behavior
+                # class as client-go's retry semantics.
+                if conn_retried or method == "POST":
                     raise
+                conn_retried = True
+                continue
+            if resp.status == 401 and not auth_retried and \
+                    self._token_source is not None:
+                # bound SA token rotated underneath us: re-read the
+                # source (force) and retry once
+                resp.read()
+                auth_retried = True
+                force_token = True
+                continue
+            break
         if resp.status >= 400:
             body_bytes = resp.read()  # drains; connection stays reusable
-            _raise_for_status(resp.status, body_bytes)
+            _raise_for_status(resp.status, body_bytes,
+                              retry_after=resp.headers.get("Retry-After"))
         return resp
 
     def _call(self, method: str, path: str, body: Optional[Dict[str, Any]] = None,
               params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
-        with self._open(method, path, body, params) as resp:
-            out = json.loads(resp.read() or b"{}")
-        self._note_rv(out)
-        return out
+        for attempt in range(self.MAX_THROTTLE_RETRIES + 1):
+            try:
+                with self._open(method, path, body, params) as resp:
+                    out = json.loads(resp.read() or b"{}")
+                self._note_rv(out)
+                return out
+            except errors.TooManyRequestsError as e:
+                # APF throttling: honor Retry-After (bounded), retry
+                if attempt >= self.MAX_THROTTLE_RETRIES:
+                    raise
+                time.sleep(min(e.retry_after, 10.0))
 
     def _note_rv(self, obj: Dict[str, Any]) -> None:
         rv = (obj.get("metadata") or {}).get("resourceVersion")
@@ -304,12 +395,37 @@ class RestCluster:
 
     def list(self, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
-        params: Dict[str, str] = {}
+        """Paged list: follows ``metadata.continue`` so collections
+        larger than one server page (e.g. the Pods of a v5p-128 job)
+        aren't silently truncated — client-go chunking semantics."""
+        return self.list_with_rv(kind, namespace, label_selector)[0]
+
+    def list_with_rv(self, kind: str, namespace: Optional[str] = None,
+                     label_selector: Optional[Dict[str, str]] = None):
+        """List + the list's OWN ``metadata.resourceVersion`` — the only
+        correct anchor for a reflector's subsequent watch. Anchoring on
+        the client-wide ``resource_version`` high-water mark instead
+        would skip any event committed (by another thread on this
+        shared client) between the LIST snapshot and the watch start."""
+        params: Dict[str, str] = {"limit": str(self.LIST_PAGE_LIMIT)}
         if label_selector:
             params["labelSelector"] = wire.format_label_selector(label_selector)
-        out = self._call("GET", wire.ROUTES[kind].collection_path(namespace),
-                         params=params)
-        return out.get("items", [])
+        items: List[Dict[str, Any]] = []
+        list_rv = 0
+        while True:
+            out = self._call("GET", wire.ROUTES[kind].collection_path(namespace),
+                             params=params)
+            items.extend(out.get("items", []))
+            if not list_rv:
+                try:
+                    list_rv = int((out.get("metadata") or {})
+                                  .get("resourceVersion", 0))
+                except (TypeError, ValueError):
+                    list_rv = 0
+            cont = (out.get("metadata") or {}).get("continue")
+            if not cont:
+                return items, list_rv
+            params["continue"] = cont
 
     def delete_collection(self, kind: str, namespace: str,
                           label_selector: Dict[str, str]) -> int:
@@ -350,22 +466,32 @@ IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 def in_cluster_config() -> Optional[RestCluster]:
     """Pod-environment bootstrap (reference InClusterConfig branch,
     ``k8sutil.go:45-65``): KUBERNETES_SERVICE_HOST/PORT + mounted
-    serviceaccount token/CA."""
+    serviceaccount token/CA. The token is a rotating
+    :class:`FileTokenSource`, not a one-shot read — bound SA tokens
+    expire (~1h) and kubelet refreshes the mounted file; a long-running
+    operator must pick the refresh up (round 2 read it once and would
+    have gone permanently 401)."""
     host = os.environ.get("KUBERNETES_SERVICE_HOST")
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     if not host or not os.path.exists(IN_CLUSTER_TOKEN):
         return None
-    with open(IN_CLUSTER_TOKEN) as f:
-        token = f.read().strip()
     ctx = ssl.create_default_context(
         cafile=IN_CLUSTER_CA if os.path.exists(IN_CLUSTER_CA) else None
     )
-    return RestCluster(f"https://{host}:{port}", token=token, ssl_context=ctx)
+    return RestCluster(f"https://{host}:{port}",
+                       token=FileTokenSource(IN_CLUSTER_TOKEN),
+                       ssl_context=ctx)
 
 
 def kubeconfig_config(path: str) -> RestCluster:
     """KUBECONFIG bootstrap: current-context server + user credentials
-    (token or client cert/key), CA or insecure-skip-tls-verify."""
+    (token or client cert/key), CA or insecure-skip-tls-verify.
+
+    Credential hygiene (round-2 advisor finding): the CA loads from
+    memory (``cadata``), and inline client cert/key material only ever
+    touches disk as a 0600 tempfile that is unlinked before this
+    function returns — nothing outlives the call, let alone the
+    process."""
     import base64
     import tempfile
 
@@ -389,25 +515,35 @@ def kubeconfig_config(path: str) -> RestCluster:
         if cluster.get("insecure-skip-tls-verify"):
             ssl_ctx = ssl._create_unverified_context()  # user asked for it
         else:
-            cafile = cluster.get("certificate-authority")
-            if not cafile and cluster.get("certificate-authority-data"):
-                tmp = tempfile.NamedTemporaryFile(
-                    "wb", suffix=".crt", delete=False)
-                tmp.write(base64.b64decode(cluster["certificate-authority-data"]))
-                tmp.close()
-                cafile = tmp.name
-            ssl_ctx = ssl.create_default_context(cafile=cafile)
+            cadata = None
+            if cluster.get("certificate-authority-data"):
+                cadata = base64.b64decode(
+                    cluster["certificate-authority-data"]).decode()
+            ssl_ctx = ssl.create_default_context(
+                cafile=cluster.get("certificate-authority"), cadata=cadata)
         certfile, keyfile = user.get("client-certificate"), user.get("client-key")
-        if not certfile and user.get("client-certificate-data"):
-            for field, suffix in (("client-certificate-data", ".crt"),
-                                  ("client-key-data", ".key")):
-                tmp = tempfile.NamedTemporaryFile("wb", suffix=suffix, delete=False)
-                tmp.write(base64.b64decode(user[field]))
-                tmp.close()
-                if suffix == ".crt":
-                    certfile = tmp.name
-                else:
-                    keyfile = tmp.name
-        if certfile:
-            ssl_ctx.load_cert_chain(certfile, keyfile)
+        tmp_paths: List[str] = []
+        try:
+            if not certfile and user.get("client-certificate-data"):
+                for field, suffix in (("client-certificate-data", ".crt"),
+                                      ("client-key-data", ".key")):
+                    fd, tmp_path = tempfile.mkstemp(suffix=suffix)
+                    tmp_paths.append(tmp_path)
+                    os.fchmod(fd, 0o600)
+                    with os.fdopen(fd, "wb") as tf:
+                        tf.write(base64.b64decode(user[field]))
+                    if suffix == ".crt":
+                        certfile = tmp_path
+                    else:
+                        keyfile = tmp_path
+            if certfile:
+                ssl_ctx.load_cert_chain(certfile, keyfile)
+        finally:
+            for p in tmp_paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    log.warning("kubeconfig bootstrap: operator will drive REAL cluster %s "
+                "(context %s)", server, ctx_name)
     return RestCluster(server, token=user.get("token"), ssl_context=ssl_ctx)

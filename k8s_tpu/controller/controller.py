@@ -49,6 +49,7 @@ class Controller:
         self.jobs: Dict[str, TrainingJob] = {}  # reference jobs map, :46-61
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._owns_informer = False
 
     # ------------------------------------------------------------ bootstrap
 
@@ -56,6 +57,12 @@ class Controller:
         """Create the CRD if needed and wait Established (reference
         initResource + createCRD, controller.go:213-286). Returns the
         resourceVersion to start watching from."""
+        if self.client.informer is None:
+            # the informer replaces the reference's per-tick polling
+            # (SURVEY §7.2 #4): one watch stream per kind, reconcilers
+            # read the cache — not O(replicas) GETs every 8s
+            self.client.start_informer(namespace=self.namespace)
+            self._owns_informer = True
         try:
             self.job_client.create_crd_definition()
         except errors.AlreadyExistsError:
@@ -177,6 +184,9 @@ class Controller:
             tj.stop()
         for tj in list(self.jobs.values()):
             tj.join(timeout=5)
+        if self._owns_informer:
+            self.client.stop_informer()
+            self._owns_informer = False
 
     def wait_for_job(
         self, namespace: str, name: str, timeout: float = 300.0, poll: float = 0.05

@@ -66,6 +66,14 @@ class TensorBoardReplicaSet:
         )
 
     def create(self) -> None:
+        # informer-backed existence check: steady-state reconcile ticks
+        # must not POST (the AlreadyExists round-trip is still O(1) per
+        # tick, but with the cache it is zero)
+        inf = getattr(self.client, "informer", None)
+        if inf is not None and inf.synced and \
+                inf.get("Deployment", self.namespace, self.name()) is not None and \
+                inf.get("Service", self.namespace, self.name()) is not None:
+            return
         owner = [self.job.job.as_owner()]
         container = Container(
             name="tensorboard",

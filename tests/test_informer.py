@@ -1,0 +1,210 @@
+"""Informer cache: the watch-fed status path that kills the polling loop.
+
+The reference's hot loop cost 8s x O(replicas) apiserver round-trips
+per job (SURVEY §3.3, ``pkg/trainer/replicas.go:432-467``) and §7.2
+hard part #4 calls for informers + pod-condition aggregation instead.
+These tests pin the new contract:
+
+- the cache mirrors the cluster through both feed mechanisms
+  (synchronous hooks in-memory, reflector threads over REST);
+- a controller at steady state makes ZERO apiserver reads or writes
+  per reconcile tick (the counting-client test VERDICT round 2 asked
+  for);
+- the gang-restart path still works when reads come from the cache,
+  including the stale-cache window (tombstones).
+"""
+
+from __future__ import annotations
+
+import time
+
+from k8s_tpu.api.apiserver import LocalApiServer
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.informer import Informer
+from k8s_tpu.api.objects import ObjectMeta, Service, ServiceSpec
+from k8s_tpu.api.restcluster import RestCluster
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.runtime.kubelet import LocalKubelet, SimulatedExecutor
+from k8s_tpu import spec as S
+
+
+def _svc(name: str, labels=None) -> Service:
+    return Service(
+        metadata=ObjectMeta(name=name, namespace="default", labels=labels or {}),
+        spec=ServiceSpec(selector={}, ports=[]),
+    )
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestCacheFeeds:
+    def test_in_memory_hook_feed_is_synchronous(self):
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        client.services.create(_svc("pre-existing"))
+        inf = Informer(cluster).start()
+        assert inf.synced
+        # pre-existing object primed
+        assert inf.get("Service", "default", "pre-existing") is not None
+        # mutations visible IMMEDIATELY after the call returns (hooks
+        # fire inside the cluster's commit)
+        client.services.create(_svc("live", labels={"a": "b"}))
+        assert inf.get("Service", "default", "live") is not None
+        assert len(inf.list("Service", "default", {"a": "b"})) == 1
+        client.services.delete("default", "live")
+        assert inf.get("Service", "default", "live") is None
+        inf.stop()
+
+    def test_reflector_over_rest(self):
+        api = LocalApiServer().start()
+        try:
+            rest = RestCluster(api.url)
+            seed = KubeClient(RestCluster(api.url))
+            seed.services.create(_svc("before-start"))
+            inf = Informer(rest).start()
+            assert inf.wait_for_sync(15)
+            assert inf.get("Service", "default", "before-start") is not None
+            seed.services.create(_svc("after-start", labels={"x": "y"}))
+            _wait(lambda: inf.get("Service", "default", "after-start") is not None,
+                  msg="ADDED to reach reflector")
+            seed.services.delete("default", "after-start")
+            _wait(lambda: inf.get("Service", "default", "after-start") is None,
+                  msg="DELETED to reach reflector")
+            inf.stop()
+        finally:
+            api.stop()
+
+
+class CountingCluster(InMemoryCluster):
+    """InMemoryCluster that counts every API verb, so a test can assert
+    an exact request bill for a control-plane phase."""
+
+    def __init__(self):
+        super().__init__()
+        self.counts = {}
+
+    def _count(self, verb: str):
+        self.counts[verb] = self.counts.get(verb, 0) + 1
+
+    def create(self, *a, **k):
+        self._count("create")
+        return super().create(*a, **k)
+
+    def get(self, *a, **k):
+        self._count("get")
+        return super().get(*a, **k)
+
+    def update(self, *a, **k):
+        self._count("update")
+        return super().update(*a, **k)
+
+    def delete(self, *a, **k):
+        self._count("delete")
+        return super().delete(*a, **k)
+
+    def list(self, *a, **k):
+        self._count("list")
+        return super().list(*a, **k)
+
+    def delete_collection(self, *a, **k):
+        self._count("delete_collection")
+        return super().delete_collection(*a, **k)
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+
+class TestZeroSteadyStateCalls:
+    def test_running_job_reconciles_with_zero_api_calls(self):
+        """The VERDICT round-2 'done' criterion: during steady-state
+        reconcile of a RUNNING job, the operator performs ZERO apiserver
+        calls — reads come from the informer cache, and the unchanged
+        status produces no write. Round 2 cost ~5 calls/replica/tick."""
+        cluster = CountingCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        kubelet = LocalKubelet(client, SimulatedExecutor(delay=3600))
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.05)
+        kubelet.start()
+        controller.start()
+        try:
+            j = S.TpuJob()
+            j.metadata.name = "steady"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=4)
+            ]
+            jc.create(j)
+            _wait(lambda: jc.get("default", "steady").status.phase
+                  == S.TpuJobPhase.RUNNING, msg="job RUNNING")
+            # give the transition ticks time to drain, then measure
+            time.sleep(0.3)
+            before = dict(cluster.counts)
+            time.sleep(1.0)  # ~20 reconcile ticks at 0.05s
+            after = dict(cluster.counts)
+            delta = {k: after.get(k, 0) - before.get(k, 0)
+                     for k in set(before) | set(after)}
+            delta = {k: v for k, v in delta.items() if v}
+            assert delta == {}, (
+                f"steady-state reconcile hit the apiserver: {delta}"
+            )
+        finally:
+            controller.stop()
+            kubelet.stop()
+
+    def test_gang_restart_still_works_through_cache(self):
+        """Fault path on the informer-backed read: SIGKILL one worker
+        (retryable 137), the whole gang restarts once and the job then
+        keeps running with the restart budget charged exactly once."""
+        cluster = CountingCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        kills = {"n": 0}
+
+        def fn(pod):
+            # first pod of index 1 dies 137; everyone else runs long
+            if pod.metadata.labels.get("task_index") == "1" and kills["n"] == 0:
+                kills["n"] += 1
+                return 137
+            time.sleep(3600)
+            return 0
+
+        kubelet = LocalKubelet(client, SimulatedExecutor(fn=fn))
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.05)
+        kubelet.start()
+        controller.start()
+        try:
+            j = S.TpuJob()
+            j.metadata.name = "gangcache"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+            ]
+            j.spec.max_gang_restarts = 3
+            jc.create(j)
+            _wait(lambda: jc.get("default", "gangcache").status.gang_restarts == 1,
+                  msg="one gang restart")
+            # job must come back RUNNING, and the budget must stay at 1
+            _wait(lambda: jc.get("default", "gangcache").status.phase
+                  == S.TpuJobPhase.RUNNING, msg="job back to RUNNING")
+            time.sleep(0.5)
+            cur = jc.get("default", "gangcache")
+            assert cur.status.gang_restarts == 1, (
+                "stale cache double-charged the restart budget: "
+                f"{cur.status.gang_restarts}"
+            )
+            assert cur.status.phase == S.TpuJobPhase.RUNNING
+        finally:
+            controller.stop()
+            kubelet.stop()
