@@ -179,9 +179,43 @@ def is_retryable_error(e):
     return any(m in text for m in _RETRYABLE_MARKERS)
 
 
+def install_preemption_handler():
+    """TPU maintenance/preemption events arrive as SIGTERM with a grace
+    period (GKE node drain; the kubelet sim mirrors it: SIGTERM, 10s,
+    SIGKILL). The handler only RECORDS the request — flushing a final
+    checkpoint mid-signal-handler would deadlock on collectives.
+    Programs that can use the grace period declare it by setting
+    ``KTPU_PREEMPT_AWARE=1`` (e.g. llama_train with a checkpoint_dir);
+    they poll ``KTPU_PREEMPT_REQUESTED`` at step boundaries, flush, and
+    exit EX_RETRYABLE so the gang restart resumes from the flushed step
+    instead of the last periodic save. A program that has NOT opted in
+    exits EX_RETRYABLE immediately — swallowing SIGTERM there would
+    just burn the kubelet's grace period doing nothing until SIGKILL.
+
+    Caveat: under ``jax.distributed`` the runtime replaces this handler
+    with its own preemption notifier (preemption_notifier.cc), which
+    also swallows SIGTERM; distributed programs get the event through
+    the coordination service (orbax ``reached_preemption``) instead,
+    and non-polling distributed programs rely on the SIGKILL
+    follow-through — a JAX behavior, not ours."""
+    import signal
+
+    def handler(signum, frame):
+        os.environ["KTPU_PREEMPT_REQUESTED"] = "1"
+        print(json.dumps({"event": "preempt_requested"}), flush=True)
+        if os.environ.get("KTPU_PREEMPT_AWARE") != "1":
+            os._exit(EX_RETRYABLE)  # signal-safe; prior default behavior
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass  # not the main thread (in-process test harness)
+
+
 def main(argv=None):
     rdzv = Rendezvous()
     t0 = time.time()
+    install_preemption_handler()
     try:
         configure_platform()
     except Exception as e:

@@ -24,6 +24,14 @@ class RunConfig:
     extra: Optional[Dict[str, str]] = None
 
 
+def preempt_requested() -> bool:
+    """True once the launcher's SIGTERM handler has fired (TPU
+    maintenance events arrive as SIGTERM; see
+    ``spmd_launcher.install_preemption_handler``). Poll at step
+    boundaries only — never inside a collective."""
+    return os.environ.get("KTPU_PREEMPT_REQUESTED") == "1"
+
+
 def parse_run_config(rdzv, defaults: Optional[dict] = None) -> RunConfig:
     """Program args come from ``KTPU_PROGRAM_ARGS`` (shell-ish
     ``--key=value`` tokens) with env fallbacks."""

@@ -8,6 +8,9 @@ and fsdp/tensor/seq inside the slice (ICI), per the megascale recipe.
 
 from __future__ import annotations
 
+import json
+import os
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -16,7 +19,11 @@ from k8s_tpu.data import synthetic_token_batches
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
 from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
-from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.programs.common import (
+    MetricLogger,
+    parse_run_config,
+    preempt_requested,
+)
 from k8s_tpu.train import (
     create_sharded_state,
     cross_entropy_loss,
@@ -65,6 +72,11 @@ def main(rdzv) -> None:
     num_slices = max(1, rdzv.num_slices)
 
     mesh = _mesh_for(strategy, n, num_slices)
+    if rdzv.process_id <= 0:
+        # machine-readable proof the MEGASCALE env shaped the mesh
+        # (multi-slice e2e asserts data axis == num_slices)
+        print(json.dumps({"event": "mesh", "num_slices": num_slices,
+                          "shape": dict(mesh.shape)}), flush=True)
     rules = LogicalRules(getattr(LogicalRules, STRATEGIES[strategy]))
     attention = "ring" if mesh.shape["seq"] > 1 else "flash"
     if model_name == "llama3-8b":
@@ -90,10 +102,8 @@ def main(rdzv) -> None:
             state = restored
             # machine-readable resume marker: the gang-restart e2e
             # asserts training continued PAST the checkpoint
-            import json as _json
-
-            print(_json.dumps({"event": "restored",
-                               "step": int(state.step)}), flush=True)
+            print(json.dumps({"event": "restored",
+                              "step": int(state.step)}), flush=True)
 
     # default on: fuses the lm_head matmul into the loss so the
     # [B, S, V] logits never materialize — required headroom at 128k
@@ -132,6 +142,20 @@ def main(rdzv) -> None:
     # pacing knob for chaos/e2e tests: widens the mid-training window a
     # fault can land in (tiny-model CPU steps are sub-millisecond)
     step_sleep = float(extra.get("step_sleep", "0"))
+    # Preemption contract (TPU maintenance arrives as SIGTERM): when
+    # checkpointing is on, every step ends with a preemption poll; on a
+    # gang-wide positive the gang flushes a final checkpoint at the
+    # CURRENT step and exits 143 (retryable), so the gang restart
+    # resumes from here rather than the last periodic save. Distributed
+    # runs poll JAX's coordination-service notifier via orbax
+    # (mgr.reached_preemption — same verdict on every process at the
+    # same step); single-process runs poll the launcher's SIGTERM flag.
+    # Benches/jobs without a checkpoint_dir never pay the poll.
+    preempt_poll = mgr is not None
+    if preempt_poll:
+        # tell the launcher's SIGTERM handler we will USE the grace
+        # period (flush + exit 143); without this it exits immediately
+        os.environ["KTPU_PREEMPT_AWARE"] = "1"
     start = int(state.step)
     for step in range(start + 1, cfg.steps + 1):
         if step_sleep:
@@ -141,6 +165,16 @@ def main(rdzv) -> None:
         state, metrics = step_fn(state, next(data), rng)
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(metrics["loss"])})
+        if preempt_poll and (
+            mgr.reached_preemption(step) if rdzv.num_processes > 1
+            else preempt_requested()
+        ):
+            mgr.save(step, state, force=True)
+            mgr.wait()
+            mgr.close()
+            print(json.dumps({"event": "preempt_checkpoint",
+                              "step": step}), flush=True)
+            raise SystemExit(143)  # retryable: gang restart resumes here
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
     if mgr is not None:

@@ -25,6 +25,7 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self._preemption_poll_broken = False
         self.directory = directory
         self.manager = ocp.CheckpointManager(
             directory,
@@ -94,6 +95,30 @@ class CheckpointManager:
             ),
         )
         return out["params"]
+
+    def reached_preemption(self, step: int) -> bool:
+        """Gang-wide preemption consensus for distributed runs: JAX's
+        distributed runtime installs a SIGTERM notifier
+        (preemption_notifier.cc) during ``jax.distributed.initialize``
+        and broadcasts the event through the coordination service;
+        orbax surfaces it per-step here on EVERY process at the same
+        step boundary — so the whole gang flushes together instead of
+        one process entering a checkpoint collective while its peers
+        enter the next train step (deadlock). Single-process runs use
+        the launcher's own SIGTERM flag instead
+        (``programs.common.preempt_requested``: the JAX notifier only
+        exists under jax.distributed)."""
+        try:
+            return bool(self.manager.reached_preemption(step))
+        except Exception as e:
+            if not self._preemption_poll_broken:
+                # log ONCE: a silently-dead poll would mean no flush on
+                # real maintenance events with zero diagnostics
+                self._preemption_poll_broken = True
+                log.warning("preemption poll unavailable (%s: %s); "
+                            "falling back to periodic checkpoints only",
+                            type(e).__name__, e)
+            return False
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()

@@ -118,15 +118,20 @@ class TrainingJob:
     def setup(self, config: ControllerConfig) -> None:
         """Reference setup() (training.go:245-301)."""
         if self.status.phase != TpuJobPhase.NONE:
-            log.warning("job %s has already been set up", self.name)
+            # Adopted mid-flight (operator restart / HA failover,
+            # reference findAllTfJobs controller.go:172-201): the CRD
+            # already carries phase + runtime_id, but THIS process has
+            # no replica-set objects yet — materialize them from the
+            # persisted spec so status/gang reconciliation can resume.
+            # Phase/state/runtime_id are left untouched.
+            if not self.replicas and self.job.spec.replica_specs:
+                try:
+                    self._materialize_replica_sets(validate=False)
+                except Exception as e:
+                    log.error("job %s: adopt materialize: %s", self.fullname, e)
             return
         try:
-            self.job.spec.set_defaults()
-            self.job.spec.validate()
-            self.replicas = [
-                TpuReplicaSet(self.client, rs, self) for rs in self.job.spec.replica_specs
-            ]
-            self.tensorboard = init_tensorboard(self.client, self)
+            self._materialize_replica_sets()
             self.job.spec.configure_accelerators(config.accelerators)
             if not self.job.spec.runtime_id:
                 self.job.spec.runtime_id = utils.rand_string(4)
@@ -138,6 +143,24 @@ class TrainingJob:
             return
         self.status.phase = TpuJobPhase.CREATING
         self.status.state = TpuJobState.RUNNING
+
+    def _materialize_replica_sets(self, validate: bool = True) -> None:
+        """Defaults → (validate) → build replica-set + TB objects.
+        Shared by first-time setup, mid-flight adoption, and the
+        CLEANUP rebuild; idempotent (runtime_id persists in the spec).
+        Adoption and teardown pass ``validate=False``: a spec that
+        passed validation when the job was CREATED must still be
+        reconcilable/deletable even if validation has tightened across
+        an operator upgrade — re-validating there would brick a running
+        job or leak its resources."""
+        self.job.spec.set_defaults()
+        if validate:
+            self.job.spec.validate()
+        self.replicas = [
+            TpuReplicaSet(self.client, rs, self)
+            for rs in self.job.spec.replica_specs
+        ]
+        self.tensorboard = init_tensorboard(self.client, self)
 
     # ------------------------------------------------------------ resources
 
@@ -154,12 +177,7 @@ class TrainingJob:
         # the job's Jobs/Services leak.
         if not self.replicas and self.job.spec.replica_specs:
             try:
-                self.job.spec.set_defaults()
-                self.replicas = [
-                    TpuReplicaSet(self.client, rs, self)
-                    for rs in self.job.spec.replica_specs
-                ]
-                self.tensorboard = init_tensorboard(self.client, self)
+                self._materialize_replica_sets(validate=False)
             except Exception as e:
                 log.error("job %s: rebuild replica sets for delete: %s",
                           self.fullname, e)
@@ -291,6 +309,11 @@ class TrainingJob:
             # so a crash during create_resources() can't orphan resources
             # under a runtime_id the CRD never saw.
             self.update_crd_status()
+        elif not self.replicas and self.job.spec.replica_specs:
+            # adopted mid-flight (HA failover / operator restart):
+            # setup()'s adoption branch materializes replica sets from
+            # the persisted spec without touching phase or runtime_id
+            self.setup(config)
 
         # A job adopted in CLEANUP (operator restarted mid-delete) only
         # needs its resources torn down.

@@ -538,6 +538,149 @@ class TestRealClusterBehaviors:
             api.stop()
 
 
+class _OperatorInstance:
+    """One operator process, as `operator.main` wires it (elector ->
+    on_started_leading -> Controller), against its own REST client —
+    the in-process analogue of one HA replica of
+    ``cmd/tf_operator/main.go:125-169``."""
+
+    def __init__(self, url: str, identity: str,
+                 lease=1.2, renew=0.25, retry=0.1):
+        self.identity = identity
+        self.cluster = RestCluster(url)
+        self.client = KubeClient(self.cluster)
+        self.job_client = TpuJobClient(self.cluster)
+        self.elector = LeaderElector(
+            self.cluster, "default", "tpu-operator", identity=identity,
+            lease_duration=lease, renew_deadline=renew, retry_period=retry,
+        )
+        self.stop_ev = threading.Event()
+        self.controller = None
+        self.leading = threading.Event()
+        self.stood_down = threading.Event()
+        self._thread = None
+
+    def _on_started_leading(self, lost: threading.Event):
+        self.controller = Controller(
+            self.client, self.job_client, S.ControllerConfig(),
+            reconcile_interval=0.1)
+        self.controller.start()
+        self.leading.set()
+        while not self.stop_ev.is_set() and not lost.is_set():
+            self.stop_ev.wait(0.05)
+        self.controller.stop()
+        self.stood_down.set()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.elector.run,
+            args=(self._on_started_leading, lambda: None),
+            kwargs={"stop": self.stop_ev},
+            daemon=True, name=f"operator-{self.identity}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.controller is not None:
+            self.controller.stop()
+
+
+class TestOperatorFailover:
+    def test_standby_takes_over_mid_job(self):
+        """The HA story of reference main.go:125-169 + controller.go:
+        172-201, end to end over the wire-format apiserver: operator A
+        leads and starts a job; A is partitioned from the apiserver
+        mid-job (its CAS renewals fail); A must STAND DOWN (deposed
+        leaders must stop reconciling), B must steal the lock after
+        lease expiry, adopt the live job via find_all_jobs, and drive
+        it to Succeeded — without duplicating any per-index resource."""
+        from k8s_tpu.api.election import LEADER_ANNOTATION
+
+        api = LocalApiServer().start()
+        kubelet = LocalKubelet(KubeClient(api.cluster), None)
+        finish = threading.Event()
+        kubelet.executor = SimulatedExecutor(
+            fn=lambda pod: 0 if finish.wait(30) else 1)
+        kubelet.start()
+        op_1 = _OperatorInstance(api.url, "operator-a").start()
+        op_2 = _OperatorInstance(api.url, "operator-b").start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not (
+                    op_1.leading.is_set() or op_2.leading.is_set()):
+                time.sleep(0.05)
+            # whichever won the initial CAS race is "A"; the other is
+            # the standby "B"
+            op_a, op_b = (op_1, op_2) if op_1.leading.is_set() else (op_2, op_1)
+            assert op_a.leading.is_set(), "no instance became leader"
+            assert not op_b.leading.is_set(), "split brain at startup"
+
+            user = TpuJobClient(RestCluster(api.url))
+            j = S.TpuJob()
+            j.metadata.name = "ha-job"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+            ]
+            user.create(j)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if user.get("default", "ha-job").status.phase == \
+                        S.TpuJobPhase.RUNNING:
+                    break
+                time.sleep(0.05)
+            assert user.get("default", "ha-job").status.phase == \
+                S.TpuJobPhase.RUNNING
+
+            # ---- partition A: every CAS renewal now fails ----
+            op_a.elector.try_acquire_or_renew = lambda: False
+            assert op_a.stood_down.wait(10), \
+                "deposed leader kept its controller running"
+            assert op_b.leading.wait(15), "standby never acquired the lease"
+            lock = api.cluster.get("Endpoints", "default", "tpu-operator")
+            holder = lock["metadata"]["annotations"][LEADER_ANNOTATION]
+            assert f'"{op_b.identity}"' in holder
+
+            # B adopted the live job: its controller tracks it
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if op_b.controller is not None and \
+                        "default/ha-job" in op_b.controller.jobs:
+                    break
+                time.sleep(0.05)
+            assert op_b.controller is not None
+            assert "default/ha-job" in op_b.controller.jobs, \
+                f"standby adopted nothing: {list(op_b.controller.jobs)}"
+
+            # let the workers finish under B; B drives the job terminal
+            finish.set()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                cur = user.get("default", "ha-job")
+                if cur.status.phase in (S.TpuJobPhase.DONE, S.TpuJobPhase.FAILED):
+                    break
+                time.sleep(0.1)
+            assert cur.status.state == S.TpuJobState.SUCCEEDED, \
+                cur.status.to_dict()
+
+            # no duplicate resources: exactly one Service and one batch
+            # Job per replica index survived the adoption
+            jobs = api.cluster.list("Job", "default")
+            svcs = api.cluster.list("Service", "default")
+            job_names = sorted(o["metadata"]["name"] for o in jobs)
+            svc_names = sorted(o["metadata"]["name"] for o in svcs)
+            assert len(job_names) == len(set(job_names)) == 2, job_names
+            assert len(svc_names) == len(set(svc_names)) == 2, svc_names
+        finally:
+            op_1.stop()
+            op_2.stop()
+            kubelet.stop()
+            api.stop()
+
+
 class TestBootstrap:
     def test_env_url_bootstrap(self, monkeypatch):
         api = LocalApiServer().start()

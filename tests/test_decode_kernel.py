@@ -147,17 +147,107 @@ class TestInt8KvCache:
         assert np.array_equal(np.asarray(v2)[:, :, m], np.asarray(vc)[:, :, m])
         assert np.array_equal(np.asarray(ks2)[:, :, m], np.asarray(ksc)[:, :, m])
 
-    def test_generate_with_int8_kv_close_to_bf16(self):
-        # XLA fallback path (CPU): int8 KV changes numerics slightly;
-        # greedy tokens should mostly agree with the bf16-cache run on
-        # a random tiny model
-        cfg = LlamaConfig.tiny(decode=True, max_seq_len=64)
+    @staticmethod
+    def _trained_tiny():
+        """Trained-weight fixture (fixed seeds): ~80 AdamW steps on a
+        learnable deterministic next-token rule. Random-init weights
+        under-represent quantization error structure (near-isotropic
+        activations quantize unrealistically well/badly); a production
+        numerics gate must run on weights with learned structure."""
+        import optax
+
+        cfg = LlamaConfig.tiny(decode=False)
         model = LlamaForCausalLM(cfg)
-        prompt = jax.random.randint(
-            jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
-        params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
+        V = cfg.vocab_size
+        B, T = 8, 32
+
+        def batch(key):
+            start = jax.random.randint(key, (B, 1), 0, V)
+            steps = jnp.arange(T)
+            return (start * (steps + 1) * 3 + 7 * steps) % V  # learnable
+
+        example = batch(jax.random.PRNGKey(1))
+        params = nn.unbox(model.init(jax.random.PRNGKey(0), example)["params"])
+        opt = optax.adamw(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, ids):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, ids)
+                logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+                ll = jnp.take_along_axis(
+                    logp, ids[:, 1:, None], axis=-1)[..., 0]
+                return -ll.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for i in range(80):
+            params, opt_state, loss = step(
+                params, opt_state, batch(jax.random.PRNGKey(100 + i)))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, (
+            f"fixture failed to train: {losses[0]:.3f} -> {losses[-1]:.3f}")
+        return cfg, params
+
+    @staticmethod
+    def _stepwise_decode_logits(model, params, seq):
+        """Teacher-forced logits through the DECODE path (per-token
+        cache append) — the numerics actually shipped by generate()."""
+        B, T = seq.shape
+
+        @jax.jit
+        def one(cache, tok, pos):
+            variables = {"params": params}
+            if cache is not None:
+                variables["cache"] = cache
+            logits, mut = model.apply(
+                variables, tok,
+                positions=jnp.full((B, 1), pos, jnp.int32),
+                mutable=["cache"],
+            )
+            return mut["cache"], logits[:, -1]
+
+        cache, outs = None, []
+        for t in range(T):
+            cache, l = one(cache, seq[:, t:t + 1], t)
+            outs.append(l)
+        return jnp.stack(outs, axis=1).astype(jnp.float32)  # [B, T, V]
+
+    def test_int8_kv_numerics_on_trained_weights(self):
+        """Production numerics gate for the int8 KV cache (VERDICT r2
+        weak #5 replaced the old `> 0.7` random-weight check): on the
+        trained fixture, fixed seeds, the decode-path logits error vs
+        the bf16 cache stays within a few percent and greedy top-1
+        agrees >= 0.9 — both stepwise (teacher-forced) and end-to-end
+        through generate()."""
+        cfg, params = self._trained_tiny()
+        dec = dataclasses.replace(cfg, decode=True, max_seq_len=64)
+        model = LlamaForCausalLM(dec)
+        m8 = LlamaForCausalLM(dataclasses.replace(dec, kv_quant="int8"))
+
+        seq = jax.random.randint(jax.random.PRNGKey(7), (4, 40), 0,
+                                 cfg.vocab_size)
+        lref = self._stepwise_decode_logits(model, params, seq)
+        l8 = self._stepwise_decode_logits(m8, params, seq)
+
+        # relative logits error, per step, averaged (weight-only int8
+        # ships at ~3%; the KV cache path must be in the same class)
+        num = jnp.linalg.norm((l8 - lref).reshape(-1, lref.shape[-1]), axis=-1)
+        den = jnp.linalg.norm(lref.reshape(-1, lref.shape[-1]), axis=-1)
+        rel = float((num / jnp.maximum(den, 1e-6)).mean())
+        assert rel < 0.05, f"int8-KV relative logits error {rel:.3%}"
+
+        # stepwise top-1 agreement
+        top1 = float((lref.argmax(-1) == l8.argmax(-1)).mean())
+        assert top1 >= 0.9, f"stepwise top-1 agreement {top1:.2f}"
+
+        # end-to-end greedy generate agreement on the same fixture
+        prompt = seq[:, :12]
         ref = generate(model, params, prompt, 24)
-        m8 = LlamaForCausalLM(dataclasses.replace(cfg, kv_quant="int8"))
         t8 = generate(m8, params, prompt, 24)
         agree = float((ref == t8).mean())
-        assert agree > 0.7, f"greedy agreement {agree:.2f}"
+        assert agree >= 0.9, f"greedy agreement {agree:.2f}"
