@@ -39,6 +39,21 @@ class BertConfig:
     # Set explicitly when fine-tuning a checkpoint across head configs
     # so the activation never changes out from under trained weights.
     exact_gelu: "bool | None" = None
+    # W8A8 dynamic int8 on the encoder matmuls (qkv/o/fc_in/fc_out),
+    # bf16 straight-through backward — same machinery and caveats as
+    # LlamaConfig.quant (k8s_tpu/ops/quant.py): numerics change, OPT-IN
+    # per config, never a default.
+    quant: str = "none"
+    # LayerNorms in bf16 instead of f32 (statistics still accumulate in
+    # f32 inside the bf16 kernel's mean/var reduction). BERT is post-LN
+    # — 25 norms touch the full residual stream every step, and in f32
+    # they are pure HBM bandwidth. Opt-in: loss curves should be
+    # validated per pretraining config.
+    bf16_norms: bool = False
+    # single [E, 3, H, D] qkv projection instead of three [E, H, D]
+    # matmuls (one wider MXU dispatch). Changes the checkpoint layout —
+    # opt-in, like Llama's fuse_params_for_decode.
+    fused_qkv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -60,7 +75,12 @@ class BertConfig:
         return BertConfig(**base)
 
 
-def _dense(features, axes, name, dtype, axis=-1):
+def _dense(features, axes, name, dtype, axis=-1, quant="none"):
+    extra = {}
+    if quant != "none":
+        from k8s_tpu.models.llama import _quant_extra
+
+        extra = _quant_extra(quant)
     return nn.DenseGeneral(
         features=features,
         axis=axis,
@@ -70,6 +90,7 @@ def _dense(features, axes, name, dtype, axis=-1):
             nn.initializers.normal(stddev=0.02), axes
         ),
         name=name,
+        **extra,
     )
 
 
@@ -80,30 +101,35 @@ class BertLayer(nn.Module):
     def __call__(self, x, attention_mask=None):
         cfg = self.config
         h, d = cfg.num_heads, cfg.head_dim
-        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_attn")
-        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_mlp")
-        q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj", cfg.dtype)(x)
-        k = _dense((h, d), ("embed", "heads", "head_dim"), "k_proj", cfg.dtype)(x)
-        v = _dense((h, d), ("embed", "heads", "head_dim"), "v_proj", cfg.dtype)(x)
+        ln_dtype = cfg.dtype if cfg.bf16_norms else jnp.float32
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=ln_dtype, name="ln_attn")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=ln_dtype, name="ln_mlp")
+        if cfg.fused_qkv:
+            qkv = _dense((3, h, d), ("embed", None, "heads", "head_dim"),
+                         "qkv_proj", cfg.dtype, quant=cfg.quant)(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            q = _dense((h, d), ("embed", "heads", "head_dim"), "q_proj",
+                       cfg.dtype, quant=cfg.quant)(x)
+            k = _dense((h, d), ("embed", "heads", "head_dim"), "k_proj",
+                       cfg.dtype, quant=cfg.quant)(x)
+            v = _dense((h, d), ("embed", "heads", "head_dim"), "v_proj",
+                       cfg.dtype, quant=cfg.quant)(x)
         q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
         # padding mask rides the kernel's segment-id masking (1=real,
         # 0=pad): pad keys are invisible; pad-query outputs are garbage
         # and the MLM loss mask is expected to drop them
         attn = flash_attention(q, k, v, causal=False, segment_ids=attention_mask)
-        attn = nn.DenseGeneral(
-            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ("heads", "head_dim", "embed")
-            ),
-            name="o_proj",
-        )(attn)
+        attn = _dense(cfg.hidden_size, ("heads", "head_dim", "embed"),
+                      "o_proj", cfg.dtype, axis=(-2, -1), quant=cfg.quant)(attn)
         x = ln1(x + attn)
-        y = _dense(cfg.intermediate_size, ("embed", "mlp"), "fc_in", cfg.dtype)(x)
+        y = _dense(cfg.intermediate_size, ("embed", "mlp"), "fc_in", cfg.dtype,
+                   quant=cfg.quant)(x)
         # exact erf gelu matches HF BERT weights (cfg.use_exact_gelu)
         y = nn.gelu(y, approximate=not cfg.use_exact_gelu)
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
-        y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc_out", cfg.dtype)(y)
+        y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc_out", cfg.dtype,
+                   quant=cfg.quant)(y)
         return ln2(x + y)
 
 
@@ -137,7 +163,11 @@ class BertForPretraining(nn.Module):
                 cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                 param_dtype=jnp.float32, name="type_embed",
             )(token_type_ids)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="ln_embed")(x)
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps,
+            dtype=cfg.dtype if cfg.bf16_norms else jnp.float32,
+            name="ln_embed",
+        )(x)
         for i in range(cfg.num_layers):
             x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
 

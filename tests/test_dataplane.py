@@ -1083,6 +1083,73 @@ class TestFusedCE:
         )(params["mlm_head"]["bias"])
         assert float(jnp.max(jnp.abs(gbias))) > 0
 
+    def test_bert_masked_position_head_equals_full_head_loss(self):
+        # The production MLM loss (gather ~15% masked positions, run the
+        # head only there — TF BERT's gather_indexes) must compute the
+        # IDENTICAL masked CE as the full-head + post-hoc-mask path.
+        from k8s_tpu.models import BertConfig, BertForPretraining
+        import flax.linen as fnn
+
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        B, S, P = 2, 32, 8
+        k1, k3 = jax.random.split(jax.random.PRNGKey(1))
+        ids = jax.random.randint(k1, (B, S), 0, cfg.vocab_size)
+        pos = jnp.tile(jnp.sort(jax.random.permutation(k3, S)[:P])[None], (B, 1))
+        mask = jnp.zeros((B, S), jnp.int32)
+        mask = mask.at[jnp.arange(B)[:, None], pos].set(1)
+        params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+        hidden, _ = model.apply({"params": params}, ids, return_hidden=True)
+        hidden = hidden.astype(jnp.float32)
+        full = fused_lm_head_cross_entropy(
+            hidden, params["mlm_head"]["kernel"], ids, mask=mask,
+            target_chunk=128, bias=params["mlm_head"]["bias"])
+        gathered = jnp.take_along_axis(hidden, pos[:, :, None], axis=1)
+        labels = jnp.take_along_axis(ids, pos, axis=1)
+        got = fused_lm_head_cross_entropy(
+            gathered, params["mlm_head"]["kernel"], labels,
+            mask=jnp.ones((B, P), jnp.int32), target_chunk=128,
+            bias=params["mlm_head"]["bias"])
+        np.testing.assert_allclose(got, full, rtol=1e-5)
+
+    def test_bert_bf16_norms_and_fused_qkv_variants(self):
+        # bf16 norms: same params, output close to the f32-norm model.
+        # fused_qkv: stacking the separate q/k/v kernels reproduces the
+        # separate-projection output exactly.
+        import dataclasses as dc
+
+        from k8s_tpu.models import BertConfig, BertForPretraining
+        import flax.linen as fnn
+
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                 cfg.vocab_size)
+        params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+        ref, _ = model.apply({"params": params}, ids, return_hidden=True)
+
+        m_bf16 = BertForPretraining(dc.replace(cfg, bf16_norms=True))
+        out, _ = m_bf16.apply({"params": params}, ids, return_hidden=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=0.1, atol=0.2)
+
+        m_fused = BertForPretraining(dc.replace(cfg, fused_qkv=True))
+        p2 = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy tree
+        for li in range(cfg.num_layers):
+            layer = dict(p2[f"layer_{li}"])
+            q, k, v = (layer.pop(n) for n in ("q_proj", "k_proj", "v_proj"))
+            layer["qkv_proj"] = {
+                "kernel": jnp.stack(
+                    [q["kernel"], k["kernel"], v["kernel"]], axis=1),
+                "bias": jnp.stack([q["bias"], k["bias"], v["bias"]], axis=0),
+            }
+            p2[f"layer_{li}"] = layer
+        out2, _ = m_fused.apply({"params": p2}, ids, return_hidden=True)
+        np.testing.assert_allclose(
+            np.asarray(out2, np.float32), np.asarray(ref, np.float32),
+            rtol=1e-5, atol=1e-5)
+
     def test_model_return_hidden_path(self):
         # end-to-end: model(return_hidden) + fused CE == logits + CE
         cfg = LlamaConfig.tiny()
