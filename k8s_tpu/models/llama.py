@@ -737,6 +737,16 @@ def _decode_loop(model, params, cache, tok, r, plen, new_tokens, temperature):
     )
 
 
+def _auto_prefill_chunk(plen: int, head_dim: int) -> int:
+    """One-shot flash prefill (0) only when the pallas kernel will
+    actually engage: alignment AND a TPU backend — flash_attention's
+    non-TPU / off-shape fallback is the quadratic XLA path whose
+    [B, Hq, plen, plen] f32 scores this gate exists to avoid."""
+    flash_ok = (plen % 128 == 0 and head_dim % 64 == 0
+                and jax.default_backend() == "tpu")
+    return 0 if flash_ok else 512
+
+
 def generate(
     model: LlamaForCausalLM,
     params,
@@ -781,8 +791,7 @@ def generate(
     rng, prefill_rng = jax.random.split(rng)
 
     if prefill_chunk is None:
-        flash_ok = plen % 128 == 0 and cfg.head_dim % 64 == 0
-        prefill_chunk = 0 if flash_ok else 512
+        prefill_chunk = _auto_prefill_chunk(plen, cfg.head_dim)
     cache, tok = _prefill(model, params, prompt_ids, prefill_rng,
                            temperature, chunk=prefill_chunk)
 

@@ -99,8 +99,19 @@ def cmd_create(args) -> int:
     return 0
 
 
+_RESOURCE_WORDS = ("tpujobs", "tpujob", "tj")
+
+
 def cmd_get(args) -> int:
     jc = _remote_client(args.server)
+    # kubectl grammar: `get [tpujobs] [name]` — an optional resource
+    # word then an optional name, so `get tpujobs`, `get tpujob myjob`,
+    # and the bare `get myjob` all work (and a job literally named
+    # "tpujob" is still reachable as `get tpujobs tpujob`)
+    if args.resource in _RESOURCE_WORDS:
+        pass  # name already holds the (optional) job name
+    elif args.name is None:
+        args.name = args.resource
     if args.name:
         j = jc.get(args.namespace, args.name)
         jobs = [j]
@@ -135,6 +146,8 @@ def main(argv=None) -> int:
     v = sub.add_parser("validate", help="validate a TpuJob manifest")
     v.add_argument("-f", "--file", required=True)
     g = sub.add_parser("get", help="list/get TpuJobs on an apiserver")
+    g.add_argument("resource", nargs="?", default=None,
+                   help="kubectl-style resource word (tpujobs) or a job name")
     g.add_argument("name", nargs="?", default=None)
     g.add_argument("-n", "--namespace", default="default")
     g.add_argument("--server", default=default_server, required=not default_server)

@@ -124,11 +124,11 @@ class TestFlashPrefill:
         chunked = generate(model, params, prompt, 16, prefill_chunk=8)
         assert (one_shot == chunked).all()
 
-    def test_auto_chunk_selection(self):
-        """prefill_chunk=None must pick one-shot ONLY when the prompt
-        can ride the flash kernel's alignment gate — an un-aligned long
-        prompt must go chunked (flash's XLA fallback would materialize
-        [B, Hq, plen, plen] f32)."""
+    def test_auto_chunk_selection(self, monkeypatch):
+        """prefill_chunk=None must pick one-shot ONLY when the pallas
+        flash kernel will actually engage (alignment AND TPU backend) —
+        anything else goes chunked, because flash's XLA fallback would
+        materialize [B, Hq, plen, plen] f32."""
         from k8s_tpu.models import llama as L
 
         calls = []
@@ -144,13 +144,19 @@ class TestFlashPrefill:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (1, 128), 0, cfg.vocab_size)
         params = nn.unbox(model.init(jax.random.PRNGKey(0), prompt)["params"])
-        try:
-            L._prefill = spy
-            generate(model, params, prompt, 2)  # 128-aligned, d=64
-            generate(model, params, prompt[:, :100], 2)  # unaligned
-        finally:
-            L._prefill = orig
-        assert calls == [0, 512], calls
+        monkeypatch.setattr(L, "_prefill", spy)
+
+        # CPU backend (the test env): NEVER one-shot, even aligned
+        generate(model, params, prompt, 2)
+        assert calls == [512], calls
+
+        # decision table with the backend pinned (pure function — the
+        # generate() run above proves the wiring; monkeypatch restores
+        # the real backend at teardown, and nothing jit-compiles here)
+        monkeypatch.setattr(L.jax, "default_backend", lambda: "tpu")
+        assert L._auto_prefill_chunk(4096, 128) == 0  # aligned, tpu
+        assert L._auto_prefill_chunk(4000, 128) == 512  # unaligned
+        assert L._auto_prefill_chunk(4096, 16) == 512  # head_dim off
 
 
 class TestInt8KvCache:
