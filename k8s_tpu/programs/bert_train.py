@@ -1,7 +1,17 @@
 """BERT-base MLM pretraining with tensor parallelism — benchmark
-config #4 (v5p-64, pjit model-parallel)."""
+config #4 (v5p-64, pjit model-parallel).
+
+Production loss path (matching ``benches/bert_bench.py``): the data
+pipeline provides masked positions/labels/weights and the MLM head
+runs ONLY on the gathered ~15% masked tokens (TF BERT's
+gather_indexes) through the fused LM-head CE — ``full_head=1`` or
+``fused_ce=0`` select the legacy paths. Checkpoint/resume and the
+preemption contract mirror llama_train/resnet_train.
+"""
 
 from __future__ import annotations
+
+import json
 
 import jax
 import jax.numpy as jnp
@@ -11,13 +21,19 @@ from k8s_tpu.models import BertConfig, BertForPretraining
 from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.parallel.mesh import best_pow2_split
-from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.programs.common import (
+    MetricLogger,
+    mark_preempt_aware,
+    maybe_preempt_exit,
+    parse_run_config,
+)
 from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
 
 
 def main(rdzv) -> None:
     cfg = parse_run_config(rdzv, {"steps": 50, "batch_size": 32})
-    tiny = (cfg.extra or {}).get("tiny") == "1"
+    extra = cfg.extra or {}
+    tiny = extra.get("tiny") == "1"
     n = len(jax.devices())
     tensor, data = best_pow2_split(n, max_first=4 if tiny else 8)
     mesh = build_mesh(MeshConfig(data=data, tensor=tensor))
@@ -25,27 +41,61 @@ def main(rdzv) -> None:
     bcfg = BertConfig.tiny() if tiny else BertConfig.base()
     model = BertForPretraining(bcfg)
     seq = bcfg.max_seq_len if not tiny else 64
+    n_pred = max(8, int(seq * 0.15 + 7) // 8 * 8)
 
     import numpy as np
 
     rng_np = np.random.default_rng(0)
     ids = rng_np.integers(0, bcfg.vocab_size, (cfg.batch_size, seq)).astype("int32")
     mask = (rng_np.random((cfg.batch_size, seq)) < 0.15).astype("int32")
-    batch = {"input_ids": ids, "labels": ids, "mask": mask}
+    masked_pos = np.sort(
+        rng_np.permutation(seq)[:n_pred]
+    ).astype("int32")[None].repeat(cfg.batch_size, axis=0)
+    batch = {
+        "input_ids": ids, "labels": ids, "mask": mask,
+        "masked_pos": masked_pos,
+        "masked_labels": np.take_along_axis(ids, masked_pos, axis=1),
+        "masked_w": np.ones((cfg.batch_size, n_pred), "int32"),
+    }
 
     state = create_sharded_state(
         model, optax.adamw(1e-4), mesh, rules,
         jax.random.PRNGKey(0), jnp.asarray(ids),
     )
 
-    # default on: MLM head fused into the CE (no [B,S,V] logits);
-    # fused_ce=0 falls back to the materialized-logits loss. NOTE the
-    # fused head matmul runs in the activations' dtype (bf16), not the
-    # unfused DenseGeneral's f32 — pass compute_dtype=jnp.float32 to
-    # fused_lm_head_cross_entropy for bit-closer parity.
-    fused_ce = (cfg.extra or {}).get("fused_ce", "1") not in ("0", "false")
+    mgr = None
+    if cfg.checkpoint_dir:
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(cfg.checkpoint_dir)
+        restored = mgr.restore(state)
+        if restored is not None:
+            state = restored
+            print(json.dumps({"event": "restored",
+                              "step": int(state.step)}), flush=True)
+
+    # default on: MLM head fused into the CE (no [B,S,V] logits) and
+    # run only on the gathered masked positions; full_head=1 scores all
+    # positions, fused_ce=0 falls back to the materialized-logits loss.
+    # NOTE the fused head matmul runs in the activations' dtype (bf16),
+    # not the unfused DenseGeneral's f32 — pass
+    # compute_dtype=jnp.float32 to fused_lm_head_cross_entropy for
+    # bit-closer parity.
+    fused_ce = extra.get("fused_ce", "1") not in ("0", "false")
+    full_head = extra.get("full_head", "0") in ("1", "true")
 
     def loss_fn(state, params, b, rng):
+        if fused_ce and not full_head:
+            hidden, _ = state.apply_fn(
+                {"params": params}, b["input_ids"], return_hidden=True
+            )
+            gathered = jnp.take_along_axis(
+                hidden, b["masked_pos"][:, :, None], axis=1
+            )
+            return fused_lm_head_cross_entropy(
+                gathered, params["mlm_head"]["kernel"], b["masked_labels"],
+                mask=b["masked_w"], bias=params["mlm_head"]["bias"],
+            ), {}
         if fused_ce:
             hidden, _ = state.apply_fn(
                 {"params": params}, b["input_ids"], return_hidden=True
@@ -60,7 +110,18 @@ def main(rdzv) -> None:
     step_fn = make_train_step(loss_fn, mesh, rules)
     logger = MetricLogger(rdzv, "bert")
     rng = jax.random.PRNGKey(1)
-    for step in range(1, cfg.steps + 1):
+    if mgr is not None:
+        mark_preempt_aware()
+    start = int(state.step)
+    for step in range(start + 1, cfg.steps + 1):
         state, metrics = step_fn(state, batch, rng)
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(metrics["loss"])})
+        maybe_preempt_exit(mgr, rdzv, step, state)
+        if mgr is not None and cfg.checkpoint_every and \
+                step % cfg.checkpoint_every == 0:
+            mgr.save(step, state)
+    if mgr is not None:
+        mgr.save(cfg.steps, state, force=True)
+        mgr.wait()
+        mgr.close()

@@ -32,6 +32,38 @@ def preempt_requested() -> bool:
     return os.environ.get("KTPU_PREEMPT_REQUESTED") == "1"
 
 
+def mark_preempt_aware() -> None:
+    """Tell the launcher's SIGTERM handler this program will USE the
+    grace period (flush + exit 143) instead of exiting immediately.
+    Call once, before the train loop, iff checkpointing is on."""
+    os.environ["KTPU_PREEMPT_AWARE"] = "1"
+
+
+def maybe_preempt_exit(mgr, rdzv, step: int, state) -> None:
+    """The shared per-step preemption contract for every training
+    program: on a gang-wide preemption verdict — JAX's coordination-
+    service notifier via orbax ``reached_preemption`` when distributed
+    (same verdict on every process at the same step boundary; a lone
+    flusher would deadlock its peers' collectives), the launcher's
+    SIGTERM flag single-process — flush a final checkpoint at the
+    CURRENT step, then exit 143 (retryable) so the gang restart
+    resumes from here instead of the last periodic save. No-op when
+    ``mgr`` is None (benches and non-checkpointing jobs never pay the
+    poll)."""
+    if mgr is None:
+        return
+    preempted = (mgr.reached_preemption(step) if rdzv.num_processes > 1
+                 else preempt_requested())
+    if not preempted:
+        return
+    mgr.save(step, state, force=True)
+    mgr.wait()
+    mgr.close()
+    print(json.dumps({"event": "preempt_checkpoint", "step": step}),
+          flush=True)
+    raise SystemExit(143)
+
+
 def parse_run_config(rdzv, defaults: Optional[dict] = None) -> RunConfig:
     """Program args come from ``KTPU_PROGRAM_ARGS`` (shell-ish
     ``--key=value`` tokens) with env fallbacks."""

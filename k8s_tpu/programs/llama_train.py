@@ -21,8 +21,9 @@ from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import (
     MetricLogger,
+    mark_preempt_aware,
+    maybe_preempt_exit,
     parse_run_config,
-    preempt_requested,
 )
 from k8s_tpu.train import (
     create_sharded_state,
@@ -142,20 +143,12 @@ def main(rdzv) -> None:
     # pacing knob for chaos/e2e tests: widens the mid-training window a
     # fault can land in (tiny-model CPU steps are sub-millisecond)
     step_sleep = float(extra.get("step_sleep", "0"))
-    # Preemption contract (TPU maintenance arrives as SIGTERM): when
-    # checkpointing is on, every step ends with a preemption poll; on a
-    # gang-wide positive the gang flushes a final checkpoint at the
-    # CURRENT step and exits 143 (retryable), so the gang restart
-    # resumes from here rather than the last periodic save. Distributed
-    # runs poll JAX's coordination-service notifier via orbax
-    # (mgr.reached_preemption — same verdict on every process at the
-    # same step); single-process runs poll the launcher's SIGTERM flag.
-    # Benches/jobs without a checkpoint_dir never pay the poll.
-    preempt_poll = mgr is not None
-    if preempt_poll:
-        # tell the launcher's SIGTERM handler we will USE the grace
-        # period (flush + exit 143); without this it exits immediately
-        os.environ["KTPU_PREEMPT_AWARE"] = "1"
+    # Preemption contract (TPU maintenance arrives as SIGTERM): see
+    # common.maybe_preempt_exit — with checkpointing on, every step
+    # ends with a gang-consistent poll; on a positive the gang flushes
+    # at the CURRENT step and exits 143 so the restart resumes here.
+    if mgr is not None:
+        mark_preempt_aware()
     start = int(state.step)
     for step in range(start + 1, cfg.steps + 1):
         if step_sleep:
@@ -165,16 +158,7 @@ def main(rdzv) -> None:
         state, metrics = step_fn(state, next(data), rng)
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(metrics["loss"])})
-        if preempt_poll and (
-            mgr.reached_preemption(step) if rdzv.num_processes > 1
-            else preempt_requested()
-        ):
-            mgr.save(step, state, force=True)
-            mgr.wait()
-            mgr.close()
-            print(json.dumps({"event": "preempt_checkpoint",
-                              "step": step}), flush=True)
-            raise SystemExit(143)  # retryable: gang restart resumes here
+        maybe_preempt_exit(mgr, rdzv, step, state)
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
     if mgr is not None:

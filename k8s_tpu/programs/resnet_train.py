@@ -8,6 +8,8 @@ when switching stems."""
 
 from __future__ import annotations
 
+import json
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -15,7 +17,12 @@ import optax
 from k8s_tpu.data import synthetic_image_batches
 from k8s_tpu.models import ResNet50, ResNet
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
-from k8s_tpu.programs.common import MetricLogger, parse_run_config
+from k8s_tpu.programs.common import (
+    MetricLogger,
+    mark_preempt_aware,
+    maybe_preempt_exit,
+    parse_run_config,
+)
 from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
 
 
@@ -92,6 +99,8 @@ def main(rdzv) -> None:
         restored = mgr.restore(state)
         if restored is not None:
             state = restored
+            print(json.dumps({"event": "restored",
+                              "step": int(state.step)}), flush=True)
 
     def _prep_images(images):
         if images.dtype == jnp.uint8:
@@ -208,6 +217,10 @@ def main(rdzv) -> None:
 
     logger = MetricLogger(rdzv, "resnet50")
     rng = jax.random.PRNGKey(1)
+    # shared preemption contract (common.maybe_preempt_exit): flush at
+    # the current step and exit 143 on a gang-wide SIGTERM verdict
+    if mgr is not None:
+        mark_preempt_aware()
     start = int(state.step)
     for step in range(start + 1, cfg.steps + 1):
         state, metrics = step_fn(state, next(data), rng)
@@ -216,6 +229,7 @@ def main(rdzv) -> None:
         if eval_every and (step % eval_every == 0 or step == cfg.steps):
             eval_loss, eval_top1 = run_eval(state)
             logger.log(step, {"eval_loss": eval_loss, "eval_top1": eval_top1})
+        maybe_preempt_exit(mgr, rdzv, step, state)
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
     if mgr is not None:

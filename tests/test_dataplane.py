@@ -1150,6 +1150,46 @@ class TestFusedCE:
             np.asarray(out2, np.float32), np.asarray(ref, np.float32),
             rtol=1e-5, atol=1e-5)
 
+    def test_bert_bf16_norms_trains_like_f32(self):
+        # convergence sanity for the opt-in bf16 norms: same init, same
+        # data, loss trajectories stay close to the f32-norm model over
+        # a short run (this is a smoke gate, not a pretraining claim —
+        # the config stays opt-in)
+        import flax.linen as fnn
+
+        def run(cfg):
+            model = BertForPretraining(cfg)
+            ids = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                     cfg.vocab_size)
+            mask = (jax.random.uniform(jax.random.PRNGKey(2), (4, 32))
+                    < 0.15).astype(jnp.int32)
+            params = fnn.unbox(model.init(jax.random.PRNGKey(0), ids)["params"])
+            tx = optax.adamw(1e-3)
+            opt = tx.init(params)
+
+            @jax.jit
+            def step(params, opt):
+                def loss_fn(p):
+                    mlm, _ = model.apply({"params": p}, ids)
+                    return cross_entropy_loss(mlm, ids, mask=mask)
+
+                loss, g = jax.value_and_grad(loss_fn)(params)
+                u, opt = tx.update(g, opt, params)
+                return optax.apply_updates(params, u), opt, loss
+
+            losses = []
+            for _ in range(25):
+                params, opt, loss = step(params, opt)
+                losses.append(float(loss))
+            return losses
+
+        base = run(BertConfig.tiny())
+        bf16 = run(BertConfig.tiny(bf16_norms=True))
+        assert bf16[-1] < base[0], "bf16-norm model failed to train"
+        # final losses within a loose band of each other
+        assert abs(bf16[-1] - base[-1]) < 0.25 * abs(base[0] - base[-1]), (
+            base[-1], bf16[-1])
+
     def test_model_return_hidden_path(self):
         # end-to-end: model(return_hidden) + fused CE == logits + CE
         cfg = LlamaConfig.tiny()

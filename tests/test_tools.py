@@ -116,6 +116,31 @@ spec:
         assert rc == 1
         assert "INVALID" in capsys.readouterr().out
 
+    def test_get_kubectl_grammar(self, capsys):
+        """`get tpujobs`, `get tpujob <name>`, and bare `get <name>`
+        against a wire-format apiserver."""
+        from k8s_tpu.api.apiserver import LocalApiServer
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.api.restcluster import RestCluster
+        from k8s_tpu import spec as S
+
+        api = LocalApiServer().start()
+        try:
+            jc = TpuJobClient(RestCluster(api.url))
+            j = S.TpuJob()
+            j.metadata.name = "grammar"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=1)]
+            jc.create(j)
+            for argv in (["get", "tpujobs", "--server", api.url],
+                         ["get", "tpujob", "grammar", "--server", api.url],
+                         ["get", "grammar", "--server", api.url]):
+                assert kubectl_local.main(argv) == 0
+                assert "grammar" in capsys.readouterr().out
+        finally:
+            api.stop()
+
 
 class TestJobClientWait:
     def test_wait_times_out(self):
