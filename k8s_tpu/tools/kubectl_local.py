@@ -131,6 +131,68 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_describe(args) -> int:
+    """`kubectl describe`-style detail: spec summary, status, the
+    condition ring, replica-state histograms, and this job's Events —
+    the reference pointed users at `kubectl describe tfjobs`
+    (README:437-479) for exactly this view."""
+    from k8s_tpu.api import errors
+    from k8s_tpu.api.client import KubeClient
+    from k8s_tpu.api.crd_client import TpuJobClient
+    from k8s_tpu.api.restcluster import RestCluster
+
+    # kubectl grammar: optional resource word, then the name —
+    # `describe tpujobs tj` reaches a job literally named "tj"
+    if args.resource in _RESOURCE_WORDS:
+        name = args.name
+    else:
+        name = args.name if args.name is not None else args.resource
+    if not name:
+        print("usage: describe [tpujobs] <name>")
+        return 1
+    rest = RestCluster(args.server)
+    jc = TpuJobClient(rest)
+    try:
+        j = jc.get(args.namespace, name)
+    except errors.NotFoundError:
+        print(f"TpuJob {args.namespace}/{name} not found")
+        return 1
+    print(f"Name:       {j.metadata.name}")
+    print(f"Namespace:  {j.metadata.namespace}")
+    print(f"RuntimeId:  {j.spec.runtime_id or '<unassigned>'}")
+    if j.spec.tpu is not None and j.spec.tpu.accelerator:
+        print(f"TPU:        {j.spec.tpu.accelerator} × "
+              f"{j.spec.tpu.num_slices} slice(s)")
+    print("Replicas:")
+    for r in j.spec.replica_specs:
+        print(f"  {r.replica_type}: replicas={r.replicas} port={r.port}")
+    s = j.status
+    print(f"Phase:      {s.phase or 'None'}")
+    print(f"State:      {s.state or '-'}")
+    if s.reason:
+        print(f"Reason:     {s.reason}")
+    if s.gang_restarts:
+        print(f"GangRestarts: {s.gang_restarts}/{j.spec.max_gang_restarts}")
+    if s.replica_statuses:
+        print("ReplicaStatuses:")
+        for rs in s.replica_statuses:
+            hist = " ".join(f"{k}={v}" for k, v in
+                            sorted(rs.replicas_states.items()))
+            print(f"  {rs.replica_type}: {rs.state}  [{hist}]")
+    if s.conditions:
+        print("Conditions:")
+        for c in s.conditions:
+            print(f"  {c.type}: {c.reason}")
+    events = KubeClient(rest).events.list(args.namespace)
+    mine = [e for e in events
+            if (e.involved_object or {}).get("name") == name]
+    if mine:
+        print("Events:")
+        for e in mine[-15:]:
+            print(f"  {e.type:8} {e.reason:20} {e.message}")
+    return 0
+
+
 def main(argv=None) -> int:
     default_server = os.environ.get("KTPU_APISERVER_URL", "")
     p = argparse.ArgumentParser(prog="ktpu")
@@ -155,9 +217,18 @@ def main(argv=None) -> int:
     d.add_argument("name")
     d.add_argument("-n", "--namespace", default="default")
     d.add_argument("--server", default=default_server, required=not default_server)
+    ds = sub.add_parser("describe",
+                        help="detailed status + conditions + events")
+    ds.add_argument("resource", nargs="?", default=None,
+                    help="kubectl-style resource word (tpujobs) or a job name")
+    ds.add_argument("name", nargs="?", default=None)
+    ds.add_argument("-n", "--namespace", default="default")
+    ds.add_argument("--server", default=default_server,
+                    required=not default_server)
     args = p.parse_args(argv)
     return {"create": cmd_create, "validate": cmd_validate,
-            "get": cmd_get, "delete": cmd_delete}[args.cmd](args)
+            "get": cmd_get, "delete": cmd_delete,
+            "describe": cmd_describe}[args.cmd](args)
 
 
 if __name__ == "__main__":

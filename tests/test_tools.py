@@ -141,6 +141,41 @@ spec:
         finally:
             api.stop()
 
+    def test_describe(self, capsys):
+        """`describe` surfaces status, conditions, and the job's Events
+        — the reference's `kubectl describe tfjobs` view."""
+        from k8s_tpu.api.apiserver import LocalApiServer
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.api.restcluster import RestCluster
+        from k8s_tpu import spec as S
+
+        api = LocalApiServer().start()
+        try:
+            jc = TpuJobClient(RestCluster(api.url))
+            j = S.TpuJob()
+            j.metadata.name = "desc"
+            j.metadata.namespace = "default"
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+            j.status.phase = S.TpuJobPhase.RUNNING
+            j.status.state = S.TpuJobState.RUNNING
+            j.status.gang_restarts = 1
+            j.status.append_condition("GangRestart", reason="worker 1 died")
+            jc.create(j)
+            KubeClient(RestCluster(api.url)).record_event(
+                "default", {"kind": "TpuJob", "name": "desc"},
+                "GangRestart", "restarting all gang pods", etype="Warning")
+            assert kubectl_local.main(
+                ["describe", "desc", "--server", api.url]) == 0
+            out = capsys.readouterr().out
+            for needle in ("Phase:      Running", "GangRestarts: 1/",
+                           "GangRestart: worker 1 died",
+                           "restarting all gang pods"):
+                assert needle in out, out
+        finally:
+            api.stop()
+
 
 class TestJobClientWait:
     def test_wait_times_out(self):
