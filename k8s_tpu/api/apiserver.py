@@ -48,12 +48,14 @@ class _Request:
     """Parsed path + query of one API request."""
 
     def __init__(self, kind: str, namespace: Optional[str], name: Optional[str],
-                 query: Dict[str, str], is_crd_registry: bool = False):
+                 query: Dict[str, str], is_crd_registry: bool = False,
+                 subresource: Optional[str] = None):
         self.kind = kind
         self.namespace = namespace
         self.name = name
         self.query = query
         self.is_crd_registry = is_crd_registry
+        self.subresource = subresource
 
 
 def _parse_path(path: str) -> Optional[_Request]:
@@ -81,7 +83,9 @@ def _parse_path(path: str) -> Optional[_Request]:
     if kind is None:
         return None
     name = rest[1] if len(rest) > 1 else None
-    return _Request(kind, namespace, name, query)
+    # subresources: /api/v1/namespaces/{ns}/pods/{name}/log
+    sub = rest[2] if len(rest) > 2 else None
+    return _Request(kind, namespace, name, query, subresource=sub)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -194,6 +198,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if r.is_crd_registry:
                 return self._get_crd(r)
+            if r.kind == "Pod" and r.subresource == "log":
+                return self._serve_pod_log(r)
             if r.name is not None:
                 obj = self.cluster.get(r.kind, r.namespace or "default", r.name)
                 return self._send_json(200, wire.stamp_type_meta(r.kind, obj))
@@ -288,6 +294,46 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(404, "NotFound", str(e))
         except Exception as e:  # noqa: BLE001 - wire boundary
             self._send_api_error(e)
+
+    def _serve_pod_log(self, r: _Request) -> None:
+        """``GET .../pods/{name}/log`` — the kubectl-logs subresource.
+        Real clusters proxy this to the kubelet; here the kubelet's
+        ``--log-dir`` is local to the apiserver process (the
+        ``--with-kubelet`` dev-cluster shape), so the file is served
+        directly. ``?tailLines=N`` supported. Text/plain body like the
+        real thing, not JSON."""
+        import os as _os
+
+        log_dir = self.server.log_dir
+        if not log_dir:
+            return self._send_status(
+                404, "NotFound",
+                "pod logs not available: this apiserver has no --log-dir "
+                "(run with --with-kubelet, or read the kubelet's log dir "
+                "directly)")
+        # the pod must exist (or have existed: its log outlives it —
+        # serve the file regardless, like kubectl logs on a crashed pod)
+        path = _os.path.join(log_dir, f"{r.name}.log")
+        if not _os.path.exists(path):
+            return self._send_status(
+                404, "NotFound", f"no log for pod {r.namespace}/{r.name}")
+        with open(path, "rb") as f:
+            data = f.read()
+        tail = r.query.get("tailLines")
+        if tail is not None:
+            try:
+                n = int(tail)
+                lines = data.splitlines(keepends=True)
+                # real-apiserver semantics: 0 → nothing; negatives are
+                # meaningless and also yield nothing (never a head-drop)
+                data = b"".join(lines[-n:]) if n > 0 else b""
+            except ValueError:
+                pass
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     # ------------------------------------------------------------ CRDs
 
@@ -413,6 +459,9 @@ class _Server(ThreadingHTTPServer):
         # None = no auth; a set = every request must bear one of these
         # tokens (simulates bound-SA-token expiry for contract tests)
         self.valid_tokens = None
+        # kubelet log dir for the pods/{name}/log subresource (the
+        # --with-kubelet dev-cluster shape); None = logs unavailable
+        self.log_dir = None
 
 
 class LocalApiServer:
@@ -420,10 +469,12 @@ class LocalApiServer:
     (possibly shared) InMemoryCluster over the real wire format."""
 
     def __init__(self, cluster: Optional[InMemoryCluster] = None, port: int = 0,
-                 host: str = "127.0.0.1", auth_tokens=None):
+                 host: str = "127.0.0.1", auth_tokens=None,
+                 log_dir: Optional[str] = None):
         self.cluster = cluster or InMemoryCluster()
         self._server = _Server((host, port), _Handler)
         self._server.cluster = self.cluster
+        self._server.log_dir = log_dir
         if auth_tokens is not None:
             self._server.valid_tokens = set(auth_tokens)
         self.host = host
@@ -467,7 +518,10 @@ def main(argv=None) -> int:
                         "as subprocesses (dev 'single-node cluster')")
     p.add_argument("--log-dir", default="/tmp/ktpu-logs")
     args = p.parse_args(argv)
-    srv = LocalApiServer(port=args.port, host=args.host).start()
+    srv = LocalApiServer(
+        port=args.port, host=args.host,
+        log_dir=args.log_dir if args.with_kubelet else None,
+    ).start()
     kubelet = None
     if args.with_kubelet:
         from k8s_tpu.api.client import KubeClient

@@ -434,6 +434,19 @@ class RestCluster:
                          params=params)
         return len(out.get("items", []))
 
+    # ------------------------------------------------------------ logs
+
+    def pod_log(self, namespace: str, name: str,
+                tail_lines: Optional[int] = None) -> str:
+        """``GET .../pods/{name}/log`` (text/plain subresource) — the
+        kubectl-logs flow. 404s map to NotFoundError like any GET."""
+        params: Dict[str, str] = {}
+        if tail_lines is not None:
+            params["tailLines"] = str(tail_lines)
+        path = wire.ROUTES["Pod"].object_path(namespace, name) + "/log"
+        with self._open("GET", path, params=params) as resp:
+            return resp.read().decode(errors="replace")
+
     # ------------------------------------------------------------ watch
 
     def watch(self, kind: str, namespace: Optional[str] = None,

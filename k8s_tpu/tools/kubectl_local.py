@@ -193,6 +193,50 @@ def cmd_describe(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """`kubectl logs`-style: fetch a pod's log through the apiserver's
+    pods/{name}/log subresource (the reference's debugging flow,
+    README:497-563 — find pods by runtime_id, read their logs). With a
+    TpuJob name, fetches the logs of its worker-0 pod; with an exact
+    pod name, that pod."""
+    from k8s_tpu.api import errors
+    from k8s_tpu.api.client import KubeClient
+    from k8s_tpu.api.restcluster import RestCluster
+    from k8s_tpu.trainer import labels as L
+
+    rest = RestCluster(args.server)
+    name = args.name
+    try:
+        # exact pod name first — works even for a deleted/crashed pod,
+        # whose log deliberately outlives it on the server
+        sys.stdout.write(rest.pod_log(args.namespace, name,
+                                      tail_lines=args.tail))
+        return 0
+    except errors.NotFoundError:
+        pass
+    # a TpuJob name: resolve its pods by the job-name label, ordered by
+    # the numeric task_index label (name sort would put 10 before 2)
+    pods = [
+        p for p in KubeClient(rest).pods.list(args.namespace)
+        if (p.metadata.labels or {}).get(L.JOB_NAME_LABEL) == name
+    ]
+    pods.sort(key=lambda p: int(
+        (p.metadata.labels or {}).get(L.TASK_INDEX_LABEL, "0") or 0))
+    if not pods:
+        print(f"no pod log or TpuJob pods named {name!r}")
+        return 1
+    idx = min(max(args.index, 0), len(pods) - 1)
+    pod_name = pods[idx].metadata.name
+    print(f"# logs of {pod_name}", flush=True)
+    try:
+        sys.stdout.write(rest.pod_log(args.namespace, pod_name,
+                                      tail_lines=args.tail))
+    except errors.NotFoundError as e:
+        print(f"logs unavailable: {e}")
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     default_server = os.environ.get("KTPU_APISERVER_URL", "")
     p = argparse.ArgumentParser(prog="ktpu")
@@ -225,10 +269,20 @@ def main(argv=None) -> int:
     ds.add_argument("-n", "--namespace", default="default")
     ds.add_argument("--server", default=default_server,
                     required=not default_server)
+    lg = sub.add_parser("logs", help="pod logs via the apiserver "
+                                     "(pod name or TpuJob name)")
+    lg.add_argument("name")
+    lg.add_argument("-n", "--namespace", default="default")
+    lg.add_argument("--tail", type=int, default=None,
+                    help="last N lines only")
+    lg.add_argument("--index", type=int, default=0,
+                    help="which replica's pod when given a TpuJob name")
+    lg.add_argument("--server", default=default_server,
+                    required=not default_server)
     args = p.parse_args(argv)
     return {"create": cmd_create, "validate": cmd_validate,
             "get": cmd_get, "delete": cmd_delete,
-            "describe": cmd_describe}[args.cmd](args)
+            "describe": cmd_describe, "logs": cmd_logs}[args.cmd](args)
 
 
 if __name__ == "__main__":

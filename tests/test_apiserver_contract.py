@@ -519,6 +519,32 @@ class TestRealClusterBehaviors:
         finally:
             api.stop()
 
+    def test_pod_log_subresource(self, tmp_path):
+        """GET .../pods/{name}/log — the kubectl-logs flow. Served from
+        the kubelet's log dir (the --with-kubelet dev-cluster shape),
+        text/plain, ?tailLines honored, structured 404s for missing
+        pods and for servers without a log dir."""
+        (tmp_path / "smoke-worker-ab12-0-pod-0.log").write_text(
+            "line1\nline2\nline3\n")
+        api = LocalApiServer(log_dir=str(tmp_path)).start()
+        try:
+            rest = RestCluster(api.url)
+            full = rest.pod_log("default", "smoke-worker-ab12-0-pod-0")
+            assert full == "line1\nline2\nline3\n"
+            tail = rest.pod_log("default", "smoke-worker-ab12-0-pod-0",
+                                tail_lines=2)
+            assert tail == "line2\nline3\n"
+            with pytest.raises(errors.NotFoundError):
+                rest.pod_log("default", "nope")
+        finally:
+            api.stop()
+        api2 = LocalApiServer().start()  # no log dir
+        try:
+            with pytest.raises(errors.NotFoundError, match="log-dir"):
+                RestCluster(api2.url).pod_log("default", "anything")
+        finally:
+            api2.stop()
+
     def test_backend_exception_becomes_structured_500(self, monkeypatch):
         """Advisor finding: an unexpected backend exception must produce
         a metav1.Status 500 on the wire, not a dropped connection."""

@@ -141,6 +141,45 @@ spec:
         finally:
             api.stop()
 
+    def test_logs_by_pod_and_job_name(self, tmp_path, capsys):
+        """`logs <pod>` fetches that pod's log; `logs <tpujob>` resolves
+        worker pods via the tpu_job_name label and picks --index."""
+        from k8s_tpu.api.apiserver import LocalApiServer
+
+        (tmp_path / "myjob-worker-ab12-0-pod-0.log").write_text("w0 says hi\n")
+        (tmp_path / "myjob-worker-ab12-1-pod-0.log").write_text("w1 says hi\n")
+        api = LocalApiServer(log_dir=str(tmp_path)).start()
+        try:
+            for i in range(2):
+                api.cluster.create("Pod", {
+                    "metadata": {
+                        "name": f"myjob-worker-ab12-{i}-pod-0",
+                        "namespace": "default",
+                        "labels": {"tpu_job_name": "myjob",
+                                   "task_index": str(i)},
+                    },
+                })
+            assert kubectl_local.main(
+                ["logs", "myjob-worker-ab12-1-pod-0",
+                 "--server", api.url]) == 0
+            assert "w1 says hi" in capsys.readouterr().out
+            assert kubectl_local.main(
+                ["logs", "myjob", "--server", api.url]) == 0
+            assert "w0 says hi" in capsys.readouterr().out
+            assert kubectl_local.main(
+                ["logs", "myjob", "--index", "1", "--server", api.url]) == 0
+            assert "w1 says hi" in capsys.readouterr().out
+            assert kubectl_local.main(
+                ["logs", "ghost", "--server", api.url]) == 1
+            capsys.readouterr()
+            # a crashed/GC'd pod's log outlives the pod object
+            (tmp_path / "gone-pod-0.log").write_text("last words\n")
+            assert kubectl_local.main(
+                ["logs", "gone-pod-0", "--server", api.url]) == 0
+            assert "last words" in capsys.readouterr().out
+        finally:
+            api.stop()
+
     def test_describe(self, capsys):
         """`describe` surfaces status, conditions, and the job's Events
         — the reference's `kubectl describe tfjobs` view."""
