@@ -50,6 +50,7 @@ class Controller:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._owns_informer = False
+        self._informer_sampler = None
 
     # ------------------------------------------------------------ bootstrap
 
@@ -61,8 +62,20 @@ class Controller:
             # the informer replaces the reference's per-tick polling
             # (SURVEY §7.2 #4): one watch stream per kind, reconcilers
             # read the cache — not O(replicas) GETs every 8s
-            self.client.start_informer(namespace=self.namespace)
+            inf = self.client.start_informer(namespace=self.namespace)
             self._owns_informer = True
+
+            from k8s_tpu.controller import metrics
+
+            def sample_informer(inf=inf):
+                for kind, cache in inf.caches.items():
+                    with cache.lock:
+                        n = len(cache.objects)
+                    metrics.INFORMER_OBJECTS.set(float(n), {"kind": kind})
+                metrics.INFORMER_SYNCED.set(1.0 if inf.synced else 0.0)
+
+            self._informer_sampler = sample_informer
+            metrics.REGISTRY.on_collect(sample_informer)
         try:
             self.job_client.create_crd_definition()
         except errors.AlreadyExistsError:
@@ -185,6 +198,15 @@ class Controller:
         for tj in list(self.jobs.values()):
             tj.join(timeout=5)
         if self._owns_informer:
+            if self._informer_sampler is not None:
+                from k8s_tpu.controller import metrics
+
+                metrics.REGISTRY.remove_collector(self._informer_sampler)
+                self._informer_sampler = None
+                # don't leave last-sampled values lying: a scrape after
+                # shutdown must not read a dead informer as synced
+                metrics.INFORMER_SYNCED.set(0.0)
+                metrics.INFORMER_OBJECTS.clear()
             self.client.stop_informer()
             self._owns_informer = False
 

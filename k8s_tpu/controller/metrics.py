@@ -26,6 +26,12 @@ class _Metric:
     def _key(self, labels: Optional[Dict[str, str]]) -> LabelKV:
         return tuple(sorted((labels or {}).items()))
 
+    def clear(self) -> None:
+        """Drop all label series (a component whose truth this metric
+        mirrored has shut down)."""
+        with self._lock:
+            self.values.clear()
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
         with self._lock:
@@ -67,6 +73,17 @@ class Registry:
     def __init__(self):
         self._metrics: List[_Metric] = []
         self.start_time = time.time()
+        # samplers run at exposition time (gauges whose truth lives in
+        # another component, e.g. informer cache sizes)
+        self._collectors: List = []
+        self._broken_collectors: set = set()
+
+    def on_collect(self, fn) -> None:
+        self._collectors.append(fn)
+
+    def remove_collector(self, fn) -> None:
+        if fn in self._collectors:
+            self._collectors.remove(fn)
 
     def counter(self, name: str, help_text: str) -> Counter:
         m = Counter(name, help_text)
@@ -79,6 +96,19 @@ class Registry:
         return m
 
     def expose(self) -> str:
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception as e:
+                # a broken sampler must not break /metrics, but a
+                # silently-frozen gauge is a debugging trap — log once
+                if fn not in self._broken_collectors:
+                    self._broken_collectors.add(fn)
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "metrics collector %r failed (gauges it feeds "
+                        "are now stale): %s", fn, e)
         lines: List[str] = []
         for m in self._metrics:
             lines.extend(m.expose())
@@ -101,4 +131,12 @@ RECONCILES = REGISTRY.counter(
 )
 LIVE_JOBS = REGISTRY.gauge(
     "ktpu_operator_live_jobs", "Reconcilers currently tracked"
+)
+INFORMER_OBJECTS = REGISTRY.gauge(
+    "ktpu_operator_informer_objects",
+    "Objects held by the watch-fed informer cache, by kind",
+)
+INFORMER_SYNCED = REGISTRY.gauge(
+    "ktpu_operator_informer_synced",
+    "1 when every informer kind has completed its initial list",
 )

@@ -168,6 +168,44 @@ class TestHealthEndpoint:
         finally:
             srv.stop()
 
+    def test_informer_gauges_sampled_at_exposition(self):
+        """A running controller registers an exposition-time sampler:
+        /metrics reports the informer's per-kind cache sizes and sync
+        state, live (not a stale snapshot)."""
+        import time
+
+        from k8s_tpu.api.objects import ObjectMeta, Service
+        from k8s_tpu.controller.controller import Controller
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        controller = Controller(client, TpuJobClient(cluster),
+                                S.ControllerConfig(), reconcile_interval=0.05)
+        controller.start()
+        try:
+            # wait for the SAMPLER, not just the informer: registration
+            # happens a few lines after start_informer() returns
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                # a VALUE series (not the always-present HELP/TYPE
+                # lines) proves the sampler actually registered and ran
+                if 'ktpu_operator_informer_objects{kind="Pod"}' \
+                        in metrics.REGISTRY.expose():
+                    break
+                time.sleep(0.02)
+            client.services.create(Service(
+                metadata=ObjectMeta(name="obs-svc", namespace="default")))
+            body = metrics.REGISTRY.expose()
+            assert 'ktpu_operator_informer_objects{kind="Service"} 1.0' in body
+            assert "ktpu_operator_informer_synced 1.0" in body
+        finally:
+            controller.stop()
+        # sampler deregistered on stop: a later scrape must not read the
+        # dead informer as synced or keep its stale object counts
+        body = metrics.REGISTRY.expose()
+        assert "ktpu_operator_informer_synced 0.0" in body
+        assert 'informer_objects{kind="Service"}' not in body
+
     def test_unhealthy_returns_503(self):
         import urllib.error
         import urllib.request
