@@ -67,14 +67,32 @@ class Controller:
 
             from k8s_tpu.controller import metrics
 
-            def sample_informer(inf=inf):
-                for kind, cache in inf.caches.items():
-                    with cache.lock:
-                        n = len(cache.objects)
-                    metrics.INFORMER_OBJECTS.set(float(n), {"kind": kind})
-                metrics.INFORMER_SYNCED.set(1.0 if inf.synced else 0.0)
+            stopped = threading.Event()
+            sample_lock = threading.Lock()
+
+            def sample_informer(inf=inf, stopped=stopped,
+                                lock=sample_lock):
+                # the lock serializes the sampler body against stop()'s
+                # gauge reset: without it a scrape that passed the flag
+                # check could finish its writes AFTER the reset and
+                # leave a dead informer reported synced forever (the
+                # sampler is removed, so nothing would correct it)
+                with lock:
+                    if stopped.is_set():
+                        metrics.INFORMER_SYNCED.set(0.0)
+                        metrics.INFORMER_OBJECTS.clear()
+                        return
+                    for kind, cache in inf.caches.items():
+                        with cache.lock:
+                            n = len(cache.objects)
+                        metrics.INFORMER_OBJECTS.set(
+                            float(n), {"kind": kind})
+                    metrics.INFORMER_SYNCED.set(
+                        1.0 if inf.synced else 0.0)
 
             self._informer_sampler = sample_informer
+            self._informer_sampler_stopped = stopped
+            self._informer_sampler_lock = sample_lock
             metrics.REGISTRY.on_collect(sample_informer)
         try:
             self.job_client.create_crd_definition()
@@ -201,12 +219,16 @@ class Controller:
             if self._informer_sampler is not None:
                 from k8s_tpu.controller import metrics
 
-                metrics.REGISTRY.remove_collector(self._informer_sampler)
-                self._informer_sampler = None
-                # don't leave last-sampled values lying: a scrape after
-                # shutdown must not read a dead informer as synced
-                metrics.INFORMER_SYNCED.set(0.0)
-                metrics.INFORMER_OBJECTS.clear()
+                # under the sampler's own lock: flag + reset become
+                # atomic w.r.t. any in-flight scrape, so a dead
+                # informer can never be reported synced afterwards
+                with self._informer_sampler_lock:
+                    self._informer_sampler_stopped.set()
+                    metrics.REGISTRY.remove_collector(
+                        self._informer_sampler)
+                    self._informer_sampler = None
+                    metrics.INFORMER_SYNCED.set(0.0)
+                    metrics.INFORMER_OBJECTS.clear()
             self.client.stop_informer()
             self._owns_informer = False
 

@@ -84,6 +84,10 @@ class Registry:
     def remove_collector(self, fn) -> None:
         if fn in self._collectors:
             self._collectors.remove(fn)
+        # drop broken-status too: the closure would otherwise be pinned
+        # (with everything it references) for the process lifetime, and
+        # a re-registered collector would inherit its silenced warning
+        self._broken_collectors.discard(fn)
 
     def counter(self, name: str, help_text: str) -> Counter:
         m = Counter(name, help_text)

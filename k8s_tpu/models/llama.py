@@ -84,6 +84,13 @@ class LlamaConfig:
     # term that dominates long-context decode. Numerics change
     # (per-row symmetric quantization of cached k/v); opt-in.
     kv_quant: str = "none"
+    # RAGGED decode (continuous batching): every batch row sits at its
+    # own cache depth. The append index comes from ``positions[:, 0]``
+    # per row instead of a shared scalar "cache_index" variable — the
+    # caller (k8s_tpu/serving's engine) owns per-slot lengths and the
+    # cache has no index state at all. Requires decode=True; prefill
+    # (s > 1) must be a fresh cache (one slot at position 0).
+    ragged_decode: bool = False
 
     @staticmethod
     def llama3_8b(**kw) -> "LlamaConfig":
@@ -327,10 +334,22 @@ class LlamaAttention(nn.Module):
                     "cache", "value_scale",
                     jnp.zeros, (b, kv, 1, cfg.max_seq_len), jnp.float32,
                 )
-            idx = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-            )
-            cur = idx.value
+            if cfg.ragged_decode:
+                # engine-owned depths: positions[:, 0] IS the per-row
+                # append index; the cache carries no index state
+                if s > 1 and not fresh_cache:
+                    raise ValueError(
+                        "ragged_decode prefill (s > 1) must start from "
+                        "a fresh cache: continuation chunks have no "
+                        "well-defined per-row write offset"
+                    )
+                idx = None
+                cur = positions[:, 0]
+            else:
+                idx = self.variable(
+                    "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                cur = idx.value
             kh = k.transpose(0, 2, 1, 3).astype(cfg.dtype)  # [B,Hkv,s,D]
             vh = v.transpose(0, 2, 1, 3).astype(cfg.dtype)
             use_fused = s == 1 and _use_pallas_decode(
@@ -357,30 +376,51 @@ class LlamaAttention(nn.Module):
                 )
                 out = out[:, None]  # [B, 1, Hq, D]
             else:
+                # XLA-fallback cache writes. Three index regimes:
+                # shared scalar (classic decode), ragged prefill
+                # (fresh slot, always offset 0), ragged single-token
+                # (per-row offsets via vmapped DUS).
+                if not cfg.ragged_decode:
+                    row_at, scale_at = cur, cur
+                elif s > 1:
+                    row_at, scale_at = 0, 0
+                else:
+                    row_at = scale_at = None  # vmapped per-row below
+
+                def _rows(cache_val, new):  # [B,H,S,D] <- [B,H,s,D]
+                    if row_at is not None:
+                        return jax.lax.dynamic_update_slice(
+                            cache_val, new, (0, 0, row_at, 0)
+                        )
+                    return jax.vmap(
+                        lambda c, n, p: jax.lax.dynamic_update_slice(
+                            c, n, (0, p, 0)
+                        )
+                    )(cache_val, new, cur)
+
+                def _scales(scale_val, new):  # [B,H,1,S] <- [B,H,1,s]
+                    if scale_at is not None:
+                        return jax.lax.dynamic_update_slice(
+                            scale_val, new, (0, 0, 0, scale_at)
+                        )
+                    return jax.vmap(
+                        lambda c, n, p: jax.lax.dynamic_update_slice(
+                            c, n, (0, 0, p)
+                        )
+                    )(scale_val, new, cur)
+
                 if kv_q8:
                     from k8s_tpu.ops.attention import quantize_kv_rows
 
                     kq, ksr = quantize_kv_rows(kh)
                     vq, vsr = quantize_kv_rows(vh)
-                    ck.value = jax.lax.dynamic_update_slice(
-                        ck.value, kq, (0, 0, cur, 0)
-                    )
-                    cv.value = jax.lax.dynamic_update_slice(
-                        cv.value, vq, (0, 0, cur, 0)
-                    )
-                    kscale.value = jax.lax.dynamic_update_slice(
-                        kscale.value, ksr[:, :, None], (0, 0, 0, cur)
-                    )
-                    vscale.value = jax.lax.dynamic_update_slice(
-                        vscale.value, vsr[:, :, None], (0, 0, 0, cur)
-                    )
+                    ck.value = _rows(ck.value, kq)
+                    cv.value = _rows(cv.value, vq)
+                    kscale.value = _scales(kscale.value, ksr[:, :, None])
+                    vscale.value = _scales(vscale.value, vsr[:, :, None])
                 else:
-                    ck.value = jax.lax.dynamic_update_slice(
-                        ck.value, kh, (0, 0, cur, 0)
-                    )
-                    cv.value = jax.lax.dynamic_update_slice(
-                        cv.value, vh, (0, 0, cur, 0)
-                    )
+                    ck.value = _rows(ck.value, kh)
+                    cv.value = _rows(cv.value, vh)
                 if s > 1 and fresh_cache:
                     # one-shot prefill: the prompt IS the whole visible
                     # context, so causal self-attention over the new
@@ -402,16 +442,22 @@ class LlamaAttention(nn.Module):
                                  * vscale.value[:, :, 0, :, None]).astype(cfg.dtype)
                     else:
                         k_all, v_all = ck.value, cv.value
-                    q_pos = cur + jnp.arange(s)  # global positions, this chunk
                     k_pos = jnp.arange(cfg.max_seq_len)
-                    mask = jnp.broadcast_to(
-                        k_pos[None, None, :] <= q_pos[None, :, None],
-                        (b, s, cfg.max_seq_len),
-                    )
+                    if cfg.ragged_decode:
+                        # per-row visibility: row b sees cache[:pos_b]
+                        # plus its own token at pos_b
+                        mask = k_pos[None, None, :] <= positions[:, :, None]
+                    else:
+                        q_pos = cur + jnp.arange(s)  # this chunk, global
+                        mask = jnp.broadcast_to(
+                            k_pos[None, None, :] <= q_pos[None, :, None],
+                            (b, s, cfg.max_seq_len),
+                        )
                     out = _cached_attention(
                         q, k_all, v_all, mask, 1.0 / math.sqrt(d)
                     )
-            idx.value = cur + s
+            if idx is not None:
+                idx.value = cur + s
         elif cfg.attention == "ring":
             from k8s_tpu.parallel.ring_attention import ring_attention
 

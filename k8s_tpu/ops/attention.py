@@ -733,8 +733,22 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+def _pos_vector(pos, b: int) -> jax.Array:
+    """Normalize a decode append index to the [B] scalar-prefetch form:
+    scalars broadcast (uniform batch), [B] vectors pass through (ragged
+    batch — per-row cache depths)."""
+    v = jnp.asarray(pos, jnp.int32).reshape(-1)
+    if v.shape[0] == 1:
+        return jnp.broadcast_to(v, (b,))
+    if v.shape[0] != b:
+        raise ValueError(
+            f"pos must be scalar or [batch]={b}, got shape {v.shape}"
+        )
+    return v
+
+
 def _decode_attn_kernel(
-    pos_ref,   # scalar prefetch: [1] int32 current cache index
+    pos_ref,   # scalar prefetch: [B] int32 per-batch cache index
     q_ref,     # [1, 1, G, D]   queries of one (batch, kv-head) group
     kn_ref,    # [1, 1, D]      this step's key
     vn_ref,    # [1, 1, D]      this step's value
@@ -748,10 +762,14 @@ def _decode_attn_kernel(
     """One (batch, kv-head) cell: masked attention of the G grouped
     queries against cache[0:pos] PLUS the incoming token (handled as an
     explicit extra term so the kernel never depends on reading its own
-    write), and the single-row cache append. f32 math throughout."""
+    write), and the single-row cache append. f32 math throughout.
+    ``pos`` is per-batch (RAGGED decode: each row of the batch sits at
+    its own cache depth — the continuous-batching engine's contract);
+    uniform-batch callers pass the same value in every entry."""
     import jax.numpy as jnp  # self-contained for clarity
+    from jax.experimental import pallas as pl  # noqa: PLC0415
 
-    pos = pos_ref[0]
+    pos = pos_ref[pl.program_id(0)]
     q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
     kcache = kc_ref[0, 0].astype(jnp.float32)            # [S, D]
     s_cache = jax.lax.dot_general(                       # [G, S]
@@ -779,8 +797,6 @@ def _decode_attn_kernel(
     # aligned 8-row window around pos — 7 rows carry the original cache
     # content (read from the aliased input slab), one carries the new
     # token
-    from jax.experimental import pallas as pl  # noqa: PLC0415
-
     aligned = (pos // 8) * 8
     win_k = kc_ref[0, 0, pl.ds(aligned, 8), :]               # [8, D] bf16
     win_v = vc_ref[0, 0, pl.ds(aligned, 8), :]
@@ -796,7 +812,8 @@ def decode_attention_update(
     v_new: jax.Array,    # [B, Hkv, D]
     k_cache: jax.Array,  # [B, Hkv, S, D] head-major cache
     v_cache: jax.Array,  # [B, Hkv, S, D]
-    pos,                 # scalar int32: append index (= tokens so far)
+    pos,                 # int32 append index: scalar (uniform batch)
+                         # or [B] vector (ragged batch, one per row)
     scale: Optional[float] = None,
     interpret: bool = False,
 ):
@@ -809,6 +826,11 @@ def decode_attention_update(
     dominant decode overhead; see docs/BENCHMARKS.md decode section).
     The incoming token's attention term is computed from ``k_new``/
     ``v_new`` directly, so the kernel never reads the row it writes.
+
+    ``pos`` may be a **per-batch vector**: row ``b`` then attends over
+    ``cache[b, :, :pos[b]]`` and appends at ``pos[b]`` — the ragged
+    contract of :mod:`k8s_tpu.serving`'s continuous-batching engine,
+    where every slot of the decode batch sits at a different depth.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -835,9 +857,10 @@ def decode_attention_update(
         ],
         out_specs=[
             pl.BlockSpec((1, 1, groups, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
-            # index maps are in BLOCK units: window pos//8 of 8-row blocks
-            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[0] // 8, 0)),
-            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[0] // 8, 0)),
+            # index maps are in BLOCK units: window pos//8 of 8-row
+            # blocks — indexed PER BATCH ROW for ragged decode
+            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[bi] // 8, 0)),
+            pl.BlockSpec((1, 1, 8, d), lambda bi, hi, pos_ref: (bi, hi, pos_ref[bi] // 8, 0)),
         ],
     )
     kernel = functools.partial(_decode_attn_kernel, scale=scale)
@@ -854,7 +877,7 @@ def decode_attention_update(
         input_output_aliases={4: 1, 5: 2},
         interpret=interpret,
     )(
-        jnp.asarray([pos], jnp.int32).reshape(1),
+        _pos_vector(pos, b),
         q4, kn.reshape(b, hkv, 1, d), vn.reshape(b, hkv, 1, d),
         k_cache, v_cache,
     )
@@ -862,7 +885,7 @@ def decode_attention_update(
 
 
 def _decode_attn_kernel_q8(
-    pos_ref,    # scalar prefetch: [1] int32
+    pos_ref,    # scalar prefetch: [B] int32 per-batch cache index
     q_ref,      # [1, 1, G, D]
     kn_ref,     # [1, 1, 1, D] bf16 new key
     vn_ref,     # [1, 1, 1, D] bf16 new value
@@ -881,8 +904,10 @@ def _decode_attn_kernel_q8(
     and dequantized in VMEM — HBM reads halve, which is the decode
     bandwidth term that grows with context. The current token's
     attention term uses the exact bf16 k/v; its row is quantized here
-    and appended in place."""
-    pos = pos_ref[0]
+    and appended in place. ``pos`` is per-batch (ragged decode)."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    pos = pos_ref[pl.program_id(0)]
     q = q_ref[0, 0].astype(jnp.float32) * scale              # [G, D]
     # dequant folded into the SMALL [G, S] matrices, not the [S, D]
     # cache: convert int8 -> f32 for the MXU (1 VPU op/element) and
@@ -913,8 +938,6 @@ def _decode_attn_kernel_q8(
 
     # quantize + append the new row (32-row aligned window: int8 native
     # sublane tile), preserving the other 31 rows from the aliased slab
-    from jax.experimental import pallas as pl  # noqa: PLC0415
-
     aligned = (pos // 32) * 32
     row = jax.lax.broadcasted_iota(jnp.int32, (32, 1), 0)
     is_new = row == (pos - aligned)
@@ -976,8 +999,8 @@ def decode_attention_update_q8(
         ],
         out_specs=[
             pl.BlockSpec((1, 1, groups, d), lambda bi, hi, p: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[0] // 32, 0)),
-            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[0] // 32, 0)),
+            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[bi] // 32, 0)),
+            pl.BlockSpec((1, 1, 32, d), lambda bi, hi, p: (bi, hi, p[bi] // 32, 0)),
             pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, 1, s), lambda bi, hi, p: (bi, hi, 0, 0)),
         ],
@@ -998,7 +1021,7 @@ def decode_attention_update_q8(
         input_output_aliases={4: 1, 5: 2, 6: 3, 7: 4},
         interpret=interpret,
     )(
-        jnp.asarray([pos], jnp.int32).reshape(1),
+        _pos_vector(pos, b),
         q4, k_new[:, :, None], v_new[:, :, None],
         k_cache, v_cache, k_scale, v_scale,
     )

@@ -1,0 +1,12 @@
+"""TPU-native continuous-batching serving (ragged decode).
+
+The reference framework has no serving path at all (its operator only
+wires *training* clusters — SURVEY.md §0); this package is original
+capability built on the repo's decode stack: the fused single-token
+decode kernel (`k8s_tpu/ops/attention.py`) extended with per-row cache
+depths, and `LlamaConfig(ragged_decode=True)`.
+"""
+
+from k8s_tpu.serving.engine import ContinuousBatchingEngine, Request
+
+__all__ = ["ContinuousBatchingEngine", "Request"]

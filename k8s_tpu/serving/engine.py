@@ -1,0 +1,554 @@
+"""Continuous-batching serving engine — TPU-shaped.
+
+GPU serving stacks (vLLM-style) get request-level elasticity from
+*paged* KV caches: per-request block tables resolved by the kernel at
+runtime. On TPU that indirection fights the hardware — Mosaic wants
+static shapes and contiguous slabs. The TPU-native shape of the same
+idea is **slot-based ragged batching**:
+
+- ONE static decode batch of ``max_slots`` rows, compiled once. Every
+  row ("slot") holds one in-flight request at its own cache depth.
+- The fused decode kernel appends/attends at a **per-row** position
+  (``pos`` is a scalar-prefetch vector — `ops/attention.py`), so one
+  kernel launch serves all slots regardless of how ragged they are.
+- Arrivals don't recompile anything: a free slot is filled by a
+  batch-1 **prefill** (one-shot flash over the prompt, padded to a
+  small set of static buckets) whose per-layer K/V slab is scattered
+  into the big cache at the slot index via donated
+  ``dynamic_update_slice`` (in-place, no cache copy).
+- Decode runs in **chunks of K steps inside one jit** (`lax.scan`):
+  EOS/budget deactivation happens on-device, so the host syncs once
+  per K tokens, not per token — load-bearing over a remote-tunnel
+  PJRT transport where every host sync is a round-trip.
+
+Inactive slots still compute (static shapes — that's the TPU trade):
+their writes land on a frozen, masked cache row and their outputs are
+dropped. Utilization therefore degrades gracefully with load instead
+of recompiling with it.
+
+The reference has no serving analogue (training-only operator,
+SURVEY.md §0). Oracle for correctness: each request's tokens must
+equal a solo :func:`k8s_tpu.models.llama.generate` run with the same
+params (pinned by ``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import itertools
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_tpu.models.llama import LlamaForCausalLM, _pick_token
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``tokens`` accumulates the output
+    (first token from prefill + decoded tokens, prompt excluded)."""
+
+    rid: int
+    prompt: np.ndarray  # [plen] int32
+    max_new_tokens: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    submitted_at: float = 0.0   # time.perf_counter at submit()
+    finished_at: float = 0.0    # ... at attribution of the last token
+
+
+def _tree_scatter_slot(cache, small, slot, plen_b: int):
+    """Scatter a batch-1 prefill cache into row ``slot`` of the big
+    cache. Only the first ``plen_b`` rows (the padded prompt) are
+    copied — pad rows land too but stay masked until overwritten by
+    the slot's own decode appends. Leaf layouts (by name):
+
+    - ``cached_key``/``cached_value``: [B, Hkv, S, D], rows on axis 2
+    - ``key_scale``/``value_scale`` (int8-KV): [B, Hkv, 1, S], rows on
+      axis 3
+
+    Leaves may carry a leading scan-stacked layer axis
+    (``scan_layers=True``: [L, B, ...]) — the batch axis is located
+    from the END of the shape, so both layouts scatter identically.
+    """
+
+    def one(path, big, small_leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            rows_axis = big.ndim - 2          # ...the S axis
+        elif name in ("key_scale", "value_scale"):
+            rows_axis = big.ndim - 1          # scales: S is last
+        else:
+            raise ValueError(f"unknown cache leaf {name!r} (ragged "
+                             "caches carry no cache_index)")
+        rows = jax.lax.slice_in_dim(small_leaf, 0, plen_b, axis=rows_axis)
+        batch_axis = big.ndim - 4             # [L?] B Hkv . .
+        start = [jnp.int32(0)] * big.ndim
+        start[batch_axis] = slot
+        return jax.lax.dynamic_update_slice(big, rows, tuple(start))
+
+    return jax.tree_util.tree_map_with_path(one, cache, small)
+
+
+def _lm_head_logits(params, hidden, quant: str):
+    """Head logits for a [*, E] hidden slice — prefill computes hidden
+    for the whole padded prompt but only needs logits at the last REAL
+    token, so the head runs on the gathered row, never on [P, V]."""
+    if quant == "int8_serving":
+        from k8s_tpu.ops.quant import int8_serving_matmul
+
+        lm = params["lm_head"]
+        return int8_serving_matmul(
+            hidden.astype(jnp.float32), lm["kernel_q"], lm["scale"], 1
+        )
+    return hidden.astype(jnp.float32) @ params["lm_head"][
+        "kernel"
+    ].astype(jnp.float32)
+
+
+# Module-level jits (llama.py house rule): defining these inside the
+# engine would make every engine a fresh function object -> full
+# recompile per instance; params/cache stay ARGUMENTS so weights are
+# never baked into the HLO as constants.
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "plen_b", "temperature"),
+    donate_argnums=(2,),
+)
+def _prefill_insert(model, params, cache, slot, prompt_pb, plen, rng,
+                    *, plen_b: int, temperature: float):
+    """Batch-1 prefill of a padded prompt + scatter into ``slot`` of
+    the (donated) big cache. Returns (cache', first_token)."""
+    positions = jnp.broadcast_to(jnp.arange(plen_b), (1, plen_b))
+    hidden, mut = model.apply(
+        {"params": params}, prompt_pb, positions=positions,
+        return_hidden=True, mutable=["cache"],
+    )
+    # last REAL token's hidden row (pads sit after it; causal attention
+    # means they never influence it)
+    h_last = jax.lax.dynamic_index_in_dim(
+        hidden[0], plen - 1, axis=0, keepdims=False
+    )
+    logits = _lm_head_logits(params, h_last[None], model.config.quant)
+    tok = _pick_token(logits, rng, temperature)[0]
+    cache = _tree_scatter_slot(cache, mut["cache"], slot, plen_b)
+    return cache, tok
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "n_steps", "temperature", "eos_id"),
+    donate_argnums=(2, 3, 4, 5, 6),
+)
+def _decode_chunk(model, params, cache, tok, lengths, active, budget,
+                  rng, *, n_steps: int, temperature: float,
+                  eos_id: Optional[int]):
+    """K ragged decode steps in one jit. Per step, every slot advances
+    iff active; EOS/budget/cache-full deactivation happens ON DEVICE.
+
+    Returns ``(state..., packed)`` where ``packed`` is ONE int32
+    array [2K+4, B] — the only thing the host ever fetches:
+
+    - row 0: the chunk's INPUT tokens (how a freshly-prefilled slot's
+      first token reaches the host without its own transfer)
+    - rows 1..K: emitted tokens per step
+    - rows K+1..2K: validity (1 = slot was active at step entry)
+    - rows 2K+1..2K+3: final active / budget / lengths
+
+    One packed fetch per chunk matters because serving runs over a
+    remote-tunnel PJRT transport here: every separate device→host read
+    is a full round-trip (~70-100 ms measured — 20-30 decode steps'
+    worth), which round-tripping 6 small arrays per chunk turned into
+    an 8x throughput hole. All scheduling state stays device-resident
+    between chunks (the engine passes the returned arrays straight
+    back in; donation keeps them in place)."""
+    max_seq = model.config.max_seq_len
+    tok_in = tok
+
+    def step(carry, _):
+        cache, tok, lengths, active, budget, rng = carry
+        rng, r = jax.random.split(rng)
+        pos = jnp.minimum(lengths, max_seq - 1)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], positions=pos[:, None], mutable=["cache"],
+        )
+        nxt = _pick_token(logits[:, -1], r, temperature)  # [B]
+        emitted_by = active
+        nxt = jnp.where(active, nxt, tok)  # freeze inactive slots
+        budget = jnp.where(active, budget - 1, budget)
+        lengths = jnp.where(active, jnp.minimum(lengths + 1, max_seq),
+                            lengths)
+        hit_eos = (
+            (nxt == eos_id) & emitted_by
+            if eos_id is not None
+            else jnp.zeros_like(active)
+        )
+        active = active & (budget > 0) & ~hit_eos & (lengths < max_seq)
+        return (mut["cache"], nxt, lengths, active, budget, rng), (
+            nxt, emitted_by,
+        )
+
+    carry, (toks, valid) = jax.lax.scan(
+        step, (cache, tok, lengths, active, budget, rng), None,
+        length=n_steps,
+    )
+    cache, tok, lengths, active, budget, rng = carry
+    packed = jnp.concatenate([
+        tok_in[None], toks, valid.astype(jnp.int32),
+        active.astype(jnp.int32)[None], budget[None], lengths[None],
+    ], axis=0)
+    return cache, tok, lengths, active, budget, rng, packed
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2, 3), static_argnames=("eos_id",)
+)
+def _set_slot(tok_v, lengths_v, active_v, budget_v, slot, tok_new,
+              plen, max_new, *, eos_id: Optional[int]):
+    """Activate ``slot`` after its prefill — ON DEVICE, including the
+    finished-at-first-token check (the host never sees the prefill
+    token until the next chunk's packed fetch)."""
+    tok_v = tok_v.at[slot].set(tok_new)
+    lengths_v = lengths_v.at[slot].set(plen)
+    budget0 = max_new - 1
+    fin = budget0 <= 0
+    if eos_id is not None:
+        fin = fin | (tok_new == eos_id)
+    active_v = active_v.at[slot].set(~fin)
+    budget_v = budget_v.at[slot].set(budget0)
+    return tok_v, lengths_v, active_v, budget_v
+
+
+def _harvest_loop(fetchq: "queue.Queue", readyq: "queue.Queue") -> None:
+    """Harvester thread: materializes chunks' packed arrays.
+    ``np.asarray`` blocks for a full transport round-trip, so it lives
+    here, off the dispatch path; attribution stays in the pump thread
+    (scheduling state is single-threaded). JAX defers async dispatch
+    errors to exactly this materialization point, so failures are
+    shipped to the pump as ("error", ...) items — a dead harvester
+    would otherwise deadlock the engine silently."""
+    while True:
+        item = fetchq.get()
+        if item is None:
+            return
+        seq, packed, fills, snapshot, t0 = item
+        try:
+            readyq.put((seq, np.asarray(packed), fills, snapshot, t0))
+        except Exception as e:  # noqa: BLE001 - crossing threads
+            readyq.put((seq, e, fills, snapshot, t0))
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_slots"))
+def _init_cache(model, params, max_slots: int):
+    """Allocate the big ragged cache: one throwaway single-token apply
+    creates zero-filled cache variables for all slots (the garbage row
+    each slot writes at position 0 is overwritten by its first
+    prefill insert and masked until then)."""
+    dummy = jnp.zeros((max_slots, 1), jnp.int32)
+    _, mut = model.apply(
+        {"params": params}, dummy,
+        positions=jnp.zeros((max_slots, 1), jnp.int32),
+        mutable=["cache"],
+    )
+    return mut["cache"]
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a ragged-decode model.
+
+    Parameters
+    ----------
+    model:
+        ``LlamaForCausalLM`` with ``decode=True, ragged_decode=True``
+        (``scan_layers=False`` recommended — the unrolled decode layout
+        is the measured-fast one, docs/BENCHMARKS.md).
+    params:
+        Canonical (or serving-transformed) parameter tree.
+    max_slots:
+        Static decode batch width = max concurrent requests in flight.
+    prompt_buckets:
+        Static prefill lengths; a prompt compiles at the smallest
+        bucket that fits, so distinct prompt lengths cost at most
+        ``len(prompt_buckets)`` prefill compilations, ever.
+    decode_chunk:
+        Decode steps per host round-trip (and per scheduling
+        opportunity): larger amortizes host sync; smaller fills freed
+        slots sooner. 16-32 is a good range on a tunnel transport.
+    """
+
+    def __init__(
+        self,
+        model: LlamaForCausalLM,
+        params,
+        *,
+        max_slots: int = 8,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        decode_chunk: int = 64,
+        prompt_buckets: Optional[Sequence[int]] = None,
+        rng: Optional[jax.Array] = None,
+        pipeline_depth: int = 2,
+    ):
+        cfg = model.config
+        if not (cfg.decode and cfg.ragged_decode):
+            raise ValueError(
+                "engine needs LlamaConfig(decode=True, ragged_decode=True)"
+            )
+        self.model = model
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.max_seq = int(cfg.max_seq_len)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.decode_chunk = int(decode_chunk)
+        # chunks dispatched ahead of the oldest un-harvested one: the
+        # packed fetch of chunk N then overlaps chunk N+1's execution,
+        # hiding the transport round-trip entirely (1 = fetch blocks
+        # the device; 2 is enough to cover one RTT)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        if prompt_buckets is None:
+            prompt_buckets = [
+                b for b in (128, 256, 512, 1024, 2048, 4096, 8192)
+                if b < self.max_seq
+            ]
+        self.prompt_buckets = sorted(int(b) for b in prompt_buckets)
+        if not self.prompt_buckets:
+            raise ValueError("need at least one prompt bucket < max_seq_len")
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        # ALL decode state lives on device between chunks; the host
+        # holds only a scheduling VIEW refreshed from each chunk's
+        # packed fetch (self._active_h). Shipping the [B] vectors back
+        # and forth per chunk cost a tunnel round-trip each.
+        self._cache = _init_cache(model, params, self.max_slots)
+        self._tok = jnp.zeros(self.max_slots, jnp.int32)
+        self._lengths = jnp.zeros(self.max_slots, jnp.int32)
+        self._active = jnp.zeros(self.max_slots, bool)
+        self._budget = jnp.zeros(self.max_slots, jnp.int32)
+        self._active_h = np.zeros(self.max_slots, bool)  # host view
+        self._slot_req: List[Optional[Request]] = [None] * self.max_slots
+        self._queue: collections.deque = collections.deque()
+        self._reqs: Dict[int, Request] = {}
+        self._done: Dict[int, Request] = {}
+        self._rid = itertools.count()
+        # Dispatched chunks flow pump -> _fetchq -> harvester threads
+        # (which own the ONLY blocking device→host transfers) ->
+        # _readyq -> pump attribution, re-ordered by sequence number.
+        # The transfer round-trip is ~120 ms on the tunnel transport —
+        # more than a small chunk's compute — so fetches must neither
+        # sit on the dispatch path NOR serialize with each other (one
+        # harvester capped the whole engine at ~1 chunk per RTT).
+        self._fetchq: "queue.Queue" = queue.Queue()
+        self._readyq: "queue.Queue" = queue.Queue()
+        self._unattributed = 0   # dispatched, not yet attributed
+        self._seq = 0            # dispatch order
+        self._attr_seq = 0       # next chunk to attribute
+        self._ready_held: Dict[int, tuple] = {}  # out-of-order buffer
+        # the thread target closes over the QUEUES, not self: a
+        # bound-method target would pin the engine (and its device KV
+        # cache) for the process lifetime if close() is never called
+        self._harvesters = [
+            threading.Thread(
+                target=_harvest_loop,
+                args=(self._fetchq, self._readyq),
+                daemon=True, name=f"serving-harvester-{i}")
+            for i in range(4)
+        ]
+        for t in self._harvesters:
+            t.start()
+        # operational counters (surfaced by the bench / metrics hook)
+        self.stats = {"prefills": 0, "chunks": 0, "decode_steps": 0,
+                      "wasted_slot_steps": 0, "prefill_s": 0.0,
+                      "chunk_s": 0.0}
+
+    # -- request intake --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt len {prompt.size} exceeds the largest bucket "
+                f"{self.prompt_buckets[-1]}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} exceeds "
+                f"cache size {self.max_seq}"
+            )
+        req = Request(next(self._rid), prompt, int(max_new_tokens),
+                      submitted_at=time.perf_counter())
+        self._reqs[req.rid] = req
+        # deque.append is atomic: submit() may be called from an
+        # arrival thread while the pump runs
+        self._queue.append(req)
+        return req.rid
+
+    # -- scheduling ------------------------------------------------------
+
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.prompt_buckets:
+            if plen <= b:
+                return b
+        raise AssertionError  # guarded in submit()
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, r = jax.random.split(self._rng)
+        return r
+
+    def _fill_free_slots(self) -> Dict[int, int]:
+        """Dispatch a prefill+insert for every (free slot, queued
+        request) pair — fully async, nothing fetched. Returns
+        {slot: rid} of the fills; their first tokens surface in the
+        NEXT dispatched chunk's packed row 0."""
+        fills: Dict[int, int] = {}
+        for slot in range(self.max_slots):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            plen = int(req.prompt.size)
+            plen_b = self._bucket_for(plen)
+            padded = np.zeros((1, plen_b), np.int32)
+            padded[0, :plen] = req.prompt
+            t0 = time.perf_counter()
+            self._cache, tok_new = _prefill_insert(
+                self.model, self.params, self._cache,
+                jnp.int32(slot), jnp.asarray(padded), jnp.int32(plen),
+                self._next_rng(), plen_b=plen_b,
+                temperature=self.temperature,
+            )
+            (self._tok, self._lengths, self._active,
+             self._budget) = _set_slot(
+                self._tok, self._lengths, self._active, self._budget,
+                jnp.int32(slot), tok_new, jnp.int32(plen),
+                jnp.int32(req.max_new_tokens), eos_id=self.eos_id,
+            )
+            self.stats["prefills"] += 1
+            self.stats["prefill_s"] += time.perf_counter() - t0
+            self._slot_req[slot] = req
+            self._active_h[slot] = True  # optimistic; fixed at harvest
+            fills[slot] = req.rid
+        return fills
+
+    # -- the pump --------------------------------------------------------
+
+    def _dispatch_chunk(self, fills: Dict[int, int]) -> None:
+        (self._cache, self._tok, self._lengths, self._active,
+         self._budget, self._rng, packed) = _decode_chunk(
+            self.model, self.params, self._cache, self._tok,
+            self._lengths, self._active, self._budget, self._rng,
+            n_steps=self.decode_chunk, temperature=self.temperature,
+            eos_id=self.eos_id,
+        )
+        snapshot = [r.rid if r is not None else None
+                    for r in self._slot_req]
+        self._fetchq.put(
+            (self._seq, packed, fills, snapshot, time.perf_counter()))
+        self._seq += 1
+        self._unattributed += 1
+        self.stats["chunks"] += 1
+        self.stats["decode_steps"] += self.decode_chunk
+
+    def _next_ready(self, block: bool):
+        """Chunk results in DISPATCH order: parallel harvesters finish
+        out of order; attribution must not (token order per slot)."""
+        while self._attr_seq not in self._ready_held:
+            try:
+                item = self._readyq.get(block=block)
+            except queue.Empty:
+                return None
+            self._ready_held[item[0]] = item[1:]
+        out = self._ready_held.pop(self._attr_seq)
+        self._attr_seq += 1
+        return out
+
+    def _attribute(self, block: bool) -> bool:
+        """Apply one harvested chunk's results via the dispatch-time
+        slot→request snapshot — a slot may have been refilled since, so
+        current `_slot_req` must not be trusted for old chunks."""
+        item = self._next_ready(block)
+        if item is None:
+            return False
+        arr, fills, snapshot, t0 = item
+        self._unattributed -= 1
+        if isinstance(arr, Exception):
+            raise RuntimeError(
+                f"decode chunk {self._attr_seq - 1} failed on device"
+            ) from arr
+        K = self.decode_chunk
+        tok_in, toks = arr[0], arr[1:K + 1]
+        valid = arr[K + 1:2 * K + 1].astype(bool)
+        active_out = arr[2 * K + 1].astype(bool)
+        self.stats["chunk_s"] += time.perf_counter() - t0
+        self.stats["wasted_slot_steps"] += int((~valid).sum())
+        for slot, rid in enumerate(snapshot):
+            if rid is None:
+                continue
+            req = self._reqs[rid]
+            if req.done:
+                continue
+            if fills.get(slot) == rid:
+                # the prefill's token rode in as this chunk's input
+                req.tokens.append(int(tok_in[slot]))
+            req.tokens.extend(int(t) for t in toks[valid[:, slot], slot])
+            if not active_out[slot]:
+                req.done = True
+                req.finished_at = time.perf_counter()
+                self._done[rid] = req
+                if self._slot_req[slot] is req:
+                    self._slot_req[slot] = None
+                    self._active_h[slot] = False
+        return True
+
+    def step(self) -> bool:
+        """One pump round: attribute whatever the harvester finished,
+        fill free slots, dispatch. Returns True while work remains."""
+        while self._attribute(block=False):
+            pass
+        if self._unattributed >= self.pipeline_depth:
+            self._attribute(block=True)
+        fills = self._fill_free_slots()
+        if fills or self._active_h.any():
+            self._dispatch_chunk(fills)
+        elif self._unattributed:
+            self._attribute(block=True)
+        return bool(
+            self._queue or self._unattributed
+            or any(r is not None for r in self._slot_req)
+        )
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drain the queue; returns {rid: tokens [n] int32} for every
+        submitted request (prompt excluded)."""
+        while self.step():
+            pass
+        return {rid: np.asarray(r.tokens, np.int32)
+                for rid, r in self._done.items()}
+
+    def close(self) -> None:
+        """Stop the harvester threads. Also runs from ``__del__``:
+        since the threads hold only the queues, an abandoned engine is
+        collectible, and collection shuts its workers down."""
+        for _ in self._harvesters:
+            self._fetchq.put(None)
+        for t in self._harvesters:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+
+    def __del__(self):  # best-effort; close() is still the right API
+        try:
+            for _ in self._harvesters:
+                self._fetchq.put(None)
+        except Exception:
+            pass

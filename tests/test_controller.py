@@ -186,8 +186,16 @@ class TestControllerPaths:
 
     def test_crd_created_on_init(self):
         client, jc, controller, _ = make_world()
-        controller.init_resource()
-        assert jc.crd_established()
+        try:
+            controller.init_resource()
+            assert jc.crd_established()
+        finally:
+            # init_resource started an informer + registered its
+            # metrics sampler on the global registry: without stop()
+            # the leaked sampler keeps reporting informer_synced=1 in
+            # every later test's scrape (caught by
+            # test_informer_gauges_sampled_at_exposition)
+            controller.stop()
 
     def test_watchdog_fires(self):
         wd = PanicTimer(deadline=0.05, msg="test", hard=False)
