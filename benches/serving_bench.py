@@ -146,13 +146,15 @@ def main(argv=None) -> int:
 
         sub = threading.Thread(target=submitter, daemon=True)
         sub.start()
-        while (sub.is_alive() or len(eng._done) < args.requests):
+        finished = {}
+        while sub.is_alive() or len(finished) < args.requests:
             if not eng.step():
                 time.sleep(0.001)
+            finished.update(eng.pop_finished())
         wall = time.perf_counter() - t_start
         sub.join()
-        out = {r: np.asarray(eng._reqs[r].tokens, np.int32) for r in rids}
-        lats = [eng._reqs[r].finished_at - eng._reqs[r].submitted_at
+        out = {r: np.asarray(finished[r].tokens, np.int32) for r in rids}
+        lats = [finished[r].finished_at - finished[r].submitted_at
                 for r in rids]
         eng.close()
         return eng, out, wall, lats

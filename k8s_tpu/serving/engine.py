@@ -322,6 +322,15 @@ class ContinuousBatchingEngine:
         self.prompt_buckets = sorted(int(b) for b in prompt_buckets)
         if not self.prompt_buckets:
             raise ValueError("need at least one prompt bucket < max_seq_len")
+        if self.prompt_buckets[-1] >= self.max_seq:
+            # a bucket >= max_seq_len would accept prompts whose prefill
+            # then fails at trace time with an opaque
+            # dynamic_update_slice shape error — refuse loudly instead
+            raise ValueError(
+                f"prompt bucket {self.prompt_buckets[-1]} >= max_seq_len "
+                f"{self.max_seq}: every bucket must leave room for at "
+                "least one generated token"
+            )
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         # ALL decode state lives on device between chunks; the host
@@ -336,9 +345,17 @@ class ContinuousBatchingEngine:
         self._active_h = np.zeros(self.max_slots, bool)  # host view
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
         self._queue: collections.deque = collections.deque()
+        # Request lifetime: submit() -> _reqs (in flight) -> on the
+        # finishing chunk's attribution, moved to _done -> drained by
+        # pop_finished()/run(). Nothing is retained after the drain, so
+        # a long-lived server's memory is bounded by in-flight work.
         self._reqs: Dict[int, Request] = {}
         self._done: Dict[int, Request] = {}
         self._rid = itertools.count()
+        self._closed = False
+        # guards the closed-flag check-then-enqueue in submit() against
+        # a concurrent close() (submit is documented thread-safe)
+        self._close_lock = threading.Lock()
         # Dispatched chunks flow pump -> _fetchq -> harvester threads
         # (which own the ONLY blocking device→host transfers) ->
         # _readyq -> pump attribution, re-ordered by sequence number.
@@ -389,10 +406,16 @@ class ContinuousBatchingEngine:
             )
         req = Request(next(self._rid), prompt, int(max_new_tokens),
                       submitted_at=time.perf_counter())
-        self._reqs[req.rid] = req
-        # deque.append is atomic: submit() may be called from an
-        # arrival thread while the pump runs
-        self._queue.append(req)
+        # the closed check and the enqueue must be one atomic unit vs a
+        # concurrent close() (submit is documented callable from an
+        # arrival thread): after close() the harvesters are gone, so a
+        # request slipping past an unsynchronized check would enqueue
+        # onto a dead engine and its caller would wait forever
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._reqs[req.rid] = req
+            self._queue.append(req)
         return req.rid
 
     # -- scheduling ------------------------------------------------------
@@ -495,8 +518,10 @@ class ContinuousBatchingEngine:
         for slot, rid in enumerate(snapshot):
             if rid is None:
                 continue
-            req = self._reqs[rid]
-            if req.done:
+            # finished requests leave _reqs at attribution (and may be
+            # drained entirely); stale snapshot entries for them skip
+            req = self._reqs.get(rid)
+            if req is None or req.done:
                 continue
             if fills.get(slot) == rid:
                 # the prefill's token rode in as this chunk's input
@@ -505,7 +530,7 @@ class ContinuousBatchingEngine:
             if not active_out[slot]:
                 req.done = True
                 req.finished_at = time.perf_counter()
-                self._done[rid] = req
+                self._done[rid] = self._reqs.pop(rid)
                 if self._slot_req[slot] is req:
                     self._slot_req[slot] = None
                     self._active_h[slot] = False
@@ -514,6 +539,8 @@ class ContinuousBatchingEngine:
     def step(self) -> bool:
         """One pump round: attribute whatever the harvester finished,
         fill free slots, dispatch. Returns True while work remains."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
         while self._attribute(block=False):
             pass
         if self._unattributed >= self.pipeline_depth:
@@ -528,18 +555,31 @@ class ContinuousBatchingEngine:
             or any(r is not None for r in self._slot_req)
         )
 
+    def pop_finished(self) -> Dict[int, Request]:
+        """Drain and return every finished-but-uncollected request.
+        Callers driving :meth:`step` directly (a server front-end)
+        poll this between rounds; once popped, the engine retains no
+        reference to the request."""
+        done, self._done = self._done, {}
+        return done
+
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue; returns {rid: tokens [n] int32} for every
-        submitted request (prompt excluded)."""
+        request finished since the last drain (prompt excluded) —
+        requests already collected by an earlier run()/pop_finished()
+        are not re-returned."""
         while self.step():
             pass
         return {rid: np.asarray(r.tokens, np.int32)
-                for rid, r in self._done.items()}
+                for rid, r in self.pop_finished().items()}
 
     def close(self) -> None:
-        """Stop the harvester threads. Also runs from ``__del__``:
-        since the threads hold only the queues, an abandoned engine is
-        collectible, and collection shuts its workers down."""
+        """Stop the harvester threads; subsequent submit()/step()
+        raise. Also runs from ``__del__``: since the threads hold only
+        the queues, an abandoned engine is collectible, and collection
+        shuts its workers down."""
+        with self._close_lock:
+            self._closed = True
         for _ in self._harvesters:
             self._fetchq.put(None)
         for t in self._harvesters:

@@ -215,6 +215,42 @@ class TestEngineUntrained:
         with pytest.raises(ValueError, match="ragged_decode"):
             ContinuousBatchingEngine(m, params, max_slots=2)
 
+    def test_oversize_prompt_bucket_rejected(self):
+        """A bucket >= max_seq_len would accept prompts whose prefill
+        fails at trace time with an opaque shape error — the engine
+        must refuse the config up front."""
+        _, params = self._params()
+        with pytest.raises(ValueError, match="max_seq_len"):
+            _mk_engine(params, max_slots=1, prompt_buckets=(8, 64))
+
+    def test_closed_engine_raises(self):
+        """After close() the harvesters are gone; submit()/step() must
+        raise instead of deadlocking on a fetch nobody will serve."""
+        _, params = self._params()
+        eng = _mk_engine(params, max_slots=1)
+        rid = eng.submit(np.array([3, 5], np.int32), 2)
+        out = eng.run()
+        assert len(out[rid]) == 2
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(np.array([3], np.int32), 1)
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.step()
+
+    def test_run_returns_only_newly_finished(self):
+        """Completed requests are drained by run()/pop_finished() and
+        evicted — a long-lived server does not accumulate history, and
+        a second run() does not re-return old results."""
+        _, params = self._params()
+        eng = _mk_engine(params, max_slots=1)
+        rid1 = eng.submit(np.array([3, 5], np.int32), 2)
+        out1 = eng.run()
+        assert set(out1) == {rid1}
+        rid2 = eng.submit(np.array([7], np.int32), 2)
+        out2 = eng.run()
+        assert set(out2) == {rid2}  # rid1 not re-returned
+        assert not eng._reqs and not eng._done  # nothing retained
+
 
 class TestEngineTrained:
     """Multi-slot oracle tests on trained weights (real logit margins:
@@ -288,6 +324,33 @@ class TestEngineTrained:
         rid2 = eng.submit(p, 2)
         out2 = eng.run()
         assert np.array_equal(out2[rid2], ref[:2])
+
+    def test_int8_serving_engine_matches_quantized_generate(self, fixture):
+        """Weight-only int8 serving quantization through the engine:
+        the quant='int8_serving' prefill lm_head branch
+        (engine._lm_head_logits) must produce the same tokens as a solo
+        generate over the identically transformed params — pins the
+        kernel_q/scale layout contract of quantize_params_for_serving
+        against engine drift."""
+        from k8s_tpu.ops.quant import quantize_params_for_serving
+
+        _, _, params = fixture
+        cfg, _ = trained_tiny()
+        sparams = quantize_params_for_serving(params)
+        dec = dataclasses.replace(
+            cfg, decode=True, ragged_decode=True, max_seq_len=64,
+            quant="int8_serving")
+        oracle = LlamaForCausalLM(dataclasses.replace(
+            cfg, decode=True, max_seq_len=64, quant="int8_serving"))
+        eng = ContinuousBatchingEngine(
+            LlamaForCausalLM(dec), sparams, max_slots=2, decode_chunk=4,
+            prompt_buckets=(4, 8))
+        p = np.array([2, 3, 5, 7], np.int32)
+        rid = eng.submit(p, 6)
+        out = eng.run()
+        ref = np.asarray(
+            generate(oracle, sparams, jnp.asarray(p)[None], 6))[0]
+        assert np.array_equal(out[rid], ref)
 
     def test_int8_kv_engine_runs(self, fixture):
         """Ragged decode composes with the int8 KV cache (XLA fallback
