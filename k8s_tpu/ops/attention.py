@@ -127,20 +127,22 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
-def _segment_mask(s, seg_ref, qi, ki, block_q: int, block_k: int):
+def _segment_mask(s, seg_q_ref, seg_k_ref, qi, ki, block_q: int, block_k: int):
     """Mask scores across segment boundaries: token j is visible to
     token i iff their segment ids match. Padding is the degenerate
     case (mask 1 = real, 0 = pad): pad keys become invisible to real
     queries; pad-query rows produce garbage outputs, which the loss
     mask is expected to drop (same contract as every flash kernel).
 
-    ``seg_ref`` is the full [1, 1, S] row (the lse layout — Mosaic
+    ``seg_*_ref`` are full [1, 1, S] rows (the lse layout — Mosaic
     rejects (1, block) blocks of a [B, S] array); the q/k slices are
-    cut here. Self-attention only, hence one shared row."""
+    cut here. Self-attention passes the SAME ref twice; ring attention
+    passes the local q row and the currently-resident (rotated) KV
+    chunk's row, which generally differ."""
     from jax.experimental import pallas as pl
 
-    seg_q = seg_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
-    seg_k = seg_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
+    seg_q = seg_q_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+    seg_k = seg_k_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
     return jnp.where(seg_q == seg_k, s, NEG_INF)
 
 
@@ -184,7 +186,8 @@ def _fwd_kernel(
     q_ref,    # [1, block_q, d]
     k_ref,    # [1, block_k, d]
     v_ref,    # [1, block_k, d]
-    seg_ref,  # [1, 1, Sq] int32 full row, or None
+    seg_ref,  # [1, 1, Sq] int32 full q-side row, or None
+    segk_ref, # [1, 1, Sk] int32 kv-side row (== seg_ref for self-attn)
     o_ref,    # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] or absent
     m_scr,    # [block_q, 128] f32 running max (col 0 live, lane-padded)
@@ -225,7 +228,7 @@ def _fwd_kernel(
         if causal:
             s = _causal_mask(s, qi, kk, block_q, block_k)
         if seg_ref is not None:
-            s = _segment_mask(s, seg_ref, qi, kk, block_q, block_k)
+            s = _segment_mask(s, seg_ref, segk_ref, qi, kk, block_q, block_k)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -254,6 +257,7 @@ def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool, with_residuals: bool = False,
     out_f32: bool = False, segment_ids: Optional[jax.Array] = None,
+    segment_ids_kv: Optional[jax.Array] = None,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -264,6 +268,12 @@ def _flash_forward(
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     with_segments = segment_ids is not None
+    # ring attention: the resident KV chunk's segment row differs from
+    # the local q row — a second operand carries it; self-attention
+    # reuses the single q-side ref for both sides of the mask
+    with_kv_segments = segment_ids_kv is not None
+    if with_kv_segments and not with_segments:
+        raise ValueError("segment_ids_kv requires segment_ids")
 
     # [B, S, H, D] -> [B*H, S, D] with the kv head index recoverable as
     # (flat_head // groups) for GQA
@@ -279,14 +289,15 @@ def _flash_forward(
 
     def kernel(q_r, k_r, v_r, *rest):
         # pallas passes refs positionally: inputs, outputs, scratch —
-        # the segment input and the lse output are present only on demand
+        # the segment inputs and the lse output are present only on demand
         rest = list(rest)
         seg_r = rest.pop(0) if with_segments else None
+        segk_r = rest.pop(0) if with_kv_segments else seg_r
         o_r = rest.pop(0)
         lse_r = rest.pop(0) if with_residuals else None
         m_s, l_s, a_s = rest
         _fwd_kernel(
-            q_r, k_r, v_r, seg_r, o_r, lse_r, m_s, l_s, a_s,
+            q_r, k_r, v_r, seg_r, segk_r, o_r, lse_r, m_s, l_s, a_s,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             num_k_blocks=num_k_blocks, with_lse=with_residuals,
         )
@@ -302,6 +313,10 @@ def _flash_forward(
         seg = segment_ids.astype(jnp.int32).reshape(b, 1, sq)
         in_specs.append(pl.BlockSpec((1, 1, sq), lambda h, i, kk: (h // hq, 0, 0)))
         operands.append(seg)
+    if with_kv_segments:
+        segk = segment_ids_kv.astype(jnp.int32).reshape(b, 1, sk)
+        in_specs.append(pl.BlockSpec((1, 1, sk), lambda h, i, kk: (h // hq, 0, 0)))
+        operands.append(segk)
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda h, i, kk: (h, i, 0))]
     # out_f32: ring attention merges per-step partials — quantizing each
@@ -343,7 +358,8 @@ def _bwd_dq_kernel(
     do_ref,   # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] full row
     dd_ref,   # [1, 1, Sq] full row   D = rowsum(dO * O)
-    seg_ref,  # [1, 1, Sq] int32 full row, or None
+    seg_ref,  # [1, 1, Sq] int32 full q-side row, or None
+    segk_ref, # [1, 1, Sk] kv-side row (== seg_ref for self-attn)
     dq_ref,   # [1, block_q, d]
     dq_scr,   # [block_q, d] f32
     *,
@@ -380,7 +396,7 @@ def _bwd_dq_kernel(
         if causal:
             s = _causal_mask(s, qi, kk, block_q, block_k)
         if seg_ref is not None:
-            s = _segment_mask(s, seg_ref, qi, kk, block_q, block_k)
+            s = _segment_mask(s, seg_ref, segk_ref, qi, kk, block_q, block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -402,7 +418,8 @@ def _bwd_dkv_kernel(
     do_ref,   # [1, block_q, d]
     lse_ref,  # [1, 1, Sq] full row
     dd_ref,   # [1, 1, Sq] full row
-    seg_ref,  # [1, 1, Sq] int32 full row, or None
+    seg_ref,  # [1, 1, Sq] int32 full q-side row, or None
+    segk_ref, # [1, 1, Sk] kv-side row (== seg_ref for self-attn)
     dk_ref,   # [1, block_k, d]
     dv_ref,   # [1, block_k, d]
     dk_scr,   # [block_k, d] f32
@@ -444,7 +461,7 @@ def _bwd_dkv_kernel(
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if seg_ref is not None:
-            s = _segment_mask(s, seg_ref, qi, ki, block_q, block_k)
+            s = _segment_mask(s, seg_ref, segk_ref, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -463,6 +480,15 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def int_zero_cotangent(x) -> "np.ndarray":
+    """float0 cotangent for an integer operand (segment ids carry no
+    gradient) — the convention ``jax.custom_vjp`` requires for
+    non-float inputs. Shared by the flash and ring backwards."""
+    import numpy as np
+
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
 def compute_dd(out: jax.Array, g: jax.Array) -> jax.Array:
     """D = rowsum(dO * O) in the backward's [B*H, 1, Sq] row layout.
 
@@ -479,6 +505,7 @@ def compute_dd(out: jax.Array, g: jax.Array) -> jax.Array:
 def _flash_backward(
     q, k, v, dd, lse, g, causal, scale, block_q, block_k, interpret,
     grads_f32: bool = False, segment_ids: Optional[jax.Array] = None,
+    segment_ids_kv: Optional[jax.Array] = None,
 ):
     """Pallas flash backward: dq streams KV blocks, dk/dv stream Q
     blocks, both recomputing P from the saved logsumexp — no S^2 in HBM
@@ -493,6 +520,9 @@ def _flash_backward(
     bq = _fit_block(block_q, sq)
     bk = _fit_block(block_k, sk)
     with_segments = segment_ids is not None
+    with_kv_segments = segment_ids_kv is not None
+    if with_kv_segments and not with_segments:
+        raise ValueError("segment_ids_kv requires segment_ids")
 
     qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
@@ -505,12 +535,16 @@ def _flash_backward(
     if with_segments:
         seg = segment_ids.astype(jnp.int32).reshape(b, 1, sq)
         operands.append(seg)
+    if with_kv_segments:
+        segk = segment_ids_kv.astype(jnp.int32).reshape(b, 1, sk)
+        operands.append(segk)
 
     def dq_wrapper(q_r, k_r, v_r, do_r, lse_r, dd_r, *rest):
         rest = list(rest)
         seg_r = rest.pop(0) if with_segments else None
+        segk_r = rest.pop(0) if with_kv_segments else seg_r
         _bwd_dq_kernel(
-            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, *rest,
+            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, segk_r, *rest,
             scale=scale, causal=causal, block_q=bq, block_k=bk,
             num_k_blocks=pl.cdiv(sk, bk),
         )
@@ -526,6 +560,10 @@ def _flash_backward(
     if with_segments:
         dq_in_specs.append(
             pl.BlockSpec((1, 1, sq), lambda h, i, kk: (h // hq, 0, 0))
+        )
+    if with_kv_segments:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, sk), lambda h, i, kk: (h // hq, 0, 0))
         )
 
     dq = pl.pallas_call(
@@ -545,8 +583,9 @@ def _flash_backward(
     def dkv_wrapper(q_r, k_r, v_r, do_r, lse_r, dd_r, *rest):
         rest = list(rest)
         seg_r = rest.pop(0) if with_segments else None
+        segk_r = rest.pop(0) if with_kv_segments else seg_r
         _bwd_dkv_kernel(
-            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, *rest,
+            q_r, k_r, v_r, do_r, lse_r, dd_r, seg_r, segk_r, *rest,
             scale=scale, causal=causal, block_q=bq, block_k=bk,
             num_q_blocks=pl.cdiv(sq, bq),
         )
@@ -562,6 +601,10 @@ def _flash_backward(
     if with_segments:
         dkv_in_specs.append(
             pl.BlockSpec((1, 1, sq), lambda h, ki, i: (h // hq, 0, 0))
+        )
+    if with_kv_segments:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, sk), lambda h, ki, i: (h // hq, 0, 0))
         )
 
     # dk/dv per *q*-head (kv grads accumulate across the GQA group
@@ -636,12 +679,9 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         q, k, v, compute_dd(out, g), lse, g, causal, scale, bwd_bq, bwd_bk,
         interpret, segment_ids=segment_ids,
     )
-    # integer segment ids carry no gradient: float0 cotangent
-    dseg = None
-    if segment_ids is not None:
-        import numpy as np
-
-        dseg = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    dseg = (
+        int_zero_cotangent(segment_ids) if segment_ids is not None else None
+    )
     return dq, dk, dv, dseg
 
 

@@ -47,6 +47,7 @@ from k8s_tpu.ops.attention import (
     _flash_backward,
     _flash_forward,
     compute_dd,
+    int_zero_cotangent,
     resolve_blocks,
     resolve_bwd_blocks,
 )
@@ -154,70 +155,81 @@ def _rotate(x, axis_name: str):
     return jax.lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, seg, axis_name, causal, scale, block_q, block_k,
+                interpret):
     out, _ = _ring_flash_fwd(
-        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+        q, k, v, seg, axis_name, causal, scale, block_q, block_k, interpret
     )
     return out
 
 
 def _ring_flash_fwd(
-    q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+    q, k, v, seg, axis_name, causal, scale, block_q, block_k, interpret
 ):
     b, sq, hq, d = q.shape
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
+    with_seg = seg is not None
 
-    def block_fwd(k_blk, v_blk, blk_causal):
+    def block_fwd(k_blk, v_blk, seg_blk, blk_causal):
         # out_f32: partials stay f32 through the log-space merge; the
-        # single cast to q.dtype happens after the last ring step
+        # single cast to q.dtype happens after the last ring step.
+        # seg (local q row) vs seg_blk (the resident KV chunk's row):
+        # the kernels mask on both sides, so packed documents compose
+        # with the ring exactly as on a single device
         return _flash_forward(
             q, k_blk, v_blk, blk_causal, scale, block_q, block_k, interpret,
             with_residuals=True, out_f32=True,
+            segment_ids=seg, segment_ids_kv=seg_blk if with_seg else None,
         )
 
-    # step 0: the diagonal chunk (kv home) — statically causal
-    out_acc, lse_acc = block_fwd(k, v, causal)
+    # step 0: the diagonal chunk (kv home) — statically causal; the
+    # kv-side segment row IS the local row here
+    out_acc, lse_acc = block_fwd(k, v, seg, causal)
 
     def step_fn(carry, step):
-        out_acc, lse_acc, k_cur, v_cur = carry
+        out_acc, lse_acc, k_cur, v_cur, seg_cur = carry
         k_cur = _rotate(k_cur, axis_name)
         v_cur = _rotate(v_cur, axis_name)
+        seg_cur = _rotate(seg_cur, axis_name) if with_seg else seg_cur
         src = (my - step) % n  # owner of the chunk now resident
         if causal:
             # past chunks attend fully; future chunks contribute nothing
             out_i, lse_i = jax.lax.cond(
                 src < my,
-                lambda: block_fwd(k_cur, v_cur, False),
+                lambda: block_fwd(k_cur, v_cur, seg_cur, False),
                 lambda: (
                     jnp.zeros((b, sq, hq, d), jnp.float32),
                     jnp.full((b * hq, 1, sq), NEG_INF, jnp.float32),
                 ),
             )
         else:
-            out_i, lse_i = block_fwd(k_cur, v_cur, False)
+            out_i, lse_i = block_fwd(k_cur, v_cur, seg_cur, False)
         out_acc, lse_acc = _merge_partial(out_acc, lse_acc, out_i, lse_i)
-        return (out_acc, lse_acc, k_cur, v_cur), None
+        return (out_acc, lse_acc, k_cur, v_cur, seg_cur), None
 
     if n > 1:
-        (out_acc, lse_acc, _, _), _ = jax.lax.scan(
-            step_fn, (out_acc, lse_acc, k, v), jnp.arange(1, n)
+        (out_acc, lse_acc, _, _, _), _ = jax.lax.scan(
+            step_fn,
+            (out_acc, lse_acc, k, v, seg if with_seg else jnp.zeros((), jnp.int32)),
+            jnp.arange(1, n),
         )
     out = out_acc.astype(q.dtype)
-    return out, (q, k, v, out, lse_acc)
+    return out, (q, k, v, seg, out, lse_acc)
 
 
 def _ring_flash_bwd(
     axis_name, causal, scale, block_q, block_k, interpret, res, g
 ):
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     b, sq, hq, d = q.shape
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
+    with_seg = seg is not None
     dd = compute_dd(out, g)  # GLOBAL rowsum(dO*O) — not per-chunk
 
-    def block_bwd(k_blk, v_blk, blk_causal):
+    def block_bwd(k_blk, v_blk, seg_blk, blk_causal):
         # per-block P recomputed from the global lse → exact global grads
         # same bwd-block resolution (incl. tuning overrides) as the
         # single-device path, against the LOCAL per-shard lengths
@@ -227,21 +239,23 @@ def _ring_flash_bwd(
         return _flash_backward(
             q, k_blk, v_blk, dd, lse, g, blk_causal, scale, bwd_bq, bwd_bk,
             interpret, grads_f32=True,
+            segment_ids=seg, segment_ids_kv=seg_blk if with_seg else None,
         )
 
     # step 0: diagonal chunk; its dk/dv partials start the ring ride
-    dq_acc, dk_cur, dv_cur = block_bwd(k, v, causal)
+    dq_acc, dk_cur, dv_cur = block_bwd(k, v, seg, causal)
 
     def step_fn(carry, step):
-        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq_acc, k_cur, v_cur, seg_cur, dk_cur, dv_cur = carry
         k_cur = _rotate(k_cur, axis_name)
         v_cur = _rotate(v_cur, axis_name)
+        seg_cur = _rotate(seg_cur, axis_name) if with_seg else seg_cur
         dk_cur = _rotate(dk_cur, axis_name)
         dv_cur = _rotate(dv_cur, axis_name)
         src = (my - step) % n
 
         def compute():
-            dq_i, dk_i, dv_i = block_bwd(k_cur, v_cur, False)
+            dq_i, dk_i, dv_i = block_bwd(k_cur, v_cur, seg_cur, False)
             return dq_acc + dq_i, dk_cur + dk_i, dv_cur + dv_i
 
         if causal:
@@ -250,19 +264,24 @@ def _ring_flash_bwd(
             )
         else:
             dq_acc, dk_cur, dv_cur = compute()
-        return (dq_acc, k_cur, v_cur, dk_cur, dv_cur), None
+        return (dq_acc, k_cur, v_cur, seg_cur, dk_cur, dv_cur), None
 
     if n > 1:
-        (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            step_fn, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(1, n)
+        (dq_acc, _, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
+            step_fn,
+            (dq_acc, k, v, seg if with_seg else jnp.zeros((), jnp.int32),
+             dk_cur, dv_cur),
+            jnp.arange(1, n),
         )
         # chunks have rotated n-1 times; one more brings dk/dv home
         dk_cur = _rotate(dk_cur, axis_name)
         dv_cur = _rotate(dv_cur, axis_name)
+    dseg = int_zero_cotangent(seg) if with_seg else None
     return (
         dq_acc.astype(q.dtype),
         dk_cur.astype(k.dtype),
         dv_cur.astype(v.dtype),
+        dseg,
     )
 
 
@@ -273,6 +292,7 @@ def ring_flash_attention_sharded(
     q: jax.Array,  # local [B, Sq_local, Hq, D]
     k: jax.Array,  # local [B, Sk_local, Hkv, D]
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,  # local [B, Sq_local]
     axis_name: str = "seq",
     causal: bool = True,
     scale: Optional[float] = None,
@@ -285,7 +305,10 @@ def ring_flash_attention_sharded(
     Causal masking assumes equal-size chunks laid out contiguously over
     the ring (chunk r holds global positions [r*S_local, (r+1)*S_local))
     with q and kv sharded identically, so the diagonal chunk is exactly
-    local causal self-attention.
+    local causal self-attention. ``segment_ids`` chunks (packed/padded
+    rows) rotate around the ring with their KV chunk; the kernels mask
+    q-side vs kv-side rows independently, so cross-document attention
+    is masked exactly as on a single device.
     """
     if q.shape[1] != k.shape[1]:
         raise ValueError(
@@ -296,7 +319,8 @@ def ring_flash_attention_sharded(
     # seq-dependent block defaults against the LOCAL shard length
     block_q, block_k = resolve_blocks(q.shape[1], block_q, block_k)
     return _ring_flash(
-        q, k, v, axis_name, causal, scale, block_q, block_k, interpret
+        q, k, v, segment_ids, axis_name, causal, scale, block_q, block_k,
+        interpret,
     )
 
 
@@ -352,9 +376,9 @@ def ring_attention(
 
     ``impl=None`` auto-selects the pallas-flash body on TPU when the
     local chunk is lane-aligned, the XLA einsum body otherwise.
-    ``segment_ids`` (packed/padded batches) run the XLA body — the
-    flash body's kernels share one segment row per device and cannot
-    mask against a rotated remote chunk.
+    ``segment_ids`` (packed/padded batches) work on both bodies: the
+    flash kernels take separate q-side/kv-side rows, so segment chunks
+    rotate around the ring with their KV chunk.
     """
     if impl is None:
         d = q.shape[-1]
@@ -362,17 +386,12 @@ def ring_attention(
         local = q.shape[1] // max(n, 1)
         flash_ok = (
             q.shape[1] == k.shape[1] and d % 128 == 0 and local % 128 == 0
-            and segment_ids is None
         )
         # the mesh's devices decide, not the default backend — they can
         # differ (e.g. a CPU mesh on a TPU-backed host in dryruns)
         on_tpu = mesh.devices.flat[0].platform == "tpu"
         impl = "flash" if (flash_ok and (on_tpu or interpret)) else "xla"
     if impl == "flash":
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "segment_ids needs impl='xla' for ring attention"
-            )
         body = partial(
             ring_flash_attention_sharded, axis_name=axis_name, causal=causal,
             scale=scale, interpret=interpret,

@@ -353,9 +353,10 @@ class ContinuousBatchingEngine:
         self._done: Dict[int, Request] = {}
         self._rid = itertools.count()
         self._closed = False
-        # guards the closed-flag check-then-enqueue in submit() against
-        # a concurrent close() (submit is documented thread-safe)
-        self._close_lock = threading.Lock()
+        # guards the cross-thread mutations: submit()'s closed-check +
+        # enqueue vs close(), and the _done insert vs pop_finished()'s
+        # swap (submit/pop_finished are documented thread-safe)
+        self._lock = threading.Lock()
         # Dispatched chunks flow pump -> _fetchq -> harvester threads
         # (which own the ONLY blocking device→host transfers) ->
         # _readyq -> pump attribution, re-ordered by sequence number.
@@ -411,7 +412,7 @@ class ContinuousBatchingEngine:
         # arrival thread): after close() the harvesters are gone, so a
         # request slipping past an unsynchronized check would enqueue
         # onto a dead engine and its caller would wait forever
-        with self._close_lock:
+        with self._lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
             self._reqs[req.rid] = req
@@ -530,7 +531,11 @@ class ContinuousBatchingEngine:
             if not active_out[slot]:
                 req.done = True
                 req.finished_at = time.perf_counter()
-                self._done[rid] = self._reqs.pop(rid)
+                # the insert must be atomic vs pop_finished()'s swap
+                # (front-end threads poll it): an unsynchronized write
+                # could land in a just-orphaned dict and be lost forever
+                with self._lock:
+                    self._done[rid] = self._reqs.pop(rid)
                 if self._slot_req[slot] is req:
                     self._slot_req[slot] = None
                     self._active_h[slot] = False
@@ -559,8 +564,9 @@ class ContinuousBatchingEngine:
         """Drain and return every finished-but-uncollected request.
         Callers driving :meth:`step` directly (a server front-end)
         poll this between rounds; once popped, the engine retains no
-        reference to the request."""
-        done, self._done = self._done, {}
+        reference to the request. Thread-safe vs the pump's inserts."""
+        with self._lock:
+            done, self._done = self._done, {}
         return done
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -578,7 +584,7 @@ class ContinuousBatchingEngine:
         raise. Also runs from ``__del__``: since the threads hold only
         the queues, an abandoned engine is collectible, and collection
         shuts its workers down."""
-        with self._close_lock:
+        with self._lock:
             self._closed = True
         for _ in self._harvesters:
             self._fetchq.put(None)
