@@ -66,30 +66,53 @@ def _stage_body(
 
 def pipeline_apply(
     fn: Callable[[Any, jax.Array], jax.Array],
-    stacked_params: Any,  # leaves [n_stages, ...], sharded on "stage"
+    stacked_params: Any,  # leaves [n_stages*, ...], sharded on "stage"
     x: jax.Array,  # [batch, ...] global
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "stage",
     batch_axes=("data", "fsdp"),
+    param_specs: Any = None,
+    peel_stage_axis: bool = True,
 ) -> jax.Array:
     """Run ``fn`` as a pipeline: ``fn(stage_params, x) -> y`` must be
     shape-preserving across stages (classic transformer-block stack).
     Returns fn's output for the full batch, microbatched through the
-    stages."""
+    stages.
+
+    ``param_specs`` (default: every leaf ``P(axis_name)``) is a pytree
+    of PartitionSpecs matching ``stacked_params`` whose FIRST entry
+    must shard the leading (layer) axis over ``axis_name``; extra
+    entries carry through other axes (e.g. ``fsdp``-sharded embed dims
+    for the manual-FSDP composition — the stage body all-gathers those
+    per layer and the transpose becomes a reduce-scatter, i.e. ZeRO-3).
+
+    ``peel_stage_axis=True`` is the one-layer-per-stage contract
+    (leaves ``[n_stages, ...]``, fn sees one layer's params);
+    ``False`` hands fn the full local ``[layers_per_stage, ...]`` slab
+    to scan over itself (the transformer-stack case)."""
     from jax import shard_map
 
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
-    assert b % num_microbatches == 0, (b, num_microbatches)
+    dp = 1
+    for a in (batch_axes if isinstance(batch_axes, (tuple, list)) else (batch_axes,)):
+        dp *= mesh.shape[a]
+    if b % dp or (b // dp) % num_microbatches:
+        raise ValueError(
+            f"global batch {b} must split into {dp} data shards x "
+            f"{num_microbatches} microbatches"
+        )
 
-    param_specs = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stacked_params
-    )
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params
+        )
     x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
 
     def body(params, xs):
-        params = jax.tree_util.tree_map(lambda p: p[0], params)  # peel stage dim
+        if peel_stage_axis:
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
         mbs = xs.reshape(num_microbatches, -1, *xs.shape[1:])
         out = _stage_body(params, mbs, fn, axis_name)
         return out.reshape(-1, *out.shape[2:])

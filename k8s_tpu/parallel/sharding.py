@@ -58,6 +58,24 @@ class LogicalRules:
         ("vocab", "tensor"),
         ("length", "seq"),
     )
+    # Pipeline parallelism: the scan-stacked layer axis ("layers", the
+    # flax PARTITION_NAME of the block scan) shards over `stage`, so
+    # each pipeline stage holds a contiguous [L/S, ...] slab of layer
+    # params — exactly the shard_map in_spec the GPipe schedule wants
+    # (k8s_tpu.parallel.pipeline). PP_FSDP additionally fsdp-shards the
+    # embed dims; the stage body all-gathers them per layer (manual
+    # ZeRO-3 — XLA can't insert those collectives inside shard_map).
+    PP = (
+        ("batch", ("data", "fsdp")),
+        ("layers", "stage"),
+        ("length", None),
+    )
+    PP_FSDP = (
+        ("batch", ("data", "fsdp")),
+        ("layers", "stage"),
+        ("embed", "fsdp"),
+        ("length", None),
+    )
     MOE = (
         ("batch", ("data", "fsdp")),
         ("embed", "fsdp"),

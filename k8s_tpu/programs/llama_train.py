@@ -1,9 +1,14 @@
-"""Llama causal-LM training with FSDP(+TP/SP) — benchmark config #5
-(Llama-3-8B, multi-slice v5p-128 over DCN) with checkpoint/resume.
+"""Llama causal-LM training with FSDP(+TP/SP) or pipeline parallelism
+— benchmark config #5 (Llama-3-8B, multi-slice v5p-128 over DCN) with
+checkpoint/resume.
 
-Strategy selection via ``--strategy=`` (dp|fsdp|fsdp_tp|fsdp_tp_sp);
-multi-slice jobs put ``data`` across slices (gradient-sync over DCN)
-and fsdp/tensor/seq inside the slice (ICI), per the megascale recipe.
+Strategy selection via ``--strategy=``
+(dp|fsdp|fsdp_tp|fsdp_tp_sp|pp|pp_fsdp); multi-slice jobs put ``data``
+across slices (gradient-sync over DCN) and fsdp/tensor/seq/stage
+inside the slice (ICI), per the megascale recipe. The pp strategies
+run the block stack through the GPipe schedule
+(``train/pipeline_llama.py``; ``--stages``/``--microbatches`` knobs)
+with the same state/checkpoint layout as every other strategy.
 """
 
 from __future__ import annotations
@@ -37,10 +42,12 @@ STRATEGIES = {
     "fsdp": "FSDP",
     "fsdp_tp": "FSDP_TP",
     "fsdp_tp_sp": "FSDP_TP_SP",
+    "pp": "PP",
+    "pp_fsdp": "PP_FSDP",
 }
 
 
-def _mesh_for(strategy: str, n: int, num_slices: int):
+def _mesh_for(strategy: str, n: int, num_slices: int, stages: int = 2):
     if strategy == "dp":
         return build_mesh(MeshConfig(data=n))
     per_slice = max(1, n // num_slices)
@@ -60,6 +67,15 @@ def _mesh_for(strategy: str, n: int, num_slices: int):
                 seq=seq, tensor=tensor,
             )
         )
+    if strategy == "pp":
+        # stages inside a slice (activation ppermutes ride ICI), data
+        # absorbs the rest (gradient sync over DCN for multi-slice)
+        return build_mesh(MeshConfig(data=-1, stage=stages))
+    if strategy == "pp_fsdp":
+        fsdp = max(1, per_slice // stages)
+        return build_mesh(
+            MeshConfig(data=num_slices, fsdp=fsdp, stage=stages)
+        )
     raise ValueError(f"unknown strategy {strategy}")
 
 
@@ -72,7 +88,9 @@ def main(rdzv) -> None:
     n = len(jax.devices())
     num_slices = max(1, rdzv.num_slices)
 
-    mesh = _mesh_for(strategy, n, num_slices)
+    pp = strategy.startswith("pp")
+    stages = int(extra.get("stages", "2"))
+    mesh = _mesh_for(strategy, n, num_slices, stages=stages)
     if rdzv.process_id <= 0:
         # machine-readable proof the MEGASCALE env shaped the mesh
         # (multi-slice e2e asserts data axis == num_slices)
@@ -84,7 +102,15 @@ def main(rdzv) -> None:
         lcfg = LlamaConfig.llama3_8b(attention=attention, mesh=mesh)
     else:
         lcfg = LlamaConfig.tiny(
-            attention=attention, mesh=mesh, num_heads=8, num_kv_heads=4, head_dim=16
+            attention=attention, mesh=mesh, num_heads=8, num_kv_heads=4,
+            head_dim=16,
+            # --layers: e2e knob (e.g. 4 layers over 4 pipeline stages)
+            num_layers=int(extra.get("layers", "2")),
+        )
+    if pp and lcfg.num_layers % mesh.shape["stage"]:
+        raise ValueError(
+            f"{lcfg.num_layers} layers not divisible by "
+            f"{mesh.shape['stage']} pipeline stages"
         )
     model = LlamaForCausalLM(lcfg)
     data = synthetic_token_batches(cfg.batch_size, seq_len, lcfg.vocab_size)
@@ -113,7 +139,27 @@ def main(rdzv) -> None:
     # either way — see fused_lm_head_cross_entropy(compute_dtype=...).
     fused_ce = extra.get("fused_ce", "1") not in ("0", "false")
 
+    if pp:
+        # GPipe over the stage axis: same state/checkpoint layout, the
+        # loss routes the block stack through the pipeline (always the
+        # fused-CE head — pp hidden states ARE the fused-CE contract)
+        if not fused_ce:
+            raise ValueError(
+                "--fused_ce=0 is not supported with --strategy=pp*: the "
+                "pipelined forward returns hidden states and the head is "
+                "fused into the loss"
+            )
+        from k8s_tpu.train import make_pp_llama_loss
+
+        microbatches = int(extra.get("microbatches", "2"))
+        pp_loss, _ = make_pp_llama_loss(
+            model, mesh, rules, jnp.zeros((cfg.batch_size, seq_len), jnp.int32),
+            num_microbatches=microbatches, z_loss=1e-4,
+        )
+
     def loss_fn(state, params, b, rng):
+        if pp:
+            return pp_loss(state, params, b, rng)
         # mutable intermediates: MoE layers sow their router
         # load-balancing loss there — without adding it to the training
         # loss the router collapses onto a few experts

@@ -2,6 +2,11 @@
 metrics, checkpointing.
 """
 
+from k8s_tpu.train.pipeline_llama import (  # noqa: F401
+    block_param_specs,
+    make_pp_llama_apply,
+    make_pp_llama_loss,
+)
 from k8s_tpu.train.trainer_lib import (  # noqa: F401
     TrainStepFn,
     create_sharded_state,

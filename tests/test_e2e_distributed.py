@@ -127,6 +127,30 @@ def test_distributed_ring_attention_job(tmp_path):
     assert '"step": 2' in log0, log0
 
 
+@pytest.mark.integration
+def test_distributed_pipeline_llama_job(tmp_path):
+    """Pipeline parallelism across REAL processes: --strategy=pp with
+    stages=4 spans the GPipe axis over ALL four devices of the
+    2-process × 2-device mesh, so microbatch activations MUST ppermute
+    stage→stage over the process boundary (loopback here; ICI at
+    scale) — the PP row at the same cross-process evidence standard as
+    FSDP/ring. (stages=2 would sit inside one process: the stage axis
+    is minor to `data` in the mesh's device order.)"""
+    _, log0, _ = _run_two_worker_job(
+        tmp_path, "pipeline",
+        extra_env={
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=2 --batch_size=8 --log_every=1 "
+                "--strategy=pp --seq_len=32 --stages=4 --layers=4 "
+                "--microbatches=2"
+            ),
+        },
+    )
+    assert '"run": "llama-tiny-pp"' in log0, log0
+    assert '"step": 2' in log0, log0
+
+
 def _read_worker_log(tmp_path, rid, idx, name):
     import glob
 

@@ -153,5 +153,103 @@ class TestPipeline:
         mesh = build_mesh(MeshConfig(data=2, stage=4))
         params = self._setup(4)
         x = jnp.zeros((10, 16))
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError, match="data shards"):
             pipeline_apply(self._fn, params, x, mesh, num_microbatches=4)
+
+
+class TestPipelineLlama:
+    """Pipeline parallelism on the REAL model path (VERDICT r3 item 2):
+    the GPipe schedule over the scan-stacked Llama block params, at the
+    same evidence standard as the FSDP/ring rows — forward parity
+    against the plain model and loss decreasing through the standard
+    train step."""
+
+    def _setup(self, rules_name, mesh_cfg):
+        import optax
+
+        from k8s_tpu.train import create_sharded_state, make_pp_llama_loss
+
+        mesh = build_mesh(mesh_cfg)
+        rules = LogicalRules(getattr(LogicalRules, rules_name))
+        cfg = LlamaConfig.tiny(num_layers=4, dtype=jnp.float32, remat=False)
+        model = LlamaForCausalLM(cfg)
+        ids0 = jnp.zeros((8, 32), jnp.int32)
+        state = create_sharded_state(
+            model, optax.adamw(1e-3), mesh, rules,
+            jax.random.PRNGKey(0), ids0,
+        )
+        loss_fn, apply_fn = make_pp_llama_loss(
+            model, mesh, rules, ids0, num_microbatches=2
+        )
+        return mesh, rules, cfg, model, state, loss_fn, apply_fn
+
+    def test_pp_forward_matches_plain_model(self):
+        """Pipelined hidden states == the plain scan forward with the
+        SAME param tree (no param surgery): bit-exact without fsdp."""
+        import flax.linen as nn
+
+        mesh, rules, cfg, model, state, _, apply_fn = self._setup(
+            "PP", MeshConfig(data=2, stage=4))
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        with nn.logical_axis_rules(rules.to_flax()):
+            h_pp = jax.jit(apply_fn)(state.params, ids)
+        h_ref = model.apply({"params": state.params}, ids,
+                            return_hidden=True)
+        np.testing.assert_array_equal(np.asarray(h_pp), np.asarray(h_ref))
+
+    def test_pp_fsdp_composes(self):
+        """PP x FSDP: block params sharded ('stage', 'fsdp'), manual
+        per-layer all-gather inside the stage body — forward matches
+        the plain model at float-associativity tolerance and the
+        sharding really is 2-axis."""
+        import flax.linen as nn
+
+        mesh, rules, cfg, model, state, _, apply_fn = self._setup(
+            "PP_FSDP", MeshConfig(data=1, fsdp=2, stage=4))
+        k = state.params["layers"]["block"]["mlp"]["gate_proj"]["kernel"]
+        assert "stage" in str(k.sharding.spec) and "fsdp" in str(
+            k.sharding.spec), k.sharding.spec
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        with nn.logical_axis_rules(rules.to_flax()):
+            h_pp = jax.jit(apply_fn)(state.params, ids)
+        h_ref = model.apply({"params": state.params}, ids,
+                            return_hidden=True)
+        np.testing.assert_allclose(
+            np.asarray(h_pp), np.asarray(h_ref), atol=2e-5)
+
+    def test_pp_trains_loss_decreases(self):
+        from k8s_tpu.train import make_train_step
+
+        mesh, rules, cfg, model, state, loss_fn, _ = self._setup(
+            "PP_FSDP", MeshConfig(data=1, fsdp=2, stage=4))
+        step = make_train_step(loss_fn, mesh, rules)
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        losses = []
+        for _ in range(4):
+            state, m = step(state, {"input_ids": ids}, jax.random.PRNGKey(2))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_pp_gates(self):
+        """MoE / non-flash attention / indivisible layer counts are
+        refused loudly (they would nest shard_maps or shard unevenly)."""
+        from k8s_tpu.train import make_pp_llama_apply
+
+        mesh = build_mesh(MeshConfig(data=2, stage=4))
+        with pytest.raises(ValueError, match="MoE"):
+            make_pp_llama_apply(
+                LlamaConfig.tiny(num_layers=4, num_experts=2), mesh, 2, None)
+        with pytest.raises(ValueError, match="flash"):
+            make_pp_llama_apply(
+                LlamaConfig.tiny(num_layers=4, attention="ring"),
+                mesh, 2, None)
+        with pytest.raises(ValueError, match="divisible"):
+            make_pp_llama_apply(
+                LlamaConfig.tiny(num_layers=6), mesh, 2, None)
+        with pytest.raises(ValueError, match="scan_layers"):
+            make_pp_llama_apply(
+                LlamaConfig.tiny(num_layers=4, scan_layers=False),
+                mesh, 2, None)

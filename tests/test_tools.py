@@ -560,6 +560,20 @@ class TestPrograms:
         llama_train.main(r)
         assert "llama-tiny-fsdp_tp_sp" in capsys.readouterr().out
 
+    def test_llama_program_pp_fsdp(self, capsys):
+        """--strategy=pp_fsdp drives the GPipe-over-stages path through
+        the program entry (stage-sharded blocks + fsdp all-gathers)."""
+        from k8s_tpu.programs import llama_train
+
+        r = self.FakeRdzv()
+        r.program_args = (
+            "--steps=2 --batch_size=8 --log_every=1 "
+            "--strategy=pp_fsdp --model=tiny --seq_len=32 "
+            "--stages=2 --microbatches=2"
+        )
+        llama_train.main(r)
+        assert "llama-tiny-pp_fsdp" in capsys.readouterr().out
+
     def test_llama_checkpoint_resume(self, tmp_path, capsys):
         from k8s_tpu.programs import llama_train
 
