@@ -120,6 +120,18 @@ def main(argv=None) -> int:
              "--junit-path", f"{args.artifacts_dir}/junit_e2e.xml"],
             args.artifacts_dir, cases,
         )
+        # AOT-compile the real north-star configs (BERT v5p-64,
+        # Llama-3-8B v5p-128) against virtual TPU topologies: proves
+        # the production sharded HLO compiles and fits HBM without
+        # hardware (~5 min; skipped with the slow tests)
+        if not args.skip_slow:
+            ok = ok and stage(
+                "aot-northstar",
+                [py, "-m", "k8s_tpu.tools.aot_check", "--all",
+                 "--skip-if-unsupported",
+                 "--json", f"{args.artifacts_dir}/aot_northstar.json"],
+                args.artifacts_dir, cases,
+            )
         if args.with_bench and ok:
             ok = stage("bench", [py, "bench.py"], args.artifacts_dir, cases)
 

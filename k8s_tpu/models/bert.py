@@ -54,6 +54,12 @@ class BertConfig:
     # matmuls (one wider MXU dispatch). Changes the checkpoint layout —
     # opt-in, like Llama's fuse_params_for_decode.
     fused_qkv: bool = False
+    # multi-device mesh: like LlamaConfig.mesh — when set (size > 1),
+    # attention runs through the shard_map-wrapped flash kernel
+    # (Mosaic can't be auto-partitioned by GSPMD)
+    mesh: "object | None" = dataclasses.field(
+        default=None, hash=False, compare=False
+    )
 
     @property
     def head_dim(self) -> int:
@@ -119,7 +125,17 @@ class BertLayer(nn.Module):
         # padding mask rides the kernel's segment-id masking (1=real,
         # 0=pad): pad keys are invisible; pad-query outputs are garbage
         # and the MLM loss mask is expected to drop them
-        attn = flash_attention(q, k, v, causal=False, segment_ids=attention_mask)
+        if cfg.mesh is not None and getattr(cfg.mesh, "size", 1) > 1:
+            from k8s_tpu.ops.attention import flash_attention_sharded
+
+            attn = flash_attention_sharded(
+                q, k, v, cfg.mesh, causal=False,
+                segment_ids=attention_mask,
+            )
+        else:
+            attn = flash_attention(
+                q, k, v, causal=False, segment_ids=attention_mask
+            )
         attn = _dense(cfg.hidden_size, ("heads", "head_dim", "embed"),
                       "o_proj", cfg.dtype, axis=(-2, -1), quant=cfg.quant)(attn)
         x = ln1(x + attn)

@@ -470,6 +470,15 @@ class LlamaAttention(nn.Module):
             out = ulysses_attention(
                 q, k, v, cfg.mesh, causal=True, segment_ids=segment_ids
             )
+        elif cfg.mesh is not None and getattr(cfg.mesh, "size", 1) > 1:
+            # multi-device flash: the pallas kernel is per-device —
+            # GSPMD can't partition Mosaic, so batch/heads shard via an
+            # explicit shard_map (ops/attention.py)
+            from k8s_tpu.ops.attention import flash_attention_sharded
+
+            out = flash_attention_sharded(
+                q, k, v, cfg.mesh, causal=True, segment_ids=segment_ids
+            )
         else:
             out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
         if cfg.quant == "int8_serving":

@@ -759,13 +759,77 @@ def flash_attention(
             )
         return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
     if use_pallas is None:
-        platform = jax.devices()[0].platform
+        # KTPU_AOT_TPU: deviceless AOT compiles (tools/aot_check.py)
+        # target a virtual TPU topology while the default backend is
+        # CPU — the gate must pick the kernel the TPU run would use,
+        # or the lowering silently swaps in the S^2 XLA path and the
+        # memory analysis measures the wrong program
+        platform = (
+            "tpu" if os.environ.get("KTPU_AOT_TPU")
+            else jax.devices()[0].platform
+        )
         use_pallas = platform == "tpu" and shapes_ok
     elif use_pallas and not shapes_ok:
         use_pallas = False  # unsupported tiling → XLA path
     if not use_pallas:
         return mha_reference(q, k, v, causal, scale, segment_ids=segment_ids)
     return _flash(q, k, v, segment_ids, causal, scale, bq, bk, interpret)
+
+
+def flash_attention_sharded(
+    q: jax.Array,  # global [B, S, Hq, D]
+    k: jax.Array,  # global [B, S, Hkv, D]
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-device flash attention: batch shards over data/fsdp and
+    heads over tensor via an explicit ``shard_map``; each device runs
+    the per-device :func:`flash_attention` body on its local block.
+
+    Required because Mosaic kernels cannot be auto-partitioned by
+    GSPMD — a plain pallas call under a multi-device jit fails to
+    lower (caught by the v5p AOT compile of the real BERT/Llama
+    configs, tools/aot_check.py; invisible on CPU dryruns, whose XLA
+    fallback partitions fine, and on single-chip benches, which have
+    nothing to partition). Sequence stays unsharded — the ``seq`` axis
+    belongs to ring/Ulysses attention.
+
+    GQA divisibility over ``head_axis`` follows the param shardings
+    (heads AND kv_heads both cut by tensor), so local group structure
+    is preserved.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axes, None, head_axis, None)
+    seg_spec = P(batch_axes, None)
+    with_seg = segment_ids is not None
+
+    def body(q, k, v, *rest):
+        seg = rest[0] if with_seg else None
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=seg,
+            block_q=block_q, block_k=block_k, use_pallas=use_pallas,
+            interpret=interpret,
+        )
+
+    in_specs = (spec, spec, spec) + ((seg_spec,) if with_seg else ())
+    wrapped = shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=spec,
+        check_vma=False,
+    )
+    if with_seg:
+        return wrapped(q, k, v, segment_ids.astype(jnp.int32))
+    return wrapped(q, k, v)
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,6 @@ import optax
 from k8s_tpu.models import BertConfig, BertForPretraining
 from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
-from k8s_tpu.parallel.mesh import best_pow2_split
 from k8s_tpu.programs.common import (
     MetricLogger,
     mark_preempt_aware,
@@ -30,15 +29,37 @@ from k8s_tpu.programs.common import (
 from k8s_tpu.train import create_sharded_state, cross_entropy_loss, make_train_step
 
 
+def tp_layout(n: int, bcfg, cap: int = 8):
+    """(tensor, data, rules) with the TP degree constrained by what the
+    MODEL can actually shard: heads and mlp must divide (BERT-base has
+    12 heads — 8-way TP is impossible, a blind pow2 split would fail at
+    state-init on real hardware; caught by tools/aot_check.py). The
+    vocab row is dropped from the rules when the tokenizer's vocab
+    (30522 = 2·3·5087) doesn't divide — the mlm head replicates, which
+    at 23M params is cheaper than Megatron-style vocab padding."""
+    t = 1
+    while (t * 2 <= cap and n % (t * 2) == 0
+           and bcfg.num_heads % (t * 2) == 0
+           and bcfg.intermediate_size % (t * 2) == 0):
+        t *= 2
+    rules = list(LogicalRules.TP)
+    if bcfg.vocab_size % t:
+        rules = [("vocab", None) if k == "vocab" else (k, v)
+                 for k, v in rules]
+    return t, n // t, LogicalRules(tuple(rules))
+
+
 def main(rdzv) -> None:
     cfg = parse_run_config(rdzv, {"steps": 50, "batch_size": 32})
     extra = cfg.extra or {}
     tiny = extra.get("tiny") == "1"
     n = len(jax.devices())
-    tensor, data = best_pow2_split(n, max_first=4 if tiny else 8)
-    mesh = build_mesh(MeshConfig(data=data, tensor=tensor))
-    rules = LogicalRules(LogicalRules.TP)
     bcfg = BertConfig.tiny() if tiny else BertConfig.base()
+    tensor, data, rules = tp_layout(n, bcfg, cap=4 if tiny else 8)
+    mesh = build_mesh(MeshConfig(data=data, tensor=tensor))
+    import dataclasses as _dc
+
+    bcfg = _dc.replace(bcfg, mesh=mesh)  # shard_map-wrapped flash attn
     model = BertForPretraining(bcfg)
     seq = bcfg.max_seq_len if not tiny else 64
     n_pred = max(8, int(seq * 0.15 + 7) // 8 * 8)

@@ -124,7 +124,12 @@ def make_pp_llama_apply(
         raise ValueError(
             f"{cfg.num_layers} layers not divisible by {n_stages} stages"
         )
-    block = LlamaBlock(cfg)
+    # the block runs INSIDE the pipeline's shard_map: a mesh on the
+    # config would route attention through flash_attention_sharded and
+    # nest shard_maps — strip it so the per-device kernel is used
+    import dataclasses as _dc
+
+    block = LlamaBlock(_dc.replace(cfg, mesh=None))
 
     def stage_fn(stage_params, x):
         # [layers_per_stage, ...] slab; constraints inside shard_map

@@ -322,6 +322,10 @@ def make_train_step(
         with nn.logical_axis_rules(rules.to_flax()):
             return jitted(state, shard_batch(batch), rng)
 
+    # the raw jitted step, exposed for AOT lowering against virtual
+    # topologies (tools/aot_check.py): .lower(abstract_state,
+    # abstract_batch, abstract_rng) under the caller's rules context
+    run.jitted = jitted
     return run
 
 
