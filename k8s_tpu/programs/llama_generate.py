@@ -46,62 +46,52 @@ def _tp_degree(n_devices: int, num_kv_heads: int) -> int:
     return t
 
 
-def main(rdzv) -> None:
-    cfg = parse_run_config(rdzv, {"steps": 3, "batch_size": 8})
-    extra = cfg.extra or {}
-    model_name = extra.get("model", "tiny")
-    prompt_len = int(extra.get("prompt_len", "32"))
-    new_tokens = int(extra.get("new_tokens", "64"))
-    temperature = float(extra.get("temperature", "0"))
-
-    import dataclasses
-
+def decode_model_config(model_name: str, max_seq: int, extra: dict,
+                        ragged: bool = False) -> "LlamaConfig":
+    """Decode-mode LlamaConfig from program args — shared between batch
+    generation (this program) and the continuous-batching server
+    (programs/serving.py). ``ragged=True`` enables per-row cache depths
+    (the engine's slot contract)."""
     # serve with the layer loop UNROLLED: the scanned stacked cache
     # carry costs full-cache copies + per-layer slab DS/DUS every step
     # (56% -> 75% of the decode bandwidth roofline when unrolled;
     # docs/BENCHMARKS.md). unroll_layers=0 opts back into scan.
     unroll = extra.get("unroll_layers", "1") not in ("0", "false")
     kv_quant = extra.get("kv_quant", "none")  # "int8": int8 KV cache
-    max_seq = prompt_len + new_tokens
+    common = dict(decode=True, scan_layers=not unroll, kv_quant=kv_quant,
+                  ragged_decode=ragged)
     if model_name == "llama3-8b":
-        lcfg = LlamaConfig.llama3_8b(decode=True, remat=False,
-                                     max_seq_len=max_seq,
-                                     scan_layers=not unroll,
-                                     kv_quant=kv_quant)
-    else:
-        # same head layout as llama_train's tiny config, so trainer
-        # checkpoints restore into the decode model
-        lcfg = LlamaConfig.tiny(
-            decode=True, max_seq_len=max(max_seq, 128),
-            num_heads=8, num_kv_heads=4, head_dim=16,
-            scan_layers=not unroll, kv_quant=kv_quant,
-        )
+        return LlamaConfig.llama3_8b(remat=False, max_seq_len=max_seq,
+                                     **common)
+    # same head layout as llama_train's tiny config, so trainer
+    # checkpoints restore into the decode model
+    return LlamaConfig.tiny(
+        max_seq_len=max(max_seq, 128), num_heads=8, num_kv_heads=4,
+        head_dim=16, **common,
+    )
+
+
+def load_decode_params(lcfg, mesh, rules, checkpoint_dir, example_ids,
+                       quant: str = ""):
+    """Restore-or-init SHARDED decode params: trained checkpoints are
+    scan-stacked, so restore goes through a scanned twin and unrolls
+    when the serving config is unrolled; weights are cast bf16 (decode
+    re-reads every weight each step — f32 masters double the bandwidth-
+    bound step time) and optionally int8-quantized. Returns
+    ``(params, lcfg)`` — lcfg updated when quantization changes it."""
+    import dataclasses
+
+    import flax.linen as nn
+
     # checkpoints are stacked (trained with scan_layers=True): restore
     # through a scanned twin, then unroll for serving
     restore_cfg = dataclasses.replace(lcfg, scan_layers=True)
     restore_model = LlamaForCausalLM(restore_cfg)
-    model = LlamaForCausalLM(lcfg)
-
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (cfg.batch_size, prompt_len), 0,
-        lcfg.vocab_size,
-    )
-    import flax.linen as nn
-
-    # weights live distributed over a TP mesh (never materialized on
-    # one device — load-bearing at 8B scale)
-    n = len(jax.devices())
-    mesh = build_mesh(
-        MeshConfig(tensor=_tp_degree(n, lcfg.num_kv_heads), data=-1)
-    )
-    rules = LogicalRules(LogicalRules.TP)
 
     def boxed_init():
-        # scanned layout: matches trained checkpoints; unrolled for
-        # serving after restore (unroll_params_for_decode)
-        return restore_model.init(jax.random.PRNGKey(0), prompt)
+        return restore_model.init(jax.random.PRNGKey(0), example_ids)
 
-    if cfg.checkpoint_dir:
+    if checkpoint_dir:
         from k8s_tpu.train.checkpoint import CheckpointManager
 
         # restore path: no random init runs at all — an eval_shape
@@ -115,7 +105,7 @@ def main(rdzv) -> None:
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
             abstract, shardings,
         )
-        mgr = CheckpointManager(cfg.checkpoint_dir)
+        mgr = CheckpointManager(checkpoint_dir)
         try:
             params = mgr.restore_params(template)
         finally:
@@ -124,34 +114,59 @@ def main(rdzv) -> None:
             # an inference job pointed at an empty/missing checkpoint
             # must FAIL, not silently serve random weights
             raise FileNotFoundError(
-                f"no checkpoint found under {cfg.checkpoint_dir}"
+                f"no checkpoint found under {checkpoint_dir}"
             )
     else:
         from k8s_tpu.train.trainer_lib import init_sharded_variables
 
         variables, _ = init_sharded_variables(boxed_init, mesh, rules)
         params = variables["params"]
-    # serve bf16: decode re-reads every weight each step, f32 masters
-    # would double the bandwidth-bound step time
     params = jax.tree_util.tree_map(
         lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
         params,
     )
-    if unroll:
+    if not lcfg.scan_layers:
         from k8s_tpu.models import unroll_params_for_decode
 
         params = unroll_params_for_decode(params, lcfg.num_layers)
-
-    if extra.get("quant") == "int8_serving":
+    if quant == "int8_serving":
         from k8s_tpu.ops.quant import quantize_params_for_serving
 
         # weight-only int8: kernels stored 1 B/param (+29% decode
         # measured, docs/BENCHMARKS.md); numerics change — validate
         # output quality per deployment
         params = quantize_params_for_serving(params)
-        model = LlamaForCausalLM(
-            dataclasses.replace(lcfg, quant="int8_serving")
-        )
+        lcfg = dataclasses.replace(lcfg, quant="int8_serving")
+    return params, lcfg
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 3, "batch_size": 8})
+    extra = cfg.extra or {}
+    model_name = extra.get("model", "tiny")
+    prompt_len = int(extra.get("prompt_len", "32"))
+    new_tokens = int(extra.get("new_tokens", "64"))
+    temperature = float(extra.get("temperature", "0"))
+
+    max_seq = prompt_len + new_tokens
+    lcfg = decode_model_config(model_name, max_seq, extra)
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch_size, prompt_len), 0,
+        lcfg.vocab_size,
+    )
+    # weights live distributed over a TP mesh (never materialized on
+    # one device — load-bearing at 8B scale)
+    n = len(jax.devices())
+    mesh = build_mesh(
+        MeshConfig(tensor=_tp_degree(n, lcfg.num_kv_heads), data=-1)
+    )
+    rules = LogicalRules(LogicalRules.TP)
+    params, lcfg = load_decode_params(
+        lcfg, mesh, rules, cfg.checkpoint_dir, prompt,
+        quant=extra.get("quant", ""),
+    )
+    model = LlamaForCausalLM(lcfg)
 
     # warm round compiles prefill + decode loop (cached across rounds);
     # the logger starts AFTER it so step 1's rate excludes compile time

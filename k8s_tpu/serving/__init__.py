@@ -8,5 +8,6 @@ depths, and `LlamaConfig(ragged_decode=True)`.
 """
 
 from k8s_tpu.serving.engine import ContinuousBatchingEngine, Request
+from k8s_tpu.serving.server import ServingFrontend
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "Request", "ServingFrontend"]

@@ -1,0 +1,217 @@
+"""HTTP front-end for the continuous-batching engine — the piece that
+makes serving an OPERATOR WORKLOAD instead of a library.
+
+The reference operator's defining contract is that it *runs* the
+workload (``/root/reference/pkg/trainer/replicas.go:216-268`` builds a
+Service + Job per replica and the training process inside); until round
+5 the serving engine could only be driven in-process. This module gives
+it a deployable surface: ``programs/serving.py`` runs a
+:class:`ServingFrontend` under the SPMD launcher, so a TpuJob manifest
+(`examples/`) serves traffic through the same lifecycle — create →
+Running → (delete ⇒ SIGTERM ⇒ drain) — as every training job.
+
+Split of responsibilities, single-threaded where it matters:
+
+- HTTP handler threads (stdlib ``ThreadingHTTPServer``) only call
+  ``engine.submit`` (documented thread-safe) and wait on a per-request
+  event. They never touch scheduling state.
+- The PUMP runs in the caller's thread (:meth:`serve`): it alone calls
+  ``engine.step``/``pop_finished`` — the engine's single-threaded
+  scheduling contract — and resolves waiter events as requests finish.
+- Drain: on SIGTERM (job delete / TPU maintenance) the front-end stops
+  accepting (503s new requests), pumps until every in-flight request
+  finished, releases any stragglers, and closes the engine. In-flight
+  work is never dropped while the kubelet grace period allows.
+
+API (JSON over HTTP, stdlib only — this rides in the same ConfigMap-
+shipped image as the launcher):
+
+- ``POST /v1/generate`` ``{"prompt": [int, ...], "max_new_tokens": N}``
+  → ``{"rid": n, "tokens": [int, ...], "latency_s": s}`` (blocks until
+  the request finishes; token-id interface — tokenization is the
+  caller's, same contract as :func:`k8s_tpu.models.llama.generate`).
+- ``GET /healthz`` → engine stats + in-flight counts (the operator's
+  ``--health-port`` idiom, per-pod).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServingFrontend:
+    """Bind an HTTP server to ``engine``; :meth:`serve` pumps until
+    ``should_stop()`` goes true, then drains. ``port=0`` binds an
+    ephemeral port (read :attr:`port` after construction — the program
+    prints it as a machine-readable event for clients/tests)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout: float = 300.0):
+        self.engine = engine
+        self.request_timeout = float(request_timeout)
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, threading.Event] = {}
+        self._results: Dict[int, object] = {}
+        self._work = threading.Event()   # poked by submissions
+        self._draining = False
+        self.served = 0                  # completed requests, lifetime
+
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # the pod log is the operator's observability surface —
+            # default per-request stderr lines would swamp it
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib naming
+                if self.path != "/healthz":
+                    return self._json(404, {"error": "not found"})
+                with frontend._lock:
+                    in_flight = len(frontend._waiters)
+                return self._json(200, {
+                    "ok": not frontend._draining,
+                    "draining": frontend._draining,
+                    "in_flight": in_flight,
+                    "served": frontend.served,
+                    "stats": {k: round(v, 4) if isinstance(v, float) else v
+                              for k, v in frontend.engine.stats.items()},
+                })
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/generate":
+                    return self._json(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    prompt = np.asarray(req["prompt"], np.int32)
+                    max_new = int(req.get("max_new_tokens", 16))
+                except Exception as e:  # malformed request → caller's 400
+                    return self._json(400, {"error": f"bad request: {e}"})
+                t0 = time.perf_counter()
+                try:
+                    tokens = frontend.submit_and_wait(prompt, max_new)
+                except RuntimeError as e:   # draining/closed
+                    return self._json(503, {"error": str(e)})
+                except ValueError as e:     # engine validation
+                    return self._json(400, {"error": str(e)})
+                except TimeoutError as e:
+                    return self._json(504, {"error": str(e)})
+                return self._json(200, {
+                    "tokens": [int(t) for t in tokens],
+                    "latency_s": round(time.perf_counter() - t0, 4),
+                })
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serving-http",
+        )
+
+    # -- handler-thread side ---------------------------------------------
+
+    def submit_and_wait(self, prompt, max_new_tokens: int):
+        """Submit one request and block until its tokens are ready.
+        Raises RuntimeError while draining (503 to the client) so the
+        load balancer retries another replica during rollout."""
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("draining: not accepting new requests")
+            rid = self.engine.submit(prompt, max_new_tokens)
+            ev = threading.Event()
+            self._waiters[rid] = ev
+        self._work.set()
+        if not ev.wait(self.request_timeout):
+            with self._lock:
+                self._waiters.pop(rid, None)
+                # the engine may still finish this request later; with
+                # the waiter gone _resolve_finished drops the tokens,
+                # but the finish could also have raced this timeout —
+                # purge either way so nothing accumulates
+                self._results.pop(rid, None)
+            raise TimeoutError(f"request {rid} timed out")
+        with self._lock:
+            result = self._results.pop(rid)
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    # -- pump side ---------------------------------------------------------
+
+    def _resolve_finished(self) -> None:
+        done = self.engine.pop_finished()
+        if not done:
+            return
+        with self._lock:
+            for rid, req in done.items():
+                ev = self._waiters.pop(rid, None)
+                self.served += 1
+                if ev is not None:
+                    # no waiter ⇒ the client timed out and left: drop
+                    # the tokens instead of accumulating them forever
+                    self._results[rid] = np.asarray(req.tokens, np.int32)
+                    ev.set()
+
+    def serve(self, should_stop) -> None:
+        """Run the pump until ``should_stop()`` — then drain and close.
+        Call from the process main thread (the engine's scheduling
+        thread); returns only when the engine is fully drained."""
+        self._http_thread.start()
+        try:
+            while not should_stop():
+                busy = self.engine.step()
+                self._resolve_finished()
+                if not busy:
+                    # idle: block on the submission poke, not a spin —
+                    # 50 ms bounds shutdown-signal latency when no
+                    # client ever connects
+                    self._work.wait(0.05)
+                    self._work.clear()
+        finally:
+            self.drain()
+
+    def drain(self) -> None:
+        """Stop intake, finish in-flight requests, close the engine.
+        Idempotent; also releases every still-parked waiter (a request
+        that raced the shutdown gets its tokens if the engine finished
+        it, a 503 RuntimeError otherwise)."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._server.shutdown()
+        try:
+            while self.engine.step():
+                self._resolve_finished()
+            self._resolve_finished()
+        finally:
+            # even if the drain pump raises (e.g. a device error
+            # surfacing out of step()), parked handler threads must be
+            # released and the engine/listener closed — otherwise each
+            # client blocks its full request_timeout and the harvester
+            # threads leak past the kubelet grace period
+            with self._lock:
+                for rid, ev in list(self._waiters.items()):
+                    self._results[rid] = RuntimeError(
+                        "server draining before request finished")
+                    ev.set()
+                self._waiters.clear()
+            self.engine.close()
+            self._server.server_close()
