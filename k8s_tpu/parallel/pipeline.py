@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 def _stage_body(
     params,  # this stage's params (leading stage axis peeled)
     microbatches,  # [M, mb, ...] same on every stage (stage 0 consumes)
+    aux_mbs,  # [M, mb, ...] per-microbatch aux (segment_ids) or None
     fn: Callable,
     axis_name: str,
 ):
@@ -31,7 +32,10 @@ def _stage_body(
     m = microbatches.shape[0]
     steps = m + n - 1
 
-    out_shape = jax.eval_shape(fn, params, microbatches[0])
+    if aux_mbs is None:
+        out_shape = jax.eval_shape(fn, params, microbatches[0])
+    else:
+        out_shape = jax.eval_shape(fn, params, microbatches[0], aux_mbs[0])
     outputs0 = jnp.zeros((m, *out_shape.shape), out_shape.dtype)
     carry0 = jnp.zeros(out_shape.shape, out_shape.dtype)
 
@@ -39,7 +43,16 @@ def _stage_body(
         carry, outputs = state
         mb_idx = jnp.clip(t, 0, m - 1)
         x_in = jnp.where(idx == 0, microbatches[mb_idx], carry)
-        y = fn(params, x_in)
+        if aux_mbs is None:
+            y = fn(params, x_in)
+        else:
+            # stage `idx` is processing microbatch t-idx at step t, so
+            # its aux (segment_ids) is indexed by THAT, not by t: the
+            # activations hop stages via ppermute but the aux array is
+            # local to every stage (the batch is not stage-sharded).
+            # Bubble steps (t-idx out of range) compute on clamped aux
+            # and their outputs are discarded by the emit mask below.
+            y = fn(params, x_in, aux_mbs[jnp.clip(t - idx, 0, m - 1)])
         # send my activation to the next stage (last stage's output
         # falls off the end of the line)
         perm = [(i, i + 1) for i in range(n - 1)]
@@ -74,11 +87,18 @@ def pipeline_apply(
     batch_axes=("data", "fsdp"),
     param_specs: Any = None,
     peel_stage_axis: bool = True,
+    aux: Any = None,
 ) -> jax.Array:
     """Run ``fn`` as a pipeline: ``fn(stage_params, x) -> y`` must be
     shape-preserving across stages (classic transformer-block stack).
     Returns fn's output for the full batch, microbatched through the
     stages.
+
+    ``aux`` (optional, [batch, ...]) rides the same microbatch split as
+    ``x`` and is handed to ``fn(stage_params, x, aux_mb)`` — the packed-
+    document segment_ids path: unlike the activations it never hops
+    stages (every stage holds the full local aux and indexes the
+    microbatch it is currently processing).
 
     ``param_specs`` (default: every leaf ``P(axis_name)``) is a pytree
     of PartitionSpecs matching ``stacked_params`` whose FIRST entry
@@ -110,17 +130,28 @@ def pipeline_apply(
         )
     x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
 
-    def body(params, xs):
+    def body(params, xs, *rest):
         if peel_stage_axis:
             params = jax.tree_util.tree_map(lambda p: p[0], params)
         mbs = xs.reshape(num_microbatches, -1, *xs.shape[1:])
-        out = _stage_body(params, mbs, fn, axis_name)
+        aux_mbs = (
+            rest[0].reshape(num_microbatches, -1, *rest[0].shape[1:])
+            if rest else None
+        )
+        out = _stage_body(params, mbs, aux_mbs, fn, axis_name)
         return out.reshape(-1, *out.shape[2:])
 
+    if aux is None:
+        in_specs = (param_specs, x_spec)
+        operands = (stacked_params, x)
+    else:
+        aux_spec = P(batch_axes, *([None] * (aux.ndim - 1)))
+        in_specs = (param_specs, x_spec, aux_spec)
+        operands = (stacked_params, x, aux)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
+        in_specs=in_specs,
         out_specs=x_spec,
         check_vma=False,
-    )(stacked_params, x)
+    )(*operands)

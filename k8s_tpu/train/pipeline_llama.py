@@ -32,8 +32,9 @@ How the composition works, tpu-first:
 
 Scope gates: dense layers only (MoE's expert all-to-all would nest
 shard_maps) and single-device attention per stage (flash kernel;
-ring/ulysses likewise nest). Packed segment_ids are not plumbed
-through the microbatch split yet.
+ring/ulysses likewise nest). Packed segment_ids ride the microbatch
+split as pipeline_apply's ``aux`` operand (each stage indexes the
+microbatch it is currently processing; boundaries masked in the loss).
 """
 
 from __future__ import annotations
@@ -83,10 +84,13 @@ def _gather_fsdp_layer(layer_params, specs):
     schedule for free."""
 
     def one(p, spec):
+        # gather EVERY fsdp-sharded dim (no early return): a leaf with
+        # two fsdp dims would otherwise silently keep the second one
+        # sharded — wrong shapes with no error
         for i, ax in enumerate(spec[1:]):
             axes = ax if isinstance(ax, tuple) else (ax,)
             if "fsdp" in [a for a in axes if a]:
-                return jax.lax.all_gather(p, "fsdp", axis=i, tiled=True)
+                p = jax.lax.all_gather(p, "fsdp", axis=i, tiled=True)
         return p
 
     leaves, treedef = jax.tree_util.tree_flatten(layer_params)
@@ -131,7 +135,7 @@ def make_pp_llama_apply(
 
     block = LlamaBlock(_dc.replace(cfg, mesh=None))
 
-    def stage_fn(stage_params, x):
+    def stage_fn(stage_params, x, seg=None):
         # [layers_per_stage, ...] slab; constraints inside shard_map
         # must be no-ops (all mesh axes are manual here), hence the
         # empty logical-rules scope
@@ -142,7 +146,7 @@ def make_pp_llama_apply(
                 pos = jnp.broadcast_to(
                     jnp.arange(x.shape[1]), (x.shape[0], x.shape[1])
                 )
-                return block.apply({"params": lp}, x, pos), None
+                return block.apply({"params": lp}, x, pos, seg), None
 
             if cfg.remat:
                 layer = jax.checkpoint(
@@ -152,7 +156,7 @@ def make_pp_llama_apply(
             x, _ = jax.lax.scan(layer, x, stage_params)
         return x
 
-    def apply_fn(params, input_ids):
+    def apply_fn(params, input_ids, segment_ids=None):
         emb = params["embed_tokens"]["embedding"].astype(cfg.dtype)
         x = jnp.take(emb, input_ids, axis=0)  # [B, S, E]
         x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
@@ -160,6 +164,8 @@ def make_pp_llama_apply(
             stage_fn, params["layers"]["block"], x, mesh,
             num_microbatches=num_microbatches,
             param_specs=specs, peel_stage_axis=False,
+            aux=(None if segment_ids is None
+                 else segment_ids.astype(jnp.int32)),
         )
         x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
         return rms_norm(x, params["final_norm"]["weight"], cfg.rms_eps)
@@ -185,10 +191,18 @@ def make_pp_llama_loss(
     apply_fn = make_pp_llama_apply(cfg, mesh, num_microbatches, specs)
 
     def loss_fn(state, params, batch, rng):
-        hidden = apply_fn(params, batch["input_ids"])
+        seg = batch.get("segment_ids")
+        hidden = apply_fn(params, batch["input_ids"], segment_ids=seg)
+        mask = None
+        if seg is not None:
+            # packed docs: drop the cross-document prediction at each
+            # boundary (same contract as the non-PP packed loss)
+            seg_next = jnp.roll(seg, -1, axis=1)
+            mask = (seg == seg_next)[:, :-1]
         ce = fused_lm_head_cross_entropy(
             hidden[:, :-1], params["lm_head"]["kernel"],
             batch["input_ids"][:, 1:], z_loss=z_loss,
+            **({"mask": mask} if mask is not None else {}),
             **({"target_chunk": vocab_chunk} if vocab_chunk else {}),
         )
         return ce, {}
