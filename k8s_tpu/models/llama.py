@@ -486,7 +486,7 @@ class LlamaAttention(nn.Module):
 
             out = Int8ServingDense(
                 cfg.hidden_size, n_in=2, dtype=cfg.dtype,
-                axes=("heads", "head_dim", "embed"), name="o_proj",
+                axes=("heads_out", "head_dim", "embed"), name="o_proj",
             )(out)
         else:
             out = nn.DenseGeneral(
@@ -497,7 +497,7 @@ class LlamaAttention(nn.Module):
                 param_dtype=jnp.float32,
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.lecun_normal(),
-                    ("heads", "head_dim", "embed"),
+                    ("heads_out", "head_dim", "embed"),
                 ),
                 # o_proj deliberately NOT quantized in TRAINING int8
                 # mode: its K=H*D contraction is too small to amortize
@@ -527,7 +527,7 @@ class LlamaMLP(nn.Module):
                         cfg.dtype, cfg.quant)(x)
         y = nn.silu(gate) * up
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
-        return _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.dtype,
+        return _dense(cfg.hidden_size, ("mlp_down", "embed"), "down_proj", cfg.dtype,
                       cfg.quant)(y)
 
 

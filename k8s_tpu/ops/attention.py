@@ -810,6 +810,26 @@ def flash_attention_sharded(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    # loud up-front divisibility checks: a mismatch otherwise surfaces
+    # deep inside shard_map as an opaque sharding error (e.g. BERT's 12
+    # heads on tensor=8 before tp_layout capped the TP degree)
+    batch_shard = 1
+    for ax in (batch_axes if isinstance(batch_axes, tuple) else (batch_axes,)):
+        batch_shard *= mesh.shape.get(ax, 1)
+    head_shard = mesh.shape.get(head_axis, 1)
+    b, hq, hkv = q.shape[0], q.shape[2], k.shape[2]
+    if b % batch_shard:
+        raise ValueError(
+            f"flash_attention_sharded: batch {b} not divisible by the "
+            f"{batch_axes} mesh extent {batch_shard}"
+        )
+    if hq % head_shard or hkv % head_shard:
+        raise ValueError(
+            f"flash_attention_sharded: heads {hq}/kv_heads {hkv} not "
+            f"divisible by mesh axis '{head_axis}'={head_shard} (cap the "
+            "TP degree to the head counts, cf. bert_train.tp_layout)"
+        )
+
     spec = P(batch_axes, None, head_axis, None)
     seg_spec = P(batch_axes, None)
     with_seg = segment_ids is not None

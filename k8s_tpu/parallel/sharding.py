@@ -27,16 +27,34 @@ class LogicalRules:
         ("batch", ("data", "fsdp")),
         ("length", None),
     )
+    # ZeRO layout note: flax applies the rules table in ORDER, and a
+    # mesh axis consumed earlier in an array's spec is skipped later —
+    # so "embed"-only sharding puts down_proj [mlp, embed] and o_proj
+    # [heads, head_dim, embed] shards on their LAST dim. The TPU
+    # backend's reduce-scatter emitter only scatters major dims
+    # (sharding_type 2nd-minor in the HLO collective config), so those
+    # two gradients compiled to full-size all-reduce — 2x the bytes —
+    # while q/k/v/gate/up reduce-scattered (verified via the v5p-128
+    # AOT compile, docs/BENCHMARKS.md AOT table). The output
+    # projections carry dedicated logical names ("mlp_down",
+    # "heads_out", models/llama.py) listed BEFORE "embed" here, so
+    # their dim-0 wins the fsdp axis and every projection gradient
+    # reduce-scatters. TP tables map the same names to "tensor",
+    # preserving the megatron row-parallel layout.
     FSDP = (
         ("batch", ("data", "fsdp")),
+        ("mlp_down", "fsdp"),
+        ("heads_out", "fsdp"),
         ("embed", "fsdp"),
         ("length", None),
     )
     TP = (
         ("batch", ("data", "fsdp")),
         ("heads", "tensor"),
+        ("heads_out", "tensor"),
         ("kv_heads", "tensor"),
         ("mlp", "tensor"),
+        ("mlp_down", "tensor"),
         ("vocab", "tensor"),
         ("length", None),
     )
@@ -44,8 +62,10 @@ class LogicalRules:
         ("batch", ("data", "fsdp")),
         ("embed", "fsdp"),
         ("heads", "tensor"),
+        ("heads_out", "tensor"),
         ("kv_heads", "tensor"),
         ("mlp", "tensor"),
+        ("mlp_down", "tensor"),
         ("vocab", "tensor"),
         ("length", None),
     )
@@ -53,8 +73,10 @@ class LogicalRules:
         ("batch", ("data", "fsdp")),
         ("embed", "fsdp"),
         ("heads", "tensor"),
+        ("heads_out", "tensor"),
         ("kv_heads", "tensor"),
         ("mlp", "tensor"),
+        ("mlp_down", "tensor"),
         ("vocab", "tensor"),
         ("length", "seq"),
     )
@@ -73,6 +95,8 @@ class LogicalRules:
     PP_FSDP = (
         ("batch", ("data", "fsdp")),
         ("layers", "stage"),
+        ("mlp_down", "fsdp"),
+        ("heads_out", "fsdp"),
         ("embed", "fsdp"),
         ("length", None),
     )
@@ -81,8 +105,10 @@ class LogicalRules:
         ("embed", "fsdp"),
         ("expert", "expert"),
         ("heads", "tensor"),
+        ("heads_out", "tensor"),
         ("kv_heads", "tensor"),
         ("mlp", "tensor"),
+        ("mlp_down", "tensor"),
         ("vocab", "tensor"),
         ("length", "seq"),
     )
