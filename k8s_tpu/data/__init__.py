@@ -5,6 +5,7 @@ from k8s_tpu.data.records import (  # noqa: F401
     write_image_shards,
 )
 from k8s_tpu.data.synthetic import (  # noqa: F401
+    learnable_token_batches,
     synthetic_image_batches,
     synthetic_mnist,
     synthetic_token_batches,

@@ -45,3 +45,28 @@ def synthetic_token_batches(
     ids = rng.integers(0, vocab_size, (batch_size, seq_len), np.int32)
     while True:
         yield {"input_ids": ids}
+
+
+def learnable_token_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+) -> Iterator[dict]:
+    """FRESH batches of a deterministic next-token rule (same family as
+    tests/llm_fixtures.py): token t is a fixed affine function of the
+    row's start token, so a working optimizer+sharding stack drives the
+    loss well below its random-init value within tens of steps — and a
+    silently broken gradient path does not. This is the data source the
+    convergence gates train on (``llama_train --data=learnable``);
+    memorizing one fixed random batch (the ``synthetic_*`` generators)
+    cannot distinguish learning from noise."""
+    rng = np.random.default_rng(seed)
+    steps = np.arange(seq_len)
+    while True:
+        start = rng.integers(0, vocab_size, (batch_size, 1))
+        yield {
+            "input_ids": (
+                (start * (steps + 1) * 3 + 7 * steps) % vocab_size
+            ).astype(np.int32)
+        }

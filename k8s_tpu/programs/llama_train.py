@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from k8s_tpu.data import synthetic_token_batches
+from k8s_tpu.data import learnable_token_batches, synthetic_token_batches
 from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
 from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
@@ -112,10 +112,20 @@ def main(rdzv) -> None:
             f"{lcfg.num_layers} layers not divisible by "
             f"{mesh.shape['stage']} pipeline stages"
         )
+    # --lr: 3e-4 is the 8B-scale default; small-model convergence
+    # gates (tiny config, --data=learnable) want ~3e-3
+    lr = float(extra.get("lr", "3e-4"))
     model = LlamaForCausalLM(lcfg)
-    data = synthetic_token_batches(cfg.batch_size, seq_len, lcfg.vocab_size)
+    # --data=learnable: fresh batches of a deterministic next-token
+    # rule — the convergence-gate source (loss must FALL, not just
+    # wiggle; see --require_convergence below). Default stays the
+    # fixed random batch (pure-throughput benching).
+    data_fn = (learnable_token_batches
+               if extra.get("data") == "learnable"
+               else synthetic_token_batches)
+    data = data_fn(cfg.batch_size, seq_len, lcfg.vocab_size)
     state = create_sharded_state(
-        model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules,
+        model, optax.adamw(lr, weight_decay=0.1), mesh, rules,
         jax.random.PRNGKey(0), jnp.asarray(next(data)["input_ids"]),
     )
 
@@ -196,14 +206,18 @@ def main(rdzv) -> None:
     if mgr is not None:
         mark_preempt_aware()
     start = int(state.step)
+    first_loss = final_loss = None
     for step in range(start + 1, cfg.steps + 1):
         if step_sleep:
             import time as _time
 
             _time.sleep(step_sleep)
         state, metrics = step_fn(state, next(data), rng)
+        final_loss = float(metrics["loss"])
+        if first_loss is None:
+            first_loss = final_loss
         if step % cfg.log_every == 0 or step == cfg.steps:
-            logger.log(step, {"loss": float(metrics["loss"])})
+            logger.log(step, {"loss": final_loss})
         maybe_preempt_exit(mgr, rdzv, step, state)
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
@@ -211,3 +225,28 @@ def main(rdzv) -> None:
         mgr.save(cfg.steps, state, force=True)
         mgr.wait()
         mgr.close()
+    # --require_convergence=R: the job FAILS (permanent — a learning
+    # bug is deterministic, retrying wastes the gang-restart budget)
+    # unless final_loss < R * first_loss. With --data=learnable this
+    # turns any training job into a convergence gate: a silent
+    # optimizer/sharding bug that halves learning flunks the job
+    # through the operator's own success contract, not a log grep.
+    req = float(extra.get("require_convergence", "0"))
+    # the gate only judges runs that trained FROM SCRATCH: after a
+    # checkpoint restore first_loss is the already-trained resume-point
+    # loss (ratio ~1.0 would flunk a healthy job), and a restore at
+    # cfg.steps runs zero steps (first_loss None would skip the gate
+    # silently) — both cases are reported as skipped instead
+    gated = req and start == 0
+    if first_loss is not None and rdzv.process_id <= 0:
+        print(json.dumps({
+            "event": "convergence", "first_loss": round(first_loss, 4),
+            "final_loss": round(final_loss, 4),
+            "ratio": round(final_loss / max(first_loss, 1e-9), 4),
+            **({"gate": "skipped_restored"} if req and not gated else {}),
+        }), flush=True)
+    if gated and first_loss is not None and final_loss >= req * first_loss:
+        raise SystemExit(
+            f"convergence gate failed: final loss {final_loss:.4f} not "
+            f"< {req} x first loss {first_loss:.4f}"
+        )
