@@ -35,7 +35,7 @@ from k8s_tpu.train import (
 PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0}
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="llama-bench")
     p.add_argument("--batch-per-chip", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=2048,
@@ -52,8 +52,19 @@ def main(argv=None) -> int:
     p.add_argument("--num-experts", type=int, default=0,
                    help=">0: top-2 MoE MLP with this many experts "
                         "(intermediate_size shrinks to fit HBM)")
-    args = p.parse_args(argv)
+    return p
 
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    print(json.dumps(measure(args)))
+    return 0
+
+
+def measure(args) -> dict:
+    """Run the bench and return the result payload — callable from the
+    driver-facing bench.py so BENCH_r*.json records the LLM train path
+    alongside resnet (VERDICT r4 item 3)."""
     n = len(jax.devices())
     on_accel = jax.default_backend() in ("tpu", "gpu")
     if on_accel:
@@ -141,18 +152,13 @@ def main(argv=None) -> int:
             6 * n_params * tokens_per_sec_chip / (PEAK_BF16_TFLOPS[gen] * 1e12),
             4,
         )
-    print(
-        json.dumps(
-            {
-                "metric": "llama_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec_chip, 1),
-                "unit": "tokens/sec/chip",
-                "params": n_params,
-                "mfu": mfu,
-            }
-        )
-    )
-    return 0
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "params": n_params,
+        "mfu": mfu,
+    }
 
 
 if __name__ == "__main__":
