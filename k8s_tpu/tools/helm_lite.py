@@ -1,0 +1,140 @@
+"""Minimal helm-template renderer for the bundled charts.
+
+The reference ships its example job as a helm chart
+(``/root/reference/examples/tf_job/`` — ``Chart.yaml`` + ``values.yaml``
++ ``templates/tf_job.yaml``) so users template image/replicas per
+environment. This repo's CI hosts have no ``helm`` binary, so this
+module renders the SUBSET of Go-template syntax those charts use —
+enough for ``render() | kubectl_local validate`` to gate every bundled
+chart in CI, and for users without helm to stamp out manifests:
+
+- ``{{ .Values.<dotted.path> }}`` — values.yaml lookups (overridable)
+- ``{{ .Release.Name }}``, ``{{ .Chart.Name }}``, ``{{ .Chart.Version }}``
+- ``{{ <ref> | default <literal> }}`` — the one pipeline the reference's
+  ``_helpers.tpl`` relies on
+
+Anything else (conditionals, loops, includes) raises loudly rather than
+rendering garbage — real helm remains the production path; this is the
+validation/bootstrap path.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+import yaml
+
+_TAG = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _lookup(root: Dict, dotted: str):
+    cur = root
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(dotted)
+        cur = cur[part]
+    return cur
+
+
+def _eval_expr(expr: str, ctx: Dict) -> str:
+    """One ``{{ ... }}`` body: a reference, optionally piped through
+    ``default``/``quote``."""
+    parts = [p.strip() for p in expr.split("|")]
+    head = parts[0]
+    if head.startswith("."):
+        try:
+            val = _lookup(ctx, head[1:])
+        except KeyError:
+            val = None
+    elif head.startswith('"') and head.endswith('"'):
+        val = head[1:-1]
+    else:
+        raise ValueError(f"unsupported template expression: {expr!r}")
+    for pipe in parts[1:]:
+        if pipe.startswith("default "):
+            arg = pipe[len("default "):].strip()
+            if val in (None, ""):
+                val = arg[1:-1] if arg.startswith('"') else _eval_expr(
+                    arg, ctx)
+        elif pipe == "quote":
+            val = f'"{val}"'
+        else:
+            raise ValueError(f"unsupported template pipe: {pipe!r}")
+    if val is None:
+        raise KeyError(f"unresolved template reference: {expr!r}")
+    return str(val)
+
+
+def render_chart(
+    chart_dir: str,
+    release_name: str = "release",
+    values: Optional[Dict] = None,
+) -> Dict[str, str]:
+    """Render every ``templates/*.yaml`` of a chart. ``values`` deep-
+    overrides ``values.yaml`` (the ``--set``/-f analogue). Returns
+    {template filename: rendered manifest text}."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    vals_path = os.path.join(chart_dir, "values.yaml")
+    base_vals: Dict = {}
+    if os.path.exists(vals_path):
+        with open(vals_path) as f:
+            base_vals = yaml.safe_load(f) or {}
+
+    def deep_merge(dst, src):
+        for k, v in (src or {}).items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                deep_merge(dst[k], v)
+            else:
+                dst[k] = v
+        return dst
+
+    ctx = {
+        "Values": deep_merge(dict(base_vals), values or {}),
+        "Release": {"Name": release_name},
+        "Chart": {"Name": chart_meta.get("name", ""),
+                  "Version": str(chart_meta.get("version", ""))},
+    }
+    out: Dict[str, str] = {}
+    tdir = os.path.join(chart_dir, "templates")
+    for fname in sorted(os.listdir(tdir)):
+        if not (fname.endswith(".yaml") or fname.endswith(".yml")):
+            continue  # _helpers.tpl etc. — defines only, nothing rendered
+        with open(os.path.join(tdir, fname)) as f:
+            text = f.read()
+        out[fname] = _TAG.sub(lambda m: _eval_expr(m.group(1), ctx), text)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        "helm-lite", description="render a bundled chart (value "
+        "substitution only; use real helm for production)")
+    ap.add_argument("chart_dir")
+    ap.add_argument("--release", default="release")
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="path.key=value")
+    args = ap.parse_args(argv)
+    overrides: Dict = {}
+    for kv in args.set:
+        path, _, val = kv.partition("=")
+        cur = overrides
+        keys = path.split(".")
+        for k in keys[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[keys[-1]] = val
+    for fname, text in render_chart(
+            args.chart_dir, args.release, overrides).items():
+        sys.stdout.write(f"---\n# Source: {fname}\n{text}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

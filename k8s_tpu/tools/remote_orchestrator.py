@@ -1,0 +1,128 @@
+"""Trigger/poll client for a REMOTE CI orchestrator.
+
+The reference triggers its e2e pipeline on a remote Airflow over REST
+and polls the final task's state to completion, retrieving result
+artifacts afterwards (``/root/reference/py/airflow.py:27-118`` — the
+trigger_dag/get_task_status/wait loop). ``ci/run_ci.py`` runs this
+repo's stage DAG in-process; this module is the remote half of that
+story: point it at an orchestrator service and drive a run from a
+laptop, a cron job, or another cluster without importing the CI code.
+
+Endpoint shape (any service can implement it; the test stub in
+``tests/test_tools.py`` is the contract):
+
+- ``POST {base}/api/v1/dags/{dag}/runs``  body ``{"conf": {...}}``
+  → ``{"run_id": ...}``
+- ``GET  {base}/api/v1/dags/{dag}/runs/{run}/tasks/{task}``
+  → ``{"state": "queued|running|succeeded|failed|upstream_failed"}``
+- ``GET  {base}/api/v1/dags/{dag}/runs/{run}/results/{key}``
+  → arbitrary JSON (the xcom-style result retrieval)
+
+stdlib-only (urllib): this rides in the same no-dependency tier as the
+launcher.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional
+
+# states that mean "still going" — anything else is terminal, including
+# the reference's "upstream_failed" (an earlier stage died and the
+# final task will never run)
+NONTERMINAL_STATES = ("", "none", "queued", "running")
+
+
+class OrchestratorError(IOError):
+    """Server-reported failure (non-2xx with an error payload)."""
+
+
+class RemoteOrchestratorClient:
+    """Minimal trigger/poll/result client. ``token`` is sent as a
+    Bearer header when given (the deployment-agnostic stand-in for the
+    reference's google-auth credential refresh)."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 request_timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.request_timeout = float(request_timeout)
+
+    def _request(self, path: str, method: str = "GET",
+                 json_body: Optional[dict] = None) -> dict:
+        url = self.base_url + path
+        data = json.dumps(json_body).encode() if json_body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001 - body may be anything
+                payload = {}
+            raise OrchestratorError(
+                payload.get("error", f"server error {e.code}")) from e
+
+    # -- API ---------------------------------------------------------------
+
+    def trigger_run(self, dag_id: str,
+                    conf: Optional[Dict] = None) -> str:
+        data = self._request(
+            f"/api/v1/dags/{dag_id}/runs", method="POST",
+            json_body={"conf": conf or {}},
+        )
+        return data["run_id"]
+
+    def get_task_state(self, dag_id: str, run_id: str,
+                       task_id: str) -> str:
+        data = self._request(
+            f"/api/v1/dags/{dag_id}/runs/{run_id}/tasks/{task_id}")
+        return str(data.get("state", ""))
+
+    def get_result(self, dag_id: str, run_id: str, key: str) -> dict:
+        """Fetch a run artifact by key — the xcom-retrieval analogue."""
+        return self._request(
+            f"/api/v1/dags/{dag_id}/runs/{run_id}/results/{key}")
+
+    def wait_for_run(
+        self,
+        dag_id: str,
+        run_id: str,
+        final_task: str = "done",
+        timeout: float = 1800.0,
+        polling_interval: float = 15.0,
+        on_status: Optional[Callable[[str], None]] = None,
+    ) -> str:
+        """Poll the final task until it leaves the non-terminal states;
+        returns the terminal state. ``on_status`` (optional) receives
+        every observed state — progress reporting without coupling to a
+        logger. Raises TimeoutError when the deadline passes."""
+        deadline = time.monotonic() + timeout
+        while True:
+            state = self.get_task_state(dag_id, run_id, final_task)
+            if on_status is not None:
+                on_status(state)
+            if state.lower() not in NONTERMINAL_STATES:
+                return state
+            if time.monotonic() + polling_interval > deadline:
+                raise TimeoutError(
+                    f"run {run_id} of dag {dag_id} did not finish "
+                    f"within {timeout}s (last state: {state or 'none'})"
+                )
+            time.sleep(polling_interval)
+
+
+def run_and_wait(client: RemoteOrchestratorClient, dag_id: str,
+                 conf: Optional[Dict] = None, **wait_kw) -> str:
+    """Trigger + wait in one call (the reference's
+    ``_run_dag_and_wait`` shape). Returns the terminal state."""
+    run_id = client.trigger_run(dag_id, conf)
+    return client.wait_for_run(dag_id, run_id, **wait_kw)

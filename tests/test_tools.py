@@ -807,3 +807,163 @@ class TestReleaseArtifacts:
         # the releaser reads it back under the SAME job name
         assert release.get_latest_green_sha(store, "postsubmit") == "abc123"
         assert release.get_latest_green_sha(store, "ci") == ""
+
+
+class TestExampleChart:
+    """The helm-templated example job chart (reference
+    examples/tf_job/ — Chart.yaml + values.yaml + templates/): rendered
+    by tools/helm_lite (no helm binary on CI hosts) and the output must
+    be a VALID TpuJob, including value overrides (the --set path users
+    template image/replicas through)."""
+
+    CHART = os.path.join(EXAMPLES, "tpu_job_chart")
+
+    def test_renders_and_validates_with_overrides(self):
+        from k8s_tpu.tools import helm_lite
+
+        out = helm_lite.render_chart(
+            self.CHART, release_name="myrun",
+            values={"workers": 4, "accelerator": "v5e-16",
+                    "image": "my.registry/jax:v2"})
+        job = load_tpu_job_yaml(out["tpu_job.yaml"])
+        job.spec.set_defaults()
+        job.spec.validate()
+        assert job.metadata.name == "myrun"
+        w = job.spec.replica_spec(S.WORKER)
+        assert w.replicas == 4
+        assert w.template.spec.containers[0].image == "my.registry/jax:v2"
+
+    def test_default_values_validate(self):
+        from k8s_tpu.tools import helm_lite
+
+        out = helm_lite.render_chart(self.CHART)
+        job = load_tpu_job_yaml(out["tpu_job.yaml"])
+        job.spec.set_defaults()
+        job.spec.validate()
+        env = {e.name: e.value for e in
+               job.spec.replica_spec(S.WORKER).template.spec.containers[0].env}
+        assert env["KTPU_PROGRAM"] == "k8s_tpu.programs.llama_train:main"
+        assert "--strategy=fsdp" in env["KTPU_PROGRAM_ARGS"]
+
+    def test_cli_set_renders(self, capsys, tmp_path):
+        from k8s_tpu.tools import helm_lite
+
+        assert helm_lite.main(
+            [self.CHART, "--release", "r1", "--set",
+             "image=img:v9"]) == 0
+        text = capsys.readouterr().out
+        assert "img:v9" in text
+        # the rendered stream validates through the kubectl-style path
+        f = tmp_path / "rendered.yaml"
+        f.write_text(text.split("---", 2)[-1].split("# Source:")[-1]
+                     .split("\n", 1)[1])
+        assert kubectl_local.main(["validate", "-f", str(f)]) == 0
+
+    def test_unsupported_template_syntax_raises(self, tmp_path):
+        """Loops/conditionals must fail loudly, not render garbage —
+        helm_lite is the validation subset, not a helm replacement."""
+        from k8s_tpu.tools import helm_lite
+
+        (tmp_path / "templates").mkdir()
+        (tmp_path / "Chart.yaml").write_text("name: x\nversion: 0.1.0\n")
+        (tmp_path / "templates" / "t.yaml").write_text(
+            "a: {{ if .Values.x }}y{{ end }}\n")
+        with pytest.raises(ValueError, match="unsupported"):
+            helm_lite.render_chart(str(tmp_path))
+
+
+class TestRemoteOrchestrator:
+    """Trigger/poll client vs a local stub orchestrator (reference
+    py/airflow.py:27-118 — trigger_dag, get_task_status, the wait loop,
+    xcom retrieval): the endpoint contract lives in this stub."""
+
+    @pytest.fixture()
+    def stub(self):
+        import http.server
+        import threading
+
+        state = {"polls": 0, "auth": [], "runs": {}}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                state["auth"].append(self.headers.get("Authorization"))
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                rid = f"run-{len(state['runs'])}"
+                state["runs"][rid] = body.get("conf", {})
+                self._json(200, {"run_id": rid})
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if parts[-2] == "tasks":
+                    state["polls"] += 1
+                    if parts[-1] == "never":
+                        return self._json(200, {"state": "running"})
+                    if parts[-1] == "boom":
+                        return self._json(500, {"error": "dag exploded"})
+                    seq = ["queued", "running", "succeeded"]
+                    return self._json(200, {
+                        "state": seq[min(state["polls"] - 1, 2)]})
+                if parts[-2] == "results":
+                    return self._json(200, {"key": parts[-1],
+                                            "value": 42})
+                self._json(404, {"error": "not found"})
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv.server_address[1], state
+        srv.shutdown()
+        srv.server_close()
+
+    def test_trigger_poll_and_results(self, stub):
+        from k8s_tpu.tools.remote_orchestrator import (
+            RemoteOrchestratorClient,
+        )
+
+        port, state = stub
+        client = RemoteOrchestratorClient(
+            f"http://127.0.0.1:{port}", token="tok-1")
+        rid = client.trigger_run("e2e", conf={"PULL_NUMBER": "7"})
+        assert state["runs"][rid] == {"PULL_NUMBER": "7"}
+        assert state["auth"][-1] == "Bearer tok-1"
+        seen = []
+        final = client.wait_for_run(
+            "e2e", rid, polling_interval=0.01, timeout=5,
+            on_status=seen.append)
+        assert final == "succeeded"
+        assert seen == ["queued", "running", "succeeded"]
+        # xcom-style result retrieval
+        assert client.get_result("e2e", rid, "artifacts")["value"] == 42
+
+    def test_wait_times_out(self, stub):
+        from k8s_tpu.tools.remote_orchestrator import (
+            RemoteOrchestratorClient,
+        )
+
+        port, _ = stub
+        client = RemoteOrchestratorClient(f"http://127.0.0.1:{port}")
+        with pytest.raises(TimeoutError, match="did not finish"):
+            client.wait_for_run("e2e", "r1", final_task="never",
+                                polling_interval=0.01, timeout=0.05)
+
+    def test_server_error_surfaces(self, stub):
+        from k8s_tpu.tools.remote_orchestrator import (
+            OrchestratorError,
+            RemoteOrchestratorClient,
+        )
+
+        port, _ = stub
+        client = RemoteOrchestratorClient(f"http://127.0.0.1:{port}")
+        with pytest.raises(OrchestratorError, match="dag exploded"):
+            client.get_task_state("e2e", "r1", "boom")
