@@ -54,10 +54,35 @@ def main(argv=None) -> int:
                    help="one qkv GEMM + one gate/up GEMM per layer "
                         "(fuse_params_for_decode); decode latency is "
                         "fusion-count-bound, so fewer dispatches win")
+    p.add_argument("--proxy-8b-tp8", action="store_true",
+                   help="single-chip proxy of the 8B TP=8 decode step: "
+                        "the PER-CHIP shard shapes of llama3-8b under "
+                        "tensor=8 (hidden 4096 full — activations are "
+                        "replicated between blocks under TP — heads "
+                        "4/1, mlp 1792, vocab 16032, 32 layers = 1.0B "
+                        "params ≈ 2.0 GiB bf16, the real shard size). "
+                        "Measures "
+                        "the per-chip compute+HBM term of the 8B serve; "
+                        "the TP all-reduces (2/layer, AOT-verified) "
+                        "ride ICI and are NOT in this number")
     args = p.parse_args(argv)
 
     on_accel = jax.default_backend() in ("tpu", "gpu")
-    if on_accel:
+    if args.proxy_8b_tp8 and not on_accel:
+        # silently falling through to the tiny CPU config would record
+        # tiny-model numbers as if they were the 8B shard measurement
+        p.error("--proxy-8b-tp8 needs an accelerator backend (the "
+                "proxy measures the per-chip HBM term of the real 8B "
+                "shard; CPU numbers would be meaningless)")
+    if on_accel and args.proxy_8b_tp8:
+        cfg = LlamaConfig(
+            vocab_size=16032, hidden_size=4096, intermediate_size=1792,
+            num_layers=32, num_heads=4, num_kv_heads=1, head_dim=128,
+            max_seq_len=args.prompt_len + args.new_tokens,
+            remat=False, decode=True, quant=args.quant,
+            scan_layers=args.scan_layers, kv_quant=args.kv_quant,
+        )
+    elif on_accel:
         cfg = LlamaConfig(
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,

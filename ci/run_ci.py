@@ -121,9 +121,11 @@ def main(argv=None) -> int:
             args.artifacts_dir, cases,
         )
         # AOT-compile the real north-star configs (BERT v5p-64,
-        # Llama-3-8B v5p-128) against virtual TPU topologies: proves
-        # the production sharded HLO compiles and fits HBM without
-        # hardware (~5 min; skipped with the slow tests)
+        # Llama-3-8B v5p-128 FSDP + PP×FSDP, the 8B TP decode step
+        # bf16+int8) against virtual TPU topologies: proves the
+        # production sharded HLO compiles, fits HBM, and keeps its
+        # collective schedule without hardware (~12-15 min for all 5;
+        # skipped with the slow tests)
         if not args.skip_slow:
             ok = ok and stage(
                 "aot-northstar",

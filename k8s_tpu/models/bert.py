@@ -136,7 +136,7 @@ class BertLayer(nn.Module):
             attn = flash_attention(
                 q, k, v, causal=False, segment_ids=attention_mask
             )
-        attn = _dense(cfg.hidden_size, ("heads", "head_dim", "embed"),
+        attn = _dense(cfg.hidden_size, ("heads_out", "head_dim", "embed"),
                       "o_proj", cfg.dtype, axis=(-2, -1), quant=cfg.quant)(attn)
         x = ln1(x + attn)
         y = _dense(cfg.intermediate_size, ("embed", "mlp"), "fc_in", cfg.dtype,
@@ -144,7 +144,7 @@ class BertLayer(nn.Module):
         # exact erf gelu matches HF BERT weights (cfg.use_exact_gelu)
         y = nn.gelu(y, approximate=not cfg.use_exact_gelu)
         y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
-        y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc_out", cfg.dtype,
+        y = _dense(cfg.hidden_size, ("mlp_down", "embed"), "fc_out", cfg.dtype,
                    quant=cfg.quant)(y)
         return ln2(x + y)
 

@@ -8,6 +8,16 @@ hop stage→stage via ``jax.lax.ppermute``. The steady state keeps every
 stage busy; bubble fraction is (S-1)/(M+S-1) for S stages and M
 microbatches. The schedule is a ``lax.scan`` (reverse-differentiable,
 single compiled loop).
+
+Schedule note (why GPipe, not 1F1B): differentiating the scan yields
+GPipe's all-forward-then-all-backward order automatically; 1F1B would
+need hand-orchestrated per-microbatch VJPs. 1F1B's win is activation
+memory at LARGE M — here remat bounds per-microbatch activation
+storage and the at-scale compile (aot_check llama3-8b-pp-fsdp,
+stage=4 M=4) peaks at 14.7 of 90 GiB/chip, so the memory case hasn't
+arrived. The bubble is managed by raising M (e.g. S=4: M=4 → 43%,
+M=16 → 16%), which the headroom accommodates; revisit 1F1B only if a
+config is simultaneously bubble-bound and memory-bound.
 """
 
 from __future__ import annotations

@@ -154,35 +154,34 @@ def _compile_and_report(name, step_fn, abs_state, abs_batch, mesh, rules,
     return _report_compiled(name, compiled, mesh, hbm_budget)
 
 
-def _report_compiled(name, compiled, mesh, hbm_budget=HBM_BUDGET):
-    ma = compiled.memory_analysis()
-    hlo = compiled.as_text()
-    # static HLO op counts: "<opcode>(" — note a lax.scan body counts
-    # each collective ONCE however many layers iterate through it.
-    # TPU HLO async-ifies collectives (all-gather-start/-done pairs);
-    # count sync + -start forms so asyncified ops aren't read as zero
-    # (-done is the same op completing, not a second one).
+def count_collectives(hlo: str) -> Dict[str, int]:
+    """Static collective-op counts from optimized HLO text. Counts
+    sync + async (-start) forms, and reclassifies the TPU backend's
+    fused reduce-scatter representation (kind=kCustom fusions calling
+    %all-reduce-scatter.* computations whose body holds a layout-
+    constrained all-reduce) — counting text alone reads those as
+    all-reduce and reports RS=0, the round-4 misread."""
+    import re
+
     counts = {
         op: hlo.count(f" {op}(") + hlo.count(f" {op}-start(")
         for op in COLLECTIVES
     }
-    # The TPU backend emits reduce-scatter as a kind=kCustom fusion
-    # calling an %all-reduce-scatter.* computation (emitter
-    # "SingleInputAllReduceScatterFusion") whose BODY holds a layout-
-    # constrained all-reduce — the actual collective on the wire is a
-    # ring reduce-scatter at 1/shard the output bytes. Counting HLO
-    # text alone reads that as an all-reduce and reports RS=0 (exactly
-    # the round-4 misread): reclassify fusion call sites as
-    # reduce-scatter and drop the representational inner all-reduces
-    # (one per fused computation definition).
-    import re as _re
-
-    rs_calls = len(_re.findall(r"calls=%?all-reduce-scatter", hlo))
-    rs_defs = len(_re.findall(r"^%?all-reduce-scatter[\w.\-]*[\s(]", hlo,
-                              _re.M))
+    rs_calls = len(re.findall(r"calls=%?all-reduce-scatter", hlo))
+    rs_defs = len(re.findall(r"^%?all-reduce-scatter[\w.\-]*[\s(]", hlo,
+                             re.M))
     if rs_calls:
         counts["reduce-scatter"] += rs_calls
         counts["all-reduce"] = max(0, counts["all-reduce"] - rs_defs)
+    return counts
+
+
+def _report_compiled(name, compiled, mesh, hbm_budget=HBM_BUDGET):
+    ma = compiled.memory_analysis()
+    hlo = compiled.as_text()
+    # note a lax.scan body counts each collective ONCE however many
+    # layers iterate through it
+    counts = count_collectives(hlo)
     cost = compiled.cost_analysis()
     if isinstance(cost, list):  # older jax returns [dict]
         cost = cost[0] if cost else {}

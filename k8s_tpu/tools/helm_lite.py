@@ -59,7 +59,12 @@ def _eval_expr(expr: str, ctx: Dict) -> str:
                 val = arg[1:-1] if arg.startswith('"') else _eval_expr(
                     arg, ctx)
         elif pipe == "quote":
-            val = f'"{val}"'
+            # escape embedded quotes/backslashes like real helm — an
+            # unescaped inner quote would render invalid YAML silently,
+            # against this module's raise-loudly-or-render-faithfully
+            # contract
+            escaped = str(val).replace("\\", "\\\\").replace('"', '\\"')
+            val = f'"{escaped}"'
         else:
             raise ValueError(f"unsupported template pipe: {pipe!r}")
     if val is None:

@@ -112,12 +112,15 @@ class RemoteOrchestratorClient:
                 on_status(state)
             if state.lower() not in NONTERMINAL_STATES:
                 return state
-            if time.monotonic() + polling_interval > deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(
                     f"run {run_id} of dag {dag_id} did not finish "
                     f"within {timeout}s (last state: {state or 'none'})"
                 )
-            time.sleep(polling_interval)
+            # never oversleep the deadline: the FULL budget gets a final
+            # poll (a run finishing in the last partial interval counts)
+            time.sleep(min(polling_interval, deadline - now))
 
 
 def run_and_wait(client: RemoteOrchestratorClient, dag_id: str,

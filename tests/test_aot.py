@@ -134,3 +134,33 @@ class TestDevicelessCompile:
         assert res["flops_per_step_per_device"] > 0
         # fsdp layout must show gather/reduce traffic in the HLO
         assert sum(res["collectives"].values()) > 0, res["collectives"]
+
+
+def test_count_collectives_reclassifies_fused_reduce_scatter():
+    """The TPU backend emits reduce-scatter as kCustom fusions calling
+    %all-reduce-scatter.* computations whose body holds an all-reduce —
+    textual op counting read those as all-reduce and reported RS=0 (the
+    round-4 misread). The counter must reclassify call sites as
+    reduce-scatter and drop the representational inner all-reduces."""
+    from k8s_tpu.tools.aot_check import count_collectives
+
+    hlo = "\n".join([
+        "%all-reduce-scatter.3.clone (p: bf16[4096,14336]) -> bf16[128,14336] {",
+        "  %r = bf16[4096,14336] all-reduce(%p), replica_groups={}",
+        "}",
+        "%other (x: f32[2]) -> f32[2] {",
+        "  %y = f32[2] all-reduce(%x)",
+        "  %z = f32[2] all-gather-start(%y)",
+        "}",
+        "ENTRY %main {",
+        "  %f1 = bf16[128,14336] fusion(%a), kind=kCustom, calls=%all-reduce-scatter.3.clone",
+        "  %f2 = bf16[128,14336] fusion(%b), kind=kCustom, calls=%all-reduce-scatter.3.clone",
+        "}",
+    ])
+    counts = count_collectives(hlo)
+    # two fusion call sites -> 2 reduce-scatters; ONE inner all-reduce
+    # dropped (one computation definition); the unrelated all-reduce
+    # and the async all-gather-start still counted
+    assert counts["reduce-scatter"] == 2, counts
+    assert counts["all-reduce"] == 1, counts
+    assert counts["all-gather"] == 1, counts
