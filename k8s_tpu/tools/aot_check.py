@@ -433,6 +433,53 @@ def _check_llama3_8b_decode(quant: str):
     return _report_compiled(f"llama3-8b-decode-tp8{suffix}", compiled, mesh)
 
 
+def check_llama3_8b_longctx_v5p128():
+    """Long-context at scale: Llama-3-8B at seq 32768 with ring
+    attention over the ``seq`` mesh axis (context parallelism),
+    composed with FSDP+TP — the headline long-context path
+    (docs/BENCHMARKS.md long-context rows are single-chip) compiled by
+    the real TPU compiler at the multi-slice topology. KV blocks and
+    their segment rows rotate the seq ring via ppermute (ICI); the
+    collective schedule must show those alongside the FSDP/TP sync."""
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+    from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
+    from k8s_tpu.parallel import LogicalRules
+    from k8s_tpu.train import make_train_step
+
+    mesh = _topology_mesh("v5p:4x4x4", dict(data=2, fsdp=8, tensor=2,
+                                            seq=2))
+    rules = LogicalRules(LogicalRules.FSDP_TP_SP)
+    cfg = LlamaConfig.llama3_8b(attention="ring", mesh=mesh,
+                                max_seq_len=32768)
+    model = LlamaForCausalLM(cfg)
+    batch, seq = 16, cfg.max_seq_len  # 1 row per data×fsdp shard at 32k
+
+    def loss_fn(state, params, b, rng):
+        hidden = state.apply_fn(
+            {"params": params}, b["input_ids"], return_hidden=True
+        )
+        return fused_lm_head_cross_entropy(
+            hidden[:, :-1], params["lm_head"]["kernel"],
+            b["input_ids"][:, 1:], z_loss=1e-4,
+        ), {}
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    example = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    abs_state = _abstract_sharded_state(
+        model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules, example
+    )
+    abs_batch = _abstract_batch(
+        {"input_ids": ((batch, seq), "int32")}, mesh, rules
+    )
+    return _compile_and_report(
+        "llama3-8b-longctx-sp-v5p128", step_fn, abs_state, abs_batch,
+        mesh, rules,
+    )
+
+
 def check_llama3_8b_decode_tp8_bf16():
     return _check_llama3_8b_decode("")
 
@@ -447,6 +494,7 @@ CONFIGS = {
     "llama3-8b-pp-fsdp-v5p128": check_llama3_8b_pp_fsdp_v5p128,
     "llama3-8b-decode-tp8-bf16": check_llama3_8b_decode_tp8_bf16,
     "llama3-8b-decode-tp8-int8": check_llama3_8b_decode_tp8_int8,
+    "llama3-8b-longctx-v5p128": check_llama3_8b_longctx_v5p128,
 }
 
 
