@@ -480,6 +480,57 @@ def check_llama3_8b_longctx_v5p128():
     )
 
 
+def check_llama_moe_ep_v5p64():
+    """Expert parallelism at scale — the last §2.5 parallelism row
+    without at-scale compile evidence (MoE was measured single-chip
+    only). A mid-size top-2 MoE Llama (hidden 2048 / 16 layers / 8
+    experts) with experts sharded over ``expert=8``, composed with
+    data=2 × fsdp=2, on 32 virtual v5p chips: proves the sort-based
+    static-shape dispatch's expert all-to-all compiles and what it
+    costs alongside the FSDP sync."""
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+    from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
+    from k8s_tpu.parallel import LogicalRules
+    from k8s_tpu.train import make_train_step, sum_sown_losses
+
+    mesh = _topology_mesh("v5p:4x4x2", dict(data=2, fsdp=2, expert=8))
+    rules = LogicalRules(LogicalRules.MOE)
+    cfg = LlamaConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=1024,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+        max_seq_len=4096, num_experts=8, attention="flash", mesh=mesh,
+        remat=True,
+    )
+    model = LlamaForCausalLM(cfg)
+    batch, seq = 16, cfg.max_seq_len  # 4 rows per data×fsdp shard
+
+    def loss_fn(state, params, b, rng):
+        hidden, mut = state.apply_fn(
+            {"params": params}, b["input_ids"], return_hidden=True,
+            mutable=["intermediates"],
+        )
+        ce = fused_lm_head_cross_entropy(
+            hidden[:, :-1], params["lm_head"]["kernel"],
+            b["input_ids"][:, 1:], z_loss=1e-4,
+        )
+        return ce + sum_sown_losses(mut.get("intermediates", {})), {}
+
+    step_fn = make_train_step(loss_fn, mesh, rules)
+    example = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    abs_state = _abstract_sharded_state(
+        model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules, example
+    )
+    abs_batch = _abstract_batch(
+        {"input_ids": ((batch, seq), "int32")}, mesh, rules
+    )
+    return _compile_and_report(
+        "llama-moe-ep-v5p64", step_fn, abs_state, abs_batch, mesh, rules
+    )
+
+
 def check_llama3_8b_decode_tp8_bf16():
     return _check_llama3_8b_decode("")
 
@@ -495,6 +546,7 @@ CONFIGS = {
     "llama3-8b-decode-tp8-bf16": check_llama3_8b_decode_tp8_bf16,
     "llama3-8b-decode-tp8-int8": check_llama3_8b_decode_tp8_int8,
     "llama3-8b-longctx-v5p128": check_llama3_8b_longctx_v5p128,
+    "llama-moe-ep-v5p64": check_llama_moe_ep_v5p64,
 }
 
 
