@@ -79,6 +79,12 @@ def main(argv=None) -> int:
                    help="requests/sec (exponential inter-arrivals, "
                         "fixed seed); 0 = all-at-once throughput race")
     p.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+    p.add_argument("--quant", default="none",
+                   choices=["none", "int8_serving"],
+                   help="int8_serving: weight-only int8 kernels — the "
+                        "production serving config of "
+                        "examples/tpu_job_serving.yaml; halves the "
+                        "weight-read term that dominates decode")
     p.add_argument("--skip-static", action="store_true",
                    help="measure only the engine (fast iteration)")
     p.add_argument("--cpu-model", default="tiny", choices=["tiny", "small"],
@@ -144,17 +150,24 @@ def main(argv=None) -> int:
                         if b < args.max_prompt) + (args.max_prompt,)
         prompt_lo, new_round = 2, 4
 
-    rcfg = dataclasses.replace(cfg, ragged_decode=True)
-    model_static = LlamaForCausalLM(cfg)
-    model = LlamaForCausalLM(rcfg)
     import flax.linen as nn
 
-    params = nn.unbox(model_static.init(
+    # init in the canonical bf16 layout, then (optionally) quantize —
+    # the real serving path (trained checkpoint -> transform)
+    params = nn.unbox(LlamaForCausalLM(cfg).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
     params = jax.tree_util.tree_map(
         lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
         params,
     )
+    if args.quant == "int8_serving":
+        from k8s_tpu.ops.quant import quantize_params_for_serving
+
+        params = quantize_params_for_serving(params)
+        cfg = dataclasses.replace(cfg, quant="int8_serving")
+    rcfg = dataclasses.replace(cfg, ragged_decode=True)
+    model_static = LlamaForCausalLM(cfg)
+    model = LlamaForCausalLM(rcfg)
 
     rng = np.random.RandomState(0)
     plens = rng.randint(prompt_lo, args.max_prompt + 1, size=args.requests)
@@ -214,6 +227,7 @@ def main(argv=None) -> int:
         "slots": args.slots,
         "decode_chunk": args.decode_chunk,
         "arrival_rate": args.arrival_rate,
+        "quant": args.quant,
         "kv_quant": args.kv_quant,
         "latency_p50_s": round(p50, 2),
         "latency_p95_s": round(p95, 2),
