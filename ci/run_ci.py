@@ -109,10 +109,11 @@ def main(argv=None) -> int:
         args.artifacts_dir, cases,
     )
     if not args.only_checks:
-        pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q",
+        # slow-marked tests (the chaos soak) run in their own stage
+        # below, never inside the tier-1 unit run
+        marker = "not slow and not integration" if args.skip_slow else "not slow"
+        pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q", "-m", marker,
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
-        if args.skip_slow:
-            pytest_cmd += ["-m", "not integration"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(
             "e2e",
@@ -120,6 +121,17 @@ def main(argv=None) -> int:
              "--junit-path", f"{args.artifacts_dir}/junit_e2e.xml"],
             args.artifacts_dir, cases,
         )
+        # chaos soak: the full level-3 fault matrix under a fixed seed
+        # (docs/ROBUSTNESS.md). Its stage verdict lands in junit_ci.xml
+        # via tools/junit.py like every other stage.
+        if not args.skip_slow:
+            ok = ok and stage(
+                "chaos-soak",
+                [py, "-m", "pytest", "tests/test_chaos_soak.py", "-q",
+                 "-m", "slow",
+                 f"--junitxml={args.artifacts_dir}/junit_chaos_soak.xml"],
+                args.artifacts_dir, cases,
+            )
         # AOT-compile the real north-star configs (BERT v5p-64,
         # Llama-3-8B v5p-128 FSDP + PP×FSDP, the 8B TP decode step
         # bf16+int8) against virtual TPU topologies: proves the

@@ -148,7 +148,21 @@ class LeaderElector:
                 stop.wait(self.renew_deadline)
                 if stop.is_set():
                     break
-                if not self.try_acquire_or_renew():
+                try:
+                    renewed = self.try_acquire_or_renew()
+                except Exception as e:
+                    # a transient API error mid-renew previously killed
+                    # this thread SILENTLY: the lease then expired with
+                    # `lost` never set — the old leader kept leading
+                    # while a new one took over (split brain). Failing
+                    # safe — treat it as a lost lease — is the only
+                    # correct direction.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "lease renew errored (%s); conceding leadership", e)
+                    renewed = False
+                if not renewed:
                     lost.set()
                     break
 

@@ -67,6 +67,14 @@ class TooManyRequestsError(ApiError):
         self.retry_after = retry_after
 
 
+def is_transient(e: BaseException) -> bool:
+    """Worth retrying blindly? Plain ApiError is the 5xx/transport
+    bucket (_raise_for_status's catch-all) and 429 names its own retry;
+    every typed subclass (404/409/410/422/403/401) carries a semantic
+    the caller must handle, not retry."""
+    return type(e) is ApiError or isinstance(e, TooManyRequestsError)
+
+
 def is_not_found(e: Exception) -> bool:
     return isinstance(e, NotFoundError)
 

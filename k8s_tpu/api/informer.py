@@ -37,10 +37,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from k8s_tpu.api import errors
 from k8s_tpu.api.cluster import InMemoryCluster, WatchEvent, _matches
+from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
 
 log = logging.getLogger(__name__)
 
 DEFAULT_KINDS = ("Job", "Pod", "Service", "ConfigMap", "Deployment")
+
+# Reflector resync schedule: list/watch failures and 410 relists space
+# out 0.5s → 15s (jittered) instead of hammering a browned-out apiserver.
+RESYNC_POLICY = BackoffPolicy(
+    base=0.5, factor=2.0, cap=15.0, jitter=0.5, reset_after=60.0
+)
 
 
 class _KindCache:
@@ -189,9 +196,9 @@ class Informer:
         410; re-dial on stream errors (the RestWatcher already re-dials
         EOFs internally — only staleness surfaces here)."""
         cache = self.caches[kind]
-        backoff = 0.0
+        bo = Backoff(RESYNC_POLICY)  # unified resync/relist schedule
         while not self._stop.is_set():
-            if backoff and self._stop.wait(backoff):
+            if bo.wait(self._stop):
                 return
             try:
                 lister = getattr(self.cluster, "list_with_rv", None)
@@ -208,11 +215,11 @@ class Informer:
                 cache.synced.set()
                 watcher = self.cluster.watch(kind, self.namespace, rv)
             except Exception as e:
-                backoff = min(max(backoff * 2, 0.5), 15.0)
+                delay = bo.note_failure()
                 log.warning("informer %s: list/watch failed (%s); retry in %.1fs",
-                            kind, e, backoff)
+                            kind, e, delay)
                 continue
-            backoff = 0.0
+            bo.note_success()
             try:
                 while not self._stop.is_set():
                     ev = watcher.next(timeout=0.2)
@@ -220,10 +227,15 @@ class Informer:
                         continue
                     cache.apply(ev)
             except errors.OutdatedVersionError:
-                log.info("informer %s: watch outdated; relisting", kind)
+                # a 410 storm (chaos watch-drop, compacted history)
+                # relists through the same backoff as any other failure
+                delay = bo.note_failure()
+                log.info("informer %s: watch outdated; relisting in %.1fs",
+                         kind, delay)
             except Exception as e:
-                backoff = 1.0
-                log.warning("informer %s: watch error (%s); relisting", kind, e)
+                delay = bo.note_failure()
+                log.warning("informer %s: watch error (%s); relisting in %.1fs",
+                            kind, e, delay)
             finally:
                 try:
                     watcher.stop()

@@ -41,8 +41,15 @@ from typing import Any, Dict, List, Optional
 
 from k8s_tpu.api import errors, wire
 from k8s_tpu.api.cluster import WatchEvent
+from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
 
 log = logging.getLogger(__name__)
+
+# Watch re-dial schedule: clean EOFs re-dial immediately (note_success),
+# stream errors space out 1s → 30s with jitter.
+WATCH_REDIAL_POLICY = BackoffPolicy(
+    base=1.0, factor=2.0, cap=30.0, jitter=0.5, reset_after=60.0
+)
 
 
 def _raise_for_status(code: int, body: bytes,
@@ -129,24 +136,25 @@ class RestWatcher:
     # -- reader side ----------------------------------------------------
 
     def _run(self) -> None:
-        backoff = 0.0  # clean EOF re-dials immediately; errors back off
+        # unified policy: clean EOF re-dials immediately; errors back off
+        bo = Backoff(WATCH_REDIAL_POLICY)
         while not self.closed:
-            if backoff:
-                time.sleep(backoff)
+            if bo.remaining() > 0:
+                time.sleep(bo.remaining())
                 if self.closed:
                     return
             try:
                 self._stream_once()
-                backoff = 0.0
+                bo.note_success()
             except errors.OutdatedVersionError:
                 self.q.put(self._STALE)
                 return
             except Exception as e:
                 if self.closed:
                     return
-                backoff = min(max(backoff * 2, 1.0), 30.0)
-                log.debug("watch %s: stream error, re-dial in %.0fs: %s",
-                          self.kind, backoff, e)
+                delay = bo.note_failure()
+                log.debug("watch %s: stream error, re-dial in %.1fs: %s",
+                          self.kind, delay, e)
             # EOF / server timeout: re-dial from last seen RV
 
     def _stream_once(self) -> None:

@@ -21,6 +21,7 @@ from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.crd_client import TpuJobClient
 from k8s_tpu import utils
 from k8s_tpu.controller.watchdog import PanicTimer
+from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
 from k8s_tpu.spec import ControllerConfig, TpuJob, TpuJobPhase
 from k8s_tpu.trainer.training import TrainingJob
 
@@ -28,6 +29,13 @@ log = logging.getLogger(__name__)
 
 INIT_RETRY_WAIT = 30.0  # reference controller.go:33
 WATCHDOG_DEADLINE = 60.0  # reference controller.go:110
+
+# Requeue schedule for the controller's outer loop: init failures,
+# relist-after-410, and pump crashes all hold off through this (capped
+# at the reference's fixed 30s init wait, which it replaces).
+REQUEUE_POLICY = BackoffPolicy(
+    base=0.5, factor=2.0, cap=INIT_RETRY_WAIT, jitter=0.5, reset_after=120.0
+)
 
 
 class Controller:
@@ -159,13 +167,24 @@ class Controller:
     # ------------------------------------------------------------ run loop
 
     def run(self) -> None:
-        """Watch pump (reference Run + watch, controller.go:80-119,292-376)."""
+        """Watch pump (reference Run + watch, controller.go:80-119,292-376).
+
+        Every requeue path — init failure, relist-after-410, a pump
+        crash (e.g. an event handler exceeding the watchdog under an
+        apiserver brown-out) — routes through one :class:`Backoff`:
+        repeated failures space out exponentially instead of hot-
+        looping the apiserver, and a stable stretch earns the fast
+        retry back. A pump crash previously killed the controller
+        thread silently; now it re-initializes and keeps serving."""
+        requeue = Backoff(REQUEUE_POLICY)
         while not self._stop.is_set():
             try:
                 watch_rv = self.init_resource()
             except Exception as e:
-                log.error("initialization failed: %s; retrying", e)
-                if self._stop.wait(INIT_RETRY_WAIT):
+                delay = requeue.note_failure()
+                log.error("initialization failed: %s; retrying in %.1fs",
+                          e, delay)
+                if requeue.wait(self._stop):
                     return
                 continue
             try:
@@ -174,8 +193,14 @@ class Controller:
             except errors.OutdatedVersionError:
                 # 410 Gone → relist and re-watch (reference
                 # ErrVersionOutdated restart path, controller.go:331-344)
-                log.info("watch outdated; relisting")
-                continue
+                delay = requeue.note_failure()
+                log.info("watch outdated; relisting in %.1fs", delay)
+            except Exception as e:
+                delay = requeue.note_failure()
+                log.error("event pump failed: %s; re-initializing in %.1fs",
+                          e, delay)
+            if requeue.wait(self._stop):
+                return
 
     def _pump(self, watch_rv: int) -> None:
         watcher = self.job_client.watch(self.namespace, resource_version=watch_rv)

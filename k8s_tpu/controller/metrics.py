@@ -144,3 +144,15 @@ INFORMER_SYNCED = REGISTRY.gauge(
     "ktpu_operator_informer_synced",
     "1 when every informer kind has completed its initial list",
 )
+GANG_RESTART_BACKOFF = REGISTRY.gauge(
+    "ktpu_operator_gang_restart_backoff_seconds",
+    "Current gang-restart backoff hold-off per job (0 = no hold-off)",
+)
+GANG_RESTARTS_DELAYED = REGISTRY.counter(
+    "ktpu_operator_gang_restarts_delayed_total",
+    "Gang restarts deferred by the backoff schedule, by job",
+)
+CHAOS_FAULTS = REGISTRY.counter(
+    "ktpu_operator_chaos_faults_total",
+    "Faults injected by the chaos matrix, by fault class",
+)

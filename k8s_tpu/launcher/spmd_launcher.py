@@ -78,7 +78,17 @@ def configure_platform(env=None):
         jax.config.update("jax_platforms", platform)
     n_cpu = env.get("KTPU_NUM_CPU_DEVICES", "")
     if n_cpu and platform == "cpu":
-        jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        except AttributeError:
+            # pre-0.5 jax has no jax_num_cpu_devices option; the XLA
+            # flag predates it and works as long as it lands before the
+            # backend initializes (we run before first device use)
+            flag = f"--xla_force_host_platform_device_count={int(n_cpu)}"
+            if flag not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") + " " + flag
+                ).strip()
 
 
 def initialize_distributed(rdzv):
@@ -88,6 +98,15 @@ def initialize_distributed(rdzv):
 
     if not rdzv.is_distributed:
         return
+    if jax.config.jax_platforms and "cpu" in str(jax.config.jax_platforms):
+        # multi-process CPU (the virtual-cluster test path) needs an
+        # explicit cross-process collectives backend on jax 0.4.x —
+        # without it every collective fails with "Multiprocess
+        # computations aren't implemented on the CPU backend"
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass  # newer jax: gloo is the default / option renamed
     jax.distributed.initialize(
         coordinator_address=rdzv.coordinator_address,
         num_processes=rdzv.num_processes,
@@ -248,6 +267,23 @@ def main(argv=None):
                 json.dumps({"event": "done", "elapsed_s": round(time.time() - t0, 3)}),
                 flush=True,
             )
+        if rdzv.is_distributed:
+            # the work is done and logged; exit without running C++
+            # teardown. Old jax's gloo/grpc destructor path corrupts the
+            # heap (malloc_consolidate abort → exit 134), which the
+            # operator classifies as a retryable SLICE fault — a
+            # successful run then burns the whole gang-restart budget
+            # crashing in teardown. jax.distributed.shutdown() is best-
+            # effort first so the coordinator sees a clean leave.
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(EX_OK)
         return EX_OK
     except Exception as e:
         if is_retryable_error(e):

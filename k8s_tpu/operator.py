@@ -41,7 +41,14 @@ def parse_args(argv=None):
     p.add_argument("--controller-config-file", default="",
                    help="YAML ControllerConfig (accelerators map, launcher module)")
     p.add_argument("--chaos-level", type=int, default=-1,
-                   help="chaos monkey aggressiveness; -1 disables")
+                   help="chaos matrix profile: -1 disables, 0 gentle pod "
+                        "kills, 1 aggressive pod kills, 2 + apiserver "
+                        "flakes/watch drops/slow handlers, 3 + checkpoint "
+                        "faults and lease loss (see docs/ROBUSTNESS.md)")
+    p.add_argument("--chaos-interval", type=float, default=30.0,
+                   help="seconds between chaos scheduling ticks")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="seed the chaos RNG for reproducible fault runs")
     p.add_argument("--gc-interval", type=float, default=600.0)
     p.add_argument("--health-port", type=int, default=8080,
                    help="liveness + /metrics listener; matches the chart's "
@@ -87,6 +94,15 @@ def main(argv=None) -> int:
     # --local forces the in-memory backend: the in-process kubelet hangs
     # off its synchronous hooks, which no remote apiserver can provide
     client = KubeClient() if args.local else get_cluster_client(args.kubeconfig)
+    faulty = None
+    if args.chaos_level >= 2:
+        # levels >= 2 inject apiserver-facing faults, which ride on the
+        # FaultyCluster wrapper — it must be in place before anything
+        # (informer, kubelet, job client) binds to the backend
+        from k8s_tpu.runtime.chaos import FaultyCluster
+
+        faulty = FaultyCluster(client.cluster)
+        client = KubeClient(faulty)
     job_client = TpuJobClient(client.cluster)
 
     health = None
@@ -121,7 +137,11 @@ def main(argv=None) -> int:
         if args.chaos_level >= 0:
             from k8s_tpu.runtime.chaos import ChaosMonkey
 
-            ChaosMonkey(client, level=args.chaos_level).start()
+            ChaosMonkey.from_level(
+                client, args.chaos_level, seed=args.chaos_seed,
+                interval=args.chaos_interval, faulty=faulty,
+                lease_namespace=namespace,
+            ).start()
         controller.start()
         while not stop.is_set() and not lost.is_set():
             stop.wait(0.5)

@@ -807,8 +807,9 @@ def flash_attention_sharded(
     (heads AND kv_heads both cut by tensor), so local group structure
     is preserved.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from k8s_tpu.utils import shard_map_compat
 
     # loud up-front divisibility checks: a mismatch otherwise surfaces
     # deep inside shard_map as an opaque sharding error (e.g. BERT's 12
@@ -843,7 +844,7 @@ def flash_attention_sharded(
         )
 
     in_specs = (spec, spec, spec) + ((seg_spec,) if with_seg else ())
-    wrapped = shard_map(
+    wrapped = shard_map_compat(
         body, mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False,
     )

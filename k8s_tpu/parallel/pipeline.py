@@ -26,6 +26,8 @@ from functools import partial
 from typing import Any, Callable
 
 import jax
+
+from k8s_tpu.utils import axis_size_compat
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -37,7 +39,7 @@ def _stage_body(
     fn: Callable,
     axis_name: str,
 ):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     steps = m + n - 1
@@ -121,7 +123,7 @@ def pipeline_apply(
     (leaves ``[n_stages, ...]``, fn sees one layer's params);
     ``False`` hands fn the full local ``[layers_per_stage, ...]`` slab
     to scan over itself (the transformer-stack case)."""
-    from jax import shard_map
+    from k8s_tpu.utils import shard_map_compat
 
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
@@ -158,7 +160,7 @@ def pipeline_apply(
         aux_spec = P(batch_axes, *([None] * (aux.ndim - 1)))
         in_specs = (param_specs, x_spec, aux_spec)
         operands = (stacked_params, x, aux)
-    return shard_map(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=in_specs,

@@ -40,6 +40,8 @@ from functools import partial
 from typing import Optional
 
 import jax
+
+from k8s_tpu.utils import axis_size_compat
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -72,7 +74,7 @@ def ring_attention_sharded(
     _, sk, hkv, _ = k.shape
     groups = hq // hkv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = jax.lax.axis_index(axis_name)
 
     qf = (q.astype(jnp.float32) * scale).reshape(b, sq, hkv, groups, d)
@@ -151,7 +153,7 @@ def _merge_partial(out_acc, lse_acc, out_i, lse_i):
 
 
 def _rotate(x, axis_name: str):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     return jax.lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
@@ -168,8 +170,11 @@ def _ring_flash_fwd(
     q, k, v, seg, axis_name, causal, scale, block_q, block_k, interpret
 ):
     b, sq, hq, d = q.shape
-    n = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    n = axis_size_compat(axis_name)
+    # only the causal mask needs the device's ring position; an unused
+    # axis_index leaves a dangling partition-id op that the SPMD
+    # partitioner rejects on jax 0.4.x
+    my = jax.lax.axis_index(axis_name) if causal else None
     with_seg = seg is not None
 
     def block_fwd(k_blk, v_blk, seg_blk, blk_causal):
@@ -193,8 +198,8 @@ def _ring_flash_fwd(
         k_cur = _rotate(k_cur, axis_name)
         v_cur = _rotate(v_cur, axis_name)
         seg_cur = _rotate(seg_cur, axis_name) if with_seg else seg_cur
-        src = (my - step) % n  # owner of the chunk now resident
         if causal:
+            src = (my - step) % n  # owner of the chunk now resident
             # past chunks attend fully; future chunks contribute nothing
             out_i, lse_i = jax.lax.cond(
                 src < my,
@@ -224,8 +229,9 @@ def _ring_flash_bwd(
 ):
     q, k, v, seg, out, lse = res
     b, sq, hq, d = q.shape
-    n = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    n = axis_size_compat(axis_name)
+    # see _ring_flash_fwd: axis_index only when the causal mask uses it
+    my = jax.lax.axis_index(axis_name) if causal else None
     with_seg = seg is not None
     dd = compute_dd(out, g)  # GLOBAL rowsum(dO*O) — not per-chunk
 
@@ -252,13 +258,13 @@ def _ring_flash_bwd(
         seg_cur = _rotate(seg_cur, axis_name) if with_seg else seg_cur
         dk_cur = _rotate(dk_cur, axis_name)
         dv_cur = _rotate(dv_cur, axis_name)
-        src = (my - step) % n
 
         def compute():
             dq_i, dk_i, dv_i = block_bwd(k_cur, v_cur, seg_cur, False)
             return dq_acc + dq_i, dk_cur + dk_i, dv_cur + dv_i
 
         if causal:
+            src = (my - step) % n
             dq_acc, dk_cur, dv_cur = jax.lax.cond(
                 src < my, compute, lambda: (dq_acc, dk_cur, dv_cur)
             )
@@ -338,13 +344,13 @@ def seq_parallel_call(
     ``segment_ids`` the body takes them as a 4th arg, sharded
     ``[batch@data/fsdp, length@seq]``; returns the ready-to-call
     closure over (q, k, v)."""
-    from jax import shard_map
+    from k8s_tpu.utils import shard_map_compat
 
     spec = P(batch_axes, axis_name, head_axis, None)
     seg_spec = P(batch_axes, axis_name)
     with_segments = segment_ids is not None
     in_specs = (spec, spec, spec) + ((seg_spec,) if with_segments else ())
-    wrapped = shard_map(
+    wrapped = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=in_specs,

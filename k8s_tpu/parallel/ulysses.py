@@ -31,6 +31,8 @@ from functools import partial
 from typing import Optional
 
 import jax
+
+from k8s_tpu.utils import axis_size_compat
 from jax.sharding import Mesh
 
 from k8s_tpu.ops.attention import flash_attention
@@ -51,7 +53,7 @@ def ulysses_attention_sharded(
     all-to-all each device holds the FULL sequence for its head
     subset, so packed/padded masking just needs the full segment row:
     one cheap int all-gather."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if hq % n or hkv % n:
         raise ValueError(

@@ -206,6 +206,9 @@ def main(rdzv) -> None:
     if mgr is not None:
         mark_preempt_aware()
     start = int(state.step)
+    # losses stay DEVICE arrays in the loop: float() forces a
+    # device-to-host sync every step, serializing async dispatch — the
+    # host only blocks at log points and after the loop
     first_loss = final_loss = None
     for step in range(start + 1, cfg.steps + 1):
         if step_sleep:
@@ -213,14 +216,17 @@ def main(rdzv) -> None:
 
             _time.sleep(step_sleep)
         state, metrics = step_fn(state, next(data), rng)
-        final_loss = float(metrics["loss"])
+        final_loss = metrics["loss"]
         if first_loss is None:
             first_loss = final_loss
         if step % cfg.log_every == 0 or step == cfg.steps:
-            logger.log(step, {"loss": final_loss})
+            logger.log(step, {"loss": float(final_loss)})
         maybe_preempt_exit(mgr, rdzv, step, state)
         if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
+    if first_loss is not None:
+        first_loss = float(first_loss)
+        final_loss = float(final_loss)
     if mgr is not None:
         mgr.save(cfg.steps, state, force=True)
         mgr.wait()

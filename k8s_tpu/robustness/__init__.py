@@ -1,0 +1,16 @@
+"""Robustness layer: the single retry/backoff policy every retry site
+in the operator routes through, plus helpers for fault-tolerant calls.
+
+Before this package existed, backoff logic was scattered ad-hoc
+(RestWatcher re-dials, informer relists, the controller's fixed 30s
+init retry) and gang restarts fired back-to-back with **zero** delay —
+a crashing-image job would burn its whole ``maxGangRestarts`` budget in
+under a minute (a restart storm). Everything now shares
+:class:`~k8s_tpu.robustness.backoff.Backoff`.
+"""
+
+from k8s_tpu.robustness.backoff import (  # noqa: F401
+    Backoff,
+    BackoffPolicy,
+    retry_call,
+)

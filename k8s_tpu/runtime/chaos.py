@@ -1,11 +1,36 @@
-"""Chaos monkey: random pod killing for fault-injection testing.
+"""Chaos matrix: pluggable fault injection for robustness testing.
 
-The reference designed for this but shipped it disabled (commented-out
+The reference designed for chaos but shipped it disabled (commented-out
 monkey + unused ``--chaos-level`` flag, ``cmd/tf_operator/main.go:50,
-171-207``; "TODO add chaos" in ``py/test_runner.py:64``). Here it is a
-working subsystem: at a rate set by the level, it force-fails a random
-running pod with a retryable exit code (137, SIGKILL-class), which
-exercises the gang-restart path end-to-end.
+171-207``; "TODO add chaos" in ``py/test_runner.py:64``) and the first
+reproduction covered exactly one fault class (pod SIGKILL). This module
+generalizes it into a **matrix** — every recovery path the operator
+claims gets an injector that exercises it:
+
+==================  =====================================================
+fault class         recovery path exercised
+==================  =====================================================
+pod-kill            retryable-exit classification → gang restart
+                    (+ restart backoff storm protection)
+api-flake           transient-apiserver-error retries: reconciler tick
+                    survival, kubelet status-write retry_call
+watch-drop          forced 410 Gone → informer relist / controller
+                    relist-after-410 (both through the unified Backoff)
+slow-handler        injected API latency inside event handling → the
+                    controller watchdog + pump re-init requeue
+checkpoint-save     CheckpointManager.save retry_call via the fault hook
+lease-loss          stolen leader lease → renew CAS conflict → concede →
+                    re-acquire after expiry
+==================  =====================================================
+
+Every injector is seeded-RNG-driven and individually rate-controlled;
+:class:`ChaosMonkey` schedules them (``tick()`` once per interval, or
+driven manually by the soak test for determinism). ``--chaos-level``
+profiles in ``operator.py`` pick a subset.
+
+The apiserver-facing faults ride on :class:`FaultyCluster`, a wrapper
+around any cluster backend (in-memory or REST) that the whole control
+plane — client, informer, kubelet — talks through unmodified.
 """
 
 from __future__ import annotations
@@ -13,36 +38,219 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from k8s_tpu.api import errors
 from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.objects import ContainerState, ContainerStateTerminated
+from k8s_tpu.controller import metrics
 
 log = logging.getLogger(__name__)
 
 
-class ChaosMonkey:
-    def __init__(
-        self,
-        client: KubeClient,
-        level: int = 0,
-        interval: float = 30.0,
-        seed: Optional[int] = None,
-    ):
-        self.client = client
-        self.level = level
-        self.interval = interval
-        self.rng = random.Random(seed)
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.kills = 0
+# ---------------------------------------------------------------------------
+# Fault-wrapping cluster backend
+# ---------------------------------------------------------------------------
 
-    def kill_one(self) -> Optional[str]:
-        """Force-fail one random running pod (exit 137 = SIGKILL)."""
+
+class _DroppableWatcher:
+    """Watcher wrapper that can be forced stale: after ``mark_stale()``
+    the next ``next()``/iteration raises OutdatedVersionError — exactly
+    what a compacted resourceVersion (410 Gone) looks like."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._stale = threading.Event()
+
+    def mark_stale(self) -> None:
+        self._stale.set()
+
+    def _check(self) -> None:
+        if self._stale.is_set():
+            self._stale.clear()  # one 410 per drop; the relist recovers
+            raise errors.OutdatedVersionError("chaos: injected watch drop")
+
+    def next(self, timeout: Optional[float] = None):
+        self._check()
+        return self._inner.next(timeout=timeout)
+
+    def __iter__(self):
+        while True:
+            self._check()
+            ev = self._inner.next(timeout=0.2)
+            if ev is None:
+                if getattr(self._inner, "closed", False):
+                    return
+                continue
+            yield ev
+
+    def stop(self) -> None:
+        self._inner.stop()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+class FaultyCluster:
+    """Fault-injecting proxy over a cluster backend (the InMemoryCluster
+    method surface). Passes everything through; armed faults fire on the
+    next API call(s):
+
+    - :meth:`arm_api_errors` — the next N calls raise a transient
+      ``ApiError`` (an apiserver 500/timeout);
+    - :meth:`arm_delay` — the next N calls sleep first (a browned-out
+      apiserver / slow handler);
+    - :meth:`drop_watches` — every live watch stream raises 410 Gone.
+
+    Counters (``api_errors_injected`` …) let the soak assert each fault
+    class actually fired.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._lock = threading.Lock()
+        self._armed_errors = 0
+        self._armed_delays = 0
+        self._delay_seconds = 0.0
+        self._watchers: List[_DroppableWatcher] = []
+        self.api_errors_injected = 0
+        self.delays_injected = 0
+        self.watch_drops_injected = 0
+
+    # -- arming ----------------------------------------------------------
+
+    def arm_api_errors(self, n: int = 1) -> None:
+        with self._lock:
+            self._armed_errors += n
+
+    def arm_delay(self, seconds: float, n: int = 1) -> None:
+        with self._lock:
+            self._delay_seconds = seconds
+            self._armed_delays += n
+
+    def drop_watches(self) -> int:
+        """Force 410 on every live watch stream; returns how many."""
+        with self._lock:
+            live = [w for w in self._watchers if not getattr(w, "closed", False)]
+            self._watchers = live
+            for w in live:
+                w.mark_stale()
+            self.watch_drops_injected += len(live)
+            return len(live)
+
+    # -- the fault gate every call passes --------------------------------
+
+    def _before(self, op: str) -> None:
+        delay = 0.0
+        err = False
+        with self._lock:
+            if self._armed_delays > 0:
+                self._armed_delays -= 1
+                delay = self._delay_seconds
+                self.delays_injected += 1
+            if self._armed_errors > 0:
+                self._armed_errors -= 1
+                self.api_errors_injected += 1
+                err = True
+        if delay > 0:
+            time.sleep(delay)
+        if err:
+            raise errors.ApiError(f"chaos: injected transient apiserver error ({op})")
+
+    # -- proxied surface -------------------------------------------------
+
+    def create(self, kind, obj):
+        self._before(f"create {kind}")
+        return self._inner.create(kind, obj)
+
+    def get(self, kind, namespace, name):
+        self._before(f"get {kind}")
+        return self._inner.get(kind, namespace, name)
+
+    def update(self, kind, obj, check_version: bool = False):
+        self._before(f"update {kind}")
+        return self._inner.update(kind, obj, check_version=check_version)
+
+    def delete(self, kind, namespace, name, cascade: bool = True):
+        self._before(f"delete {kind}")
+        return self._inner.delete(kind, namespace, name, cascade=cascade)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self._before(f"list {kind}")
+        return self._inner.list(kind, namespace, label_selector)
+
+    def delete_collection(self, kind, namespace, label_selector):
+        self._before(f"delete_collection {kind}")
+        return self._inner.delete_collection(kind, namespace, label_selector)
+
+    def watch(self, kind, namespace=None, resource_version=None):
+        w = _DroppableWatcher(
+            self._inner.watch(kind, namespace, resource_version))
+        with self._lock:
+            self._watchers.append(w)
+        return w
+
+    def create_crd(self, name, spec):
+        return self._inner.create_crd(name, spec)
+
+    def get_crd(self, name):
+        return self._inner.get_crd(name)
+
+    @property
+    def resource_version(self):
+        return self._inner.resource_version
+
+    @property
+    def hooks(self):
+        # the kubelet simulator / sync informer hang off these
+        return self._inner.hooks
+
+    def __getattr__(self, name: str) -> Any:
+        # anything else (list_with_rv, pod_log, _lock for the informer's
+        # sync-feed flip, ...) passes straight through
+        return getattr(self._inner, name)
+
+
+# ---------------------------------------------------------------------------
+# Injectors
+# ---------------------------------------------------------------------------
+
+
+class FaultInjector:
+    """One fault class: seeded-RNG-driven, individually rate-controlled.
+    ``rate`` is the probability of firing per scheduler tick."""
+
+    name = "fault"
+
+    def __init__(self, rate: float = 1.0, seed: Optional[int] = None):
+        self.rate = rate
+        self.rng = random.Random(seed)
+        self.injected = 0
+
+    def maybe_fire(self) -> Optional[str]:
+        if self.rng.random() >= self.rate:
+            return None
+        return self.fire()
+
+    def fire(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+class PodKillFault(FaultInjector):
+    """Force-fail one random running pod with a retryable exit (137 =
+    SIGKILL) — exercises exit-code classification + gang restart."""
+
+    name = "pod-kill"
+
+    def __init__(self, client: KubeClient, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.client = client
+
+    def fire(self) -> Optional[str]:
         pods = [
-            p
-            for p in self.client.pods.list()
+            p for p in self.client.pods.list()
             if p.status.phase == "Running"
         ]
         if not pods:
@@ -57,17 +265,251 @@ class ChaosMonkey:
             self.client.pods.update(victim)
         except errors.NotFoundError:
             return None
-        self.kills += 1
-        log.info("chaos: killed pod %s", victim.metadata.name)
+        self.injected += 1
+        log.info("chaos[%s]: killed pod %s", self.name, victim.metadata.name)
         return victim.metadata.name
+
+
+class ApiFlakeFault(FaultInjector):
+    """Arm transient apiserver 500s on the next ``burst`` API calls."""
+
+    name = "api-flake"
+
+    def __init__(self, faulty: FaultyCluster, rate: float = 1.0,
+                 seed: Optional[int] = None, burst: int = 1):
+        super().__init__(rate, seed)
+        self.faulty = faulty
+        self.burst = burst
+
+    def fire(self) -> str:
+        n = 1 + self.rng.randrange(self.burst)
+        self.faulty.arm_api_errors(n)
+        self.injected += 1
+        log.info("chaos[%s]: armed %d transient API errors", self.name, n)
+        return f"{n} errors"
+
+
+class WatchDropFault(FaultInjector):
+    """Force 410 Gone on every live watch stream — exercises the
+    informer relist / controller relist-after-410 path."""
+
+    name = "watch-drop"
+
+    def __init__(self, faulty: FaultyCluster, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.faulty = faulty
+
+    def fire(self) -> Optional[str]:
+        n = self.faulty.drop_watches()
+        if n == 0:
+            return None
+        self.injected += 1
+        log.info("chaos[%s]: dropped %d watch streams", self.name, n)
+        return f"{n} streams"
+
+
+class SlowHandlerFault(FaultInjector):
+    """Inject latency into the next API call(s): a handler that touches
+    the apiserver inside the event pump then overruns the watchdog."""
+
+    name = "slow-handler"
+
+    def __init__(self, faulty: FaultyCluster, rate: float = 1.0,
+                 seed: Optional[int] = None, delay: float = 0.5, burst: int = 1):
+        super().__init__(rate, seed)
+        self.faulty = faulty
+        self.delay = delay
+        self.burst = burst
+
+    def fire(self) -> str:
+        self.faulty.arm_delay(self.delay, n=self.burst)
+        self.injected += 1
+        log.info("chaos[%s]: armed %.2fs delay on next %d API calls",
+                 self.name, self.delay, self.burst)
+        return f"{self.delay}s"
+
+
+class CheckpointSaveFault(FaultInjector):
+    """Fail the next checkpoint-save attempt(s) process-wide via the
+    hook in :mod:`k8s_tpu.train.checkpoint` — exercises the save
+    retry_call."""
+
+    name = "checkpoint-save"
+
+    def __init__(self, rate: float = 1.0, seed: Optional[int] = None,
+                 burst: int = 1):
+        super().__init__(rate, seed)
+        self.burst = burst
+
+    def fire(self) -> str:
+        from k8s_tpu.train import checkpoint
+
+        n = 1 + self.rng.randrange(self.burst)
+        checkpoint.arm_save_faults(n)
+        self.injected += 1
+        log.info("chaos[%s]: armed %d save failures", self.name, n)
+        return f"{n} saves"
+
+
+class LeaseLossFault(FaultInjector):
+    """Steal the leader-election lock: overwrite the lease annotation
+    with a chaos holder so the real leader's CAS renew conflicts and it
+    concedes — then re-acquires once the stolen lease expires."""
+
+    name = "lease-loss"
+
+    def __init__(self, cluster, namespace: str = "default",
+                 lock_name: str = "tpu-operator", rate: float = 1.0,
+                 seed: Optional[int] = None, lease_duration: float = 1.0):
+        super().__init__(rate, seed)
+        self.cluster = cluster
+        self.namespace = namespace
+        self.lock_name = lock_name
+        self.lease_duration = lease_duration
+
+    def fire(self) -> Optional[str]:
+        from k8s_tpu.api.election import LEADER_ANNOTATION, LOCK_KIND, \
+            LeaderElectionRecord
+
+        try:
+            lock = self.cluster.get(LOCK_KIND, self.namespace, self.lock_name)
+        except errors.ApiError:
+            return None  # no election running — nothing to steal
+        now = time.monotonic()
+        rec = LeaderElectionRecord(
+            holder_identity="chaos-monkey",
+            lease_duration_seconds=self.lease_duration,
+            acquire_time=now,
+            renew_time=now,
+        )
+        lock["metadata"].setdefault("annotations", {})[
+            LEADER_ANNOTATION] = rec.to_json()
+        try:
+            self.cluster.update(LOCK_KIND, lock, check_version=True)
+        except errors.ApiError:
+            return None  # lost the race — the leader renewed first
+        self.injected += 1
+        log.info("chaos[%s]: stole leader lease %s/%s",
+                 self.name, self.namespace, self.lock_name)
+        return self.lock_name
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+class ChaosMonkey:
+    """Schedules a set of injectors. Backwards compatible with the
+    pod-kill-only monkey: ``ChaosMonkey(client, level=1)`` still kills
+    pods, ``kill_one()``/``kills`` still work. ``tick()`` fires one
+    scheduling round — the soak test drives it manually for
+    reproducibility; ``start()`` runs it on a wall-clock interval."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        level: int = 0,
+        interval: float = 30.0,
+        seed: Optional[int] = None,
+        injectors: Optional[List[FaultInjector]] = None,
+    ):
+        self.client = client
+        self.level = level
+        self.interval = interval
+        self.rng = random.Random(seed)
+        self._pod_kill = PodKillFault(
+            client, rate=1.0, seed=self.rng.randrange(2**32))
+        self.injectors: List[FaultInjector] = (
+            list(injectors) if injectors is not None else [self._pod_kill]
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    # -- profiles --------------------------------------------------------
+
+    @classmethod
+    def from_level(
+        cls,
+        client: KubeClient,
+        level: int,
+        seed: Optional[int] = None,
+        interval: float = 30.0,
+        faulty: Optional[FaultyCluster] = None,
+        lease_namespace: str = "default",
+    ) -> "ChaosMonkey":
+        """``--chaos-level`` profiles. Levels are cumulative:
+
+        - 0: gentle pod kills (25% per tick)
+        - 1: aggressive pod kills (every tick)
+        - 2: + apiserver flakes, watch drops, slow handlers (needs the
+          FaultyCluster wrapper; silently narrower without one)
+        - 3+: + checkpoint-save failures, leader-lease loss
+        """
+        rng = random.Random(seed)
+
+        def s() -> int:
+            return rng.randrange(2**32)
+
+        inj: List[FaultInjector] = [
+            PodKillFault(client, rate=0.25 if level == 0 else 1.0, seed=s())
+        ]
+        if level >= 2 and faulty is not None:
+            inj += [
+                ApiFlakeFault(faulty, rate=0.5, seed=s(), burst=3),
+                WatchDropFault(faulty, rate=0.3, seed=s()),
+                SlowHandlerFault(faulty, rate=0.3, seed=s(), delay=0.5),
+            ]
+        if level >= 3:
+            inj.append(CheckpointSaveFault(rate=0.5, seed=s(), burst=2))
+            inj.append(LeaseLossFault(
+                client.cluster, namespace=lease_namespace, rate=0.2, seed=s()))
+        return cls(client, level=level, interval=interval, seed=s(),
+                   injectors=inj)
+
+    # -- back-compat pod-kill surface ------------------------------------
+
+    def kill_one(self) -> Optional[str]:
+        """Force-fail one random running pod (exit 137 = SIGKILL)."""
+        victim = self._pod_kill.fire()
+        if victim is not None:
+            self.kills += 1
+        return victim
+
+    # -- scheduling ------------------------------------------------------
+
+    def tick(self) -> Dict[str, int]:
+        """One scheduling round: every injector rolls its rate die.
+        Returns {injector name: total injected so far}."""
+        for inj in self.injectors:
+            try:
+                fired = inj.maybe_fire()
+            except Exception as e:  # an injector bug must not kill chaos
+                log.error("chaos[%s]: injector error: %s", inj.name, e)
+                continue
+            if fired is not None:
+                metrics.CHAOS_FAULTS.inc({"fault": inj.name})
+                if isinstance(inj, PodKillFault):
+                    self.kills += 1
+        return self.stats()
+
+    def stats(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for inj in self.injectors:
+            out[inj.name] = out.get(inj.name, 0) + inj.injected
+        return out
 
     def _loop(self):
         while not self._stop.is_set():
             self._stop.wait(self.interval)
             if self._stop.is_set():
                 return
-            for _ in range(max(1, self.level)):
-                self.kill_one()
+            # exactly ONE scheduling round per interval: aggressiveness
+            # lives in each injector's rate (from_level), not in a tick
+            # multiplier that would silently scale every documented rate
+            self.tick()
 
     def start(self):
         if self.level < 0:

@@ -264,8 +264,19 @@ class LocalKubelet:
         """Deferred retry for a recreated same-name Job: only launch if
         the Job object still exists (it may have been deleted again)."""
         try:
-            current = self.client.jobs.get(job.metadata.namespace, job.metadata.name)
+            current = self._retry_api(
+                "relaunch job read",
+                lambda: self.client.jobs.get(
+                    job.metadata.namespace, job.metadata.name))
+        except errors.NotFoundError:
+            return  # deleted again — nothing to relaunch
         except errors.ApiError:
+            # still flaking after the in-line retries: reschedule
+            # instead of silently abandoning the recreated Job — an
+            # abandoned launch strands the whole gang forever
+            t = threading.Timer(0.25, self._relaunch_if_current, args=(job,))
+            t.daemon = True
+            t.start()
             return
         if current.metadata.uid == job.metadata.uid:
             self._maybe_launch(current)
@@ -297,6 +308,13 @@ class LocalKubelet:
             self._materialize_volumes(pod, ns)
             env = self._pod_env(pod, ns)
             exit_code = self.executor.execute(pod, env, stop)
+            killed = self._external_kill_code(ns, pod_name)
+            if killed is not None:
+                # an external agent (chaos pod-kill, a simulated node
+                # failure) marked the pod Failed while we ran it — on a
+                # real node the container died with that code and the
+                # kubelet reports IT, not the workload's exit status
+                exit_code = killed
             terminated = ContainerStateTerminated(exit_code=exit_code)
             self._finish_pod(ns, pod_name, terminated, restarts)
             if exit_code == 0:
@@ -308,6 +326,26 @@ class LocalKubelet:
                 self._update_job_status(ns, job.metadata.name, succeeded=False)
                 return
             restarts += 1
+
+    def _external_kill_code(self, ns: str, pod_name: str) -> Optional[int]:
+        """Non-zero exit code if something OTHER than this kubelet
+        (chaos pod-kill, node-failure simulation) marked the pod Failed
+        while its workload ran; None when the pod is untouched/gone."""
+        try:
+            pod = self._retry_api(
+                "kill check read",
+                lambda: self.client.pods.get(ns, pod_name))
+        except errors.ApiError:
+            # gone, or still erroring after the transient retries: an
+            # unreadable pod is treated as untouched
+            return None
+        if pod.status.phase != "Failed":
+            return None
+        for cs in pod.status.container_statuses:
+            t = cs.state.terminated if cs.state else None
+            if t is not None and t.exit_code != 0:
+                return t.exit_code
+        return None
 
     def _create_pod(
         self, job: Job, pod_name: str, restarts: int, last_state: Optional[ContainerState]
@@ -342,9 +380,12 @@ class LocalKubelet:
             ),
         )
         try:
-            return self.client.pods.create(pod)
+            return self._retry_api(
+                "pod create", lambda: self.client.pods.create(pod))
         except errors.AlreadyExistsError:
-            return self.client.pods.get(job.metadata.namespace, pod_name)
+            return self._retry_api(
+                "pod adopt read",
+                lambda: self.client.pods.get(job.metadata.namespace, pod_name))
         except errors.ApiError as e:
             log.error("pod create failed: %s", e)
             return None
@@ -363,7 +404,10 @@ class LocalKubelet:
             if v.config_map is None:
                 continue
             try:
-                cm = self.client.config_maps.get(namespace, v.config_map.name)
+                cm = self._retry_api(
+                    "configmap read",
+                    lambda: self.client.config_maps.get(
+                        namespace, v.config_map.name))
             except errors.NotFoundError:
                 continue
             d = tempfile.mkdtemp(prefix=f"ktpu-vol-{v.name}-")
@@ -395,15 +439,36 @@ class LocalKubelet:
         )
         env = container.env_dict() if container else {}
         service_names = [
-            s.metadata.name for s in self.client.services.list(namespace)
+            s.metadata.name
+            for s in self._retry_api(
+                "service list", lambda: self.client.services.list(namespace))
         ]
         return self.resolver.rewrite_env(env, service_names)
+
+    def _retry_api(self, what: str, fn):
+        """Route a status write through the unified backoff policy: a
+        transient apiserver error (real 5xx/429 or a chaos api-flake)
+        must not lose the exit-code/succeeded bookkeeping the control
+        plane classifies restarts from. Semantic errors (404 etc.)
+        surface immediately for the call site to handle."""
+        from k8s_tpu.robustness.backoff import BackoffPolicy, retry_call
+
+        return retry_call(
+            fn,
+            policy=BackoffPolicy(base=0.1, cap=2.0, jitter=0.5, reset_after=0.0),
+            max_attempts=4,
+            should_retry=errors.is_transient,
+            on_retry=lambda a, e, d: log.warning(
+                "kubelet %s: transient API error (%s); retry in %.2fs",
+                what, e, d),
+        )
 
     def _finish_pod(
         self, ns: str, pod_name: str, terminated: ContainerStateTerminated, restarts: int
     ) -> None:
         try:
-            pod = self.client.pods.get(ns, pod_name)
+            pod = self._retry_api(
+                "pod status read", lambda: self.client.pods.get(ns, pod_name))
         except errors.NotFoundError:
             return
         pod.status.phase = "Succeeded" if terminated.exit_code == 0 else "Failed"
@@ -412,13 +477,15 @@ class LocalKubelet:
                 cs.state = ContainerState(terminated=terminated)
                 cs.restart_count = restarts
         try:
-            self.client.pods.update(pod)
+            self._retry_api(
+                "pod status write", lambda: self.client.pods.update(pod))
         except errors.NotFoundError:
             pass
 
     def _update_job_status(self, ns: str, name: str, succeeded: bool) -> None:
         try:
-            job = self.client.jobs.get(ns, name)
+            job = self._retry_api(
+                "job status read", lambda: self.client.jobs.get(ns, name))
         except errors.NotFoundError:
             return
         if succeeded:
@@ -428,6 +495,7 @@ class LocalKubelet:
             job.status.failed += 1
             job.status.active = 0
         try:
-            self.client.jobs.update(job)
+            self._retry_api(
+                "job status write", lambda: self.client.jobs.update(job))
         except errors.NotFoundError:
             pass

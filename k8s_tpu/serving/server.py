@@ -60,7 +60,8 @@ class ServingFrontend:
         self._results: Dict[int, object] = {}
         self._work = threading.Event()   # poked by submissions
         self._draining = False
-        self.served = 0                  # completed requests, lifetime
+        self.served = 0                  # results DELIVERED to a waiter, lifetime
+        self.abandoned = 0               # finished after the waiter timed out
 
         frontend = self
 
@@ -88,6 +89,7 @@ class ServingFrontend:
                     "draining": frontend._draining,
                     "in_flight": in_flight,
                     "served": frontend.served,
+                    "abandoned": frontend.abandoned,
                     "stats": {k: round(v, 4) if isinstance(v, float) else v
                               for k, v in frontend.engine.stats.items()},
                 })
@@ -162,12 +164,15 @@ class ServingFrontend:
         with self._lock:
             for rid, req in done.items():
                 ev = self._waiters.pop(rid, None)
-                self.served += 1
                 if ev is not None:
-                    # no waiter ⇒ the client timed out and left: drop
-                    # the tokens instead of accumulating them forever
+                    self.served += 1
                     self._results[rid] = np.asarray(req.tokens, np.int32)
                     ev.set()
+                else:
+                    # no waiter ⇒ the client timed out and left: drop
+                    # the tokens instead of accumulating them forever —
+                    # and don't count undelivered work as served
+                    self.abandoned += 1
 
     def serve(self, should_stop) -> None:
         """Run the pump until ``should_stop()`` — then drain and close.

@@ -25,6 +25,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     ChiefSpec,
     ReplicaState,
     ReplicaStatus,
+    RestartBackoffSpec,
     TensorBoardSpec,
     TerminationPolicySpec,
     TpuJob,

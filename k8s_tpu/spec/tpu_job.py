@@ -156,6 +156,42 @@ class TerminationPolicySpec(K8sObject):
 
 @register_type
 @dataclass
+class RestartBackoffSpec(K8sObject):
+    """Per-job gang-restart backoff schedule (CrashLoopBackOff-style).
+
+    Consecutive gang restarts are spaced ``baseSeconds * factor**n``
+    apart (capped at ``capSeconds``, jittered by ``jitter``); a stable
+    run of ``resetAfterSeconds`` clears the streak. Routed through
+    :class:`k8s_tpu.robustness.backoff.Backoff` — the same policy every
+    other retry site in the operator uses."""
+
+    base_seconds: float = 10.0
+    factor: float = 2.0
+    cap_seconds: float = 300.0
+    jitter: float = 0.5
+    reset_after_seconds: float = 600.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_policy(self):
+        from k8s_tpu.robustness.backoff import BackoffPolicy
+
+        return BackoffPolicy(
+            base=self.base_seconds,
+            factor=self.factor,
+            cap=self.cap_seconds,
+            jitter=self.jitter,
+            reset_after=self.reset_after_seconds,
+        )
+
+    def validate(self) -> None:
+        try:
+            self.to_policy().validate()
+        except ValueError as e:
+            raise ValidationError(f"restartBackoff: {e}") from e
+
+
+@register_type
+@dataclass
 class TpuJobSpec(K8sObject):
     runtime_id: str = field(default="", metadata={"json": "RuntimeId"})
     tensorboard: Optional[TensorBoardSpec] = None
@@ -169,6 +205,10 @@ class TpuJobSpec(K8sObject):
     # controller (replicas.go:216-229) — wrong for TPU slices, where
     # one host's death must restart every process of the slice together.
     max_gang_restarts: int = 3
+    # Inter-restart spacing for the gang budget above: without it a
+    # crash-looping image burns the whole budget in seconds (restart
+    # storm). None → defaulted in set_defaults().
+    restart_backoff: Optional[RestartBackoffSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -211,6 +251,10 @@ class TpuJobSpec(K8sObject):
                     "invalid termination policy, chief should have "
                     f"replicaName={COORDINATOR} and index=0"
                 )
+        if self.max_gang_restarts < 0:
+            raise ValidationError("maxGangRestarts must be >= 0")
+        if self.restart_backoff is not None:
+            self.restart_backoff.validate()
         if self.tpu is not None and self.tpu.accelerator:
             t = self.tpu.topology()
             if t is None:
@@ -257,6 +301,8 @@ class TpuJobSpec(K8sObject):
             self.termination_policy = TerminationPolicySpec(
                 chief=ChiefSpec(replica_name=COORDINATOR, replica_index=0)
             )
+        if self.restart_backoff is None:
+            self.restart_backoff = RestartBackoffSpec()
 
     # -- accelerator config (reference ConfigureAccelerators, tf_job.go:179-233)
 

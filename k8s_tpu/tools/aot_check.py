@@ -168,11 +168,22 @@ def count_collectives(hlo: str) -> Dict[str, int]:
         for op in COLLECTIVES
     }
     rs_calls = len(re.findall(r"calls=%?all-reduce-scatter", hlo))
-    rs_defs = len(re.findall(r"^%?all-reduce-scatter[\w.\-]*[\s(]", hlo,
-                             re.M))
     if rs_calls:
         counts["reduce-scatter"] += rs_calls
-        counts["all-reduce"] = max(0, counts["all-reduce"] - rs_defs)
+        # drop the representational all-reduces by counting the actual
+        # occurrences inside each matched computation BODY — a body may
+        # hold several (multi-operand fused variants) or none, so
+        # subtracting the def count miscounts either way. HLO text
+        # closes a computation with a line-leading "}"; inline braces
+        # (metadata={...}, replica_groups={...}) never start a line.
+        inner = 0
+        for m in re.finditer(
+            r"^\s*%?all-reduce-scatter[\w.\-]*\s*\(.*?\{(.*?)^\}",
+            hlo, re.M | re.S,
+        ):
+            body = m.group(1)
+            inner += body.count(" all-reduce(") + body.count(" all-reduce-start(")
+        counts["all-reduce"] = max(0, counts["all-reduce"] - inner)
     return counts
 
 

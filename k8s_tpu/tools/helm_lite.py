@@ -26,7 +26,8 @@ from typing import Dict, List, Optional
 
 import yaml
 
-_TAG = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+_TAG = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}")
+_NUMBER = re.compile(r"^-?\d+(\.\d+)?$")
 
 
 def _lookup(root: Dict, dotted: str):
@@ -56,8 +57,14 @@ def _eval_expr(expr: str, ctx: Dict) -> str:
         if pipe.startswith("default "):
             arg = pipe[len("default "):].strip()
             if val in (None, ""):
-                val = arg[1:-1] if arg.startswith('"') else _eval_expr(
-                    arg, ctx)
+                if arg.startswith('"') and arg.endswith('"'):
+                    val = arg[1:-1]
+                elif arg in ("true", "false") or _NUMBER.match(arg):
+                    # bare literals render verbatim, like real helm
+                    # (`default 3`, `default true`)
+                    val = arg
+                else:
+                    val = _eval_expr(arg, ctx)
         elif pipe == "quote":
             # escape embedded quotes/backslashes like real helm — an
             # unescaped inner quote would render invalid YAML silently,
@@ -109,7 +116,20 @@ def render_chart(
             continue  # _helpers.tpl etc. — defines only, nothing rendered
         with open(os.path.join(tdir, fname)) as f:
             text = f.read()
-        out[fname] = _TAG.sub(lambda m: _eval_expr(m.group(1), ctx), text)
+
+        def sub(m: "re.Match") -> str:
+            if m.group(1) or m.group(3):
+                # real helm's {{- -}} eats adjacent whitespace; silently
+                # rendering without the trim would diverge from helm's
+                # output — raise-loudly is this module's contract
+                raise ValueError(
+                    f"unsupported trim marker in {fname}: {m.group(0)!r} "
+                    "({{- -}} whitespace trimming is not implemented; "
+                    "use real helm for charts that need it)"
+                )
+            return _eval_expr(m.group(2), ctx)
+
+        out[fname] = _TAG.sub(sub, text)
     return out
 
 

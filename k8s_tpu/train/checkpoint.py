@@ -11,36 +11,92 @@ operator's retryable-exit gang restart.
 from __future__ import annotations
 
 import logging
-from typing import Any, Optional
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 
+from k8s_tpu.robustness.backoff import BackoffPolicy, retry_call
+
 log = logging.getLogger(__name__)
+
+# Save-retry schedule: a transient FS/metadata hiccup (GCS 503, NFS
+# blip, chaos-injected fault) is retried through the unified policy
+# instead of losing the checkpoint — the data-plane half of fault
+# tolerance must be at least as durable as the control-plane half.
+SAVE_RETRY_POLICY = BackoffPolicy(
+    base=0.2, factor=2.0, cap=5.0, jitter=0.5, reset_after=0.0
+)
+SAVE_RETRY_ATTEMPTS = 4
+
+# Chaos fault hook: called with the step at the top of every save
+# attempt; raising makes the attempt fail. Installed by the chaos
+# matrix's checkpoint-save injector (k8s_tpu.runtime.chaos), never in
+# production.
+_save_fault_lock = threading.Lock()
+SAVE_FAULT_HOOK: Optional[Callable[[int], None]] = None
+
+
+def arm_save_faults(n: int, exc: Optional[Exception] = None) -> None:
+    """Make the next ``n`` save attempts (process-wide) raise. ``n=0``
+    disarms. Used by the chaos matrix and fault tests."""
+    global SAVE_FAULT_HOOK
+    remaining = {"n": n}
+
+    def hook(step: int) -> None:
+        with _save_fault_lock:
+            if remaining["n"] <= 0:
+                return
+            remaining["n"] -= 1
+        raise exc if exc is not None else OSError(
+            f"chaos: injected checkpoint-save failure at step {step}"
+        )
+
+    SAVE_FAULT_HOOK = hook if n > 0 else None
 
 
 class CheckpointManager:
     """Thin wrapper over orbax CheckpointManager (async save)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3, save_interval_steps: int = 1):
+        import os
+
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self._preemption_poll_broken = False
         self.directory = directory
+        # KTPU_SYNC_CHECKPOINT=1 forces synchronous saves — escape hatch
+        # for runtimes where orbax's background save thread is unsafe
+        # next to other native threads (e.g. gloo CPU collectives)
+        async_ok = os.environ.get("KTPU_SYNC_CHECKPOINT", "") != "1"
         self.manager = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=True,
+                enable_async_checkpointing=async_ok,
             ),
         )
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         if step in (self.manager.all_steps() or []):
             return False  # already checkpointed at this step
-        return self.manager.save(
-            step, args=self._ocp.args.StandardSave(state), force=force
+
+        def attempt() -> bool:
+            if SAVE_FAULT_HOOK is not None:
+                SAVE_FAULT_HOOK(step)
+            return self.manager.save(
+                step, args=self._ocp.args.StandardSave(state), force=force
+            )
+
+        return retry_call(
+            attempt,
+            policy=SAVE_RETRY_POLICY,
+            max_attempts=SAVE_RETRY_ATTEMPTS,
+            on_retry=lambda a, e, d: log.warning(
+                "checkpoint save step %d attempt %d failed (%s: %s); "
+                "retry in %.2fs", step, a, type(e).__name__, e, d),
         )
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
@@ -88,12 +144,17 @@ class CheckpointManager:
         )
         item_dir = os.path.join(str(self.manager.directory), str(step),
                                 "default")
-        out = self._ocp.PyTreeCheckpointer().restore(
-            item_dir,
-            args=self._ocp.args.PyTreeRestore(
+        try:
+            args = self._ocp.args.PyTreeRestore(
                 abstract, restore_args=restore_args, partial_restore=True
-            ),
-        )
+            )
+        except TypeError:
+            # older orbax spells partial restore as transforms={}: keys
+            # missing from the template are skipped instead of read
+            args = self._ocp.args.PyTreeRestore(
+                abstract, restore_args=restore_args, transforms={}
+            )
+        out = self._ocp.PyTreeCheckpointer().restore(item_dir, args=args)
         return out["params"]
 
     def reached_preemption(self, step: int) -> bool:

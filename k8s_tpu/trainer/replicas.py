@@ -394,8 +394,32 @@ class TpuReplicaSet:
         self._tombstone(jobs)
         self._tombstone(pods)
         sel = dict(self.default_labels())
-        self.client.jobs.delete_collection(self.namespace, sel)
-        self.client.pods.delete_collection(self.namespace, sel)
+        # retry transient apiserver errors in-line: a flaked delete
+        # here leaves the gang's jobs tombstoned-but-alive — invisible
+        # to classification for a whole TOMBSTONE_TTL, wedging the
+        # restart — so the delete must be pushed through the blip
+        self._retry_transient(
+            "gang jobs delete",
+            lambda: self.client.jobs.delete_collection(self.namespace, sel))
+        self._retry_transient(
+            "gang pods delete",
+            lambda: self.client.pods.delete_collection(self.namespace, sel))
+
+    def _retry_transient(self, what: str, fn):
+        """Unified-backoff retry for teardown writes whose failure
+        wedges the gang (see delete_compute); semantic errors surface
+        immediately."""
+        from k8s_tpu.robustness.backoff import BackoffPolicy, retry_call
+
+        return retry_call(
+            fn,
+            policy=BackoffPolicy(base=0.1, cap=2.0, jitter=0.5, reset_after=0.0),
+            max_attempts=4,
+            should_retry=errors.is_transient,
+            on_retry=lambda a, e, d: log.warning(
+                "%s %s: transient API error (%s); retry in %.2fs",
+                self.spec.replica_type, what, e, d),
+        )
 
     def _list_jobs_and_pods(
         self, filter_tombstones: bool = True

@@ -16,7 +16,8 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.crd_client import TpuJobClient
@@ -33,6 +34,7 @@ from k8s_tpu.spec import (
     WORKER,
 )
 from k8s_tpu import utils
+from k8s_tpu.robustness.backoff import Backoff
 from k8s_tpu.trainer.replicas import ReplicaSetSnapshot, TpuReplicaSet
 from k8s_tpu.trainer.tensorboard import TensorBoardReplicaSet, init_tensorboard
 
@@ -68,10 +70,12 @@ class TrainingJob:
         client: KubeClient,
         job_client: TpuJobClient,
         job: TpuJob,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.client = client
         self.job_client = job_client
         self.job = job
+        self.clock = clock  # injectable: backoff spacing tests run on a fake clock
         self.status: TpuJobStatus = job.status.deepcopy()
         self.replicas: List[TpuReplicaSet] = []
         self.tensorboard: Optional[TensorBoardReplicaSet] = None
@@ -82,6 +86,11 @@ class TrainingJob:
         self._thread: Optional[threading.Thread] = None
         self._rejected_spec: Optional[dict] = None  # dedupe rejections
         self._rejected_at = 0.0
+        self._restart_backoff: Optional[Backoff] = None
+        self._backoff_waiting = False  # dedupe the BackoffRestarting condition
+        # (clock_time, delay_armed_for_the_NEXT_restart) per restart —
+        # what the soak asserts spacing from
+        self.restart_history: List[Tuple[float, float]] = []
 
     # ------------------------------------------------------------ identity
 
@@ -224,6 +233,22 @@ class TrainingJob:
             return state, statuses
         return TpuJobState.RUNNING, statuses
 
+    def restart_backoff(self) -> Backoff:
+        """The per-job gang-restart Backoff, built from the (defaulted)
+        ``restartBackoff`` spec block on first use. Seeded from the job
+        key so jitter is reproducible for a given job name."""
+        if self._restart_backoff is None:
+            import zlib
+
+            rb = self.job.spec.restart_backoff
+            policy = rb.to_policy() if rb is not None else None
+            # crc32, not hash(): str hashing is salted per interpreter,
+            # which would give a restarted operator different jitter for
+            # the same job name
+            seed = zlib.crc32(self.fullname.encode())
+            self._restart_backoff = Backoff(policy, seed=seed, clock=self.clock)
+        return self._restart_backoff
+
     def _maybe_gang_restart(
         self, snaps: Optional[List["ReplicaSetSnapshot"]] = None
     ) -> Optional[str]:
@@ -233,13 +258,17 @@ class TrainingJob:
         out of) collectives, so only a coherent whole-slice restart —
         with workers restoring from the latest checkpoint — makes
         progress. Returns ``"restarted"`` if a restart was initiated,
-        ``"exhausted"`` if the budget is spent (job must fail), or
-        ``None`` if the gang is healthy.
+        ``"backoff"`` if one is wanted but held off by the restart
+        backoff schedule (CrashLoopBackOff semantics — storm
+        protection), ``"exhausted"`` if the budget is spent (job must
+        fail), or ``None`` if the gang is healthy.
 
         The reference restarted replicas independently
         (replicas.go:216-229, README:204-214) — acceptable for
         PS/worker, wrong for TPU slices.
         """
+        from k8s_tpu.controller import metrics
+
         if snaps is None:
             snaps = self.snapshots()
         degraded = [
@@ -247,19 +276,53 @@ class TrainingJob:
             if r.is_gang and snap.degraded
         ]
         if not degraded:
+            if self._backoff_waiting:
+                # spontaneously healthy again (e.g. budget raised &
+                # pods recovered) — leave the waiting state quietly
+                self._backoff_waiting = False
+            metrics.GANG_RESTART_BACKOFF.set(
+                self.restart_backoff().remaining(), {"job": self.fullname})
             return None
         if self.status.gang_restarts >= self.job.spec.max_gang_restarts:
+            # budget spent: fail fast — there is no restart left to space
             names = [f"{r.spec.replica_type}{idxs}" for r, idxs in degraded]
             self.status.reason = (
                 f"gang restart budget exhausted "
                 f"({self.job.spec.max_gang_restarts}) after {names}"
             )
             return "exhausted"
+        bo = self.restart_backoff()
+        remaining = bo.remaining()  # also applies the stable-window reset
+        metrics.GANG_RESTART_BACKOFF.set(remaining, {"job": self.fullname})
+        if remaining > 0:
+            if not self._backoff_waiting:
+                self._backoff_waiting = True
+                metrics.GANG_RESTARTS_DELAYED.inc({"job": self.fullname})
+                self.status.append_condition(
+                    "BackoffRestarting",
+                    reason=f"gang restart {self.status.gang_restarts + 1} "
+                           f"held for {remaining:.1f}s "
+                           f"(consecutive failures: {bo.failures})",
+                )
+                log.info(
+                    "job %s: gang restart held %.1fs by backoff "
+                    "(failure streak %d)",
+                    self.fullname, remaining, bo.failures,
+                )
+            return "backoff"
+        self._backoff_waiting = False
         self.status.gang_restarts += 1
+        # arm the hold-off for the NEXT restart and record this one's
+        # timestamp — the soak asserts consecutive restarts are spaced
+        # by at least the delay armed here
+        next_delay = bo.note_failure()
+        self.restart_history.append((self.clock(), next_delay))
+        metrics.GANG_RESTART_BACKOFF.set(next_delay, {"job": self.fullname})
         self.status.append_condition(
             "GangRestart",
             reason=f"retryable worker exit at "
-                   f"{[(r.spec.replica_type, i) for r, i in degraded]}",
+                   f"{[(r.spec.replica_type, i) for r, i in degraded]}; "
+                   f"next restart backed off {next_delay:.1f}s",
         )
         log.warning(
             "job %s: gang restart %d/%d (degraded: %s)",
@@ -267,9 +330,7 @@ class TrainingJob:
             self.job.spec.max_gang_restarts,
             [(r.spec.replica_type, i) for r, i in degraded],
         )
-        self.client.record_event(
-            self.job.metadata.namespace,
-            {"kind": "TpuJob", "name": self.name},
+        self._record_event(
             "GangRestart",
             f"restarting all gang pods "
             f"({self.status.gang_restarts}/{self.job.spec.max_gang_restarts})",
@@ -284,15 +345,36 @@ class TrainingJob:
                     log.error("job %s: gang teardown: %s", self.fullname, e)
         return "restarted"
 
+    def _record_event(self, reason: str, message: str,
+                      etype: str = "Normal") -> None:
+        """Best-effort event write: a transient apiserver error must
+        never crash the reconciler over observability — the status
+        transition the event describes is what matters, and it persists
+        through update_crd_status's own retry-next-tick path."""
+        try:
+            self.client.record_event(
+                self.job.metadata.namespace,
+                {"kind": "TpuJob", "name": self.name},
+                reason, message, etype=etype,
+            )
+        except Exception as e:
+            log.warning("job %s: event %s dropped: %s", self.fullname, reason, e)
+
     def update_crd_status(self) -> None:
         """Write status back iff changed (reference updateTPRStatus,
         training.go:331-347)."""
         if self.job.status.to_dict() == self.status.to_dict():
             return
+        prev = self.job.status
         self.job.status = self.status.deepcopy()
         try:
             self.job = self.job_client.update(self.job)
         except Exception as e:
+            # roll the local mirror back so the diff stays dirty and the
+            # next tick retries — overwriting it before a FAILED write
+            # made the iff-changed check above see "no change" forever,
+            # wedging e.g. a terminal transition the apiserver never saw
+            self.job.status = prev
             log.warning("job %s: failed to update CRD status: %s", self.fullname, e)
 
     # ------------------------------------------------------------ reconcile
@@ -349,6 +431,13 @@ class TrainingJob:
                 if gang == "restarted":
                     self.update_crd_status()
                     return  # next tick recreates the gang
+                if gang == "backoff":
+                    # restart wanted but held by the schedule: persist
+                    # the BackoffRestarting condition and re-check next
+                    # tick — the job must NOT be marked Failed off the
+                    # degraded pods while the hold-off runs
+                    self.update_crd_status()
+                    return
                 if gang == "exhausted":
                     state = TpuJobState.FAILED
             self.status.replica_statuses = replica_statuses
@@ -370,9 +459,8 @@ class TrainingJob:
             TpuJobPhase.FAILED,
         ):
             metrics.JOBS_TERMINAL.inc({"state": self.status.state})
-            self.client.record_event(
-                self.job.metadata.namespace,
-                {"kind": "TpuJob", "name": self.name},
+            metrics.GANG_RESTART_BACKOFF.set(0.0, {"job": self.fullname})
+            self._record_event(
                 "Finished",
                 f"job reached {self.status.state}",
                 etype="Normal" if self.status.state == TpuJobState.SUCCEEDED else "Warning",
@@ -417,13 +505,18 @@ class TrainingJob:
 
     def run(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
         """Reference run loop (training.go:412-456): select over
-        {event queue, stop, ticker}."""
-        self.reconcile(config)
+        {event queue, stop, ticker}.
+
+        A tick that raises (a transient apiserver error surfacing
+        through an unguarded read) must NOT kill the reconciler thread
+        — the job would silently never reach a terminal phase. The
+        ticker itself paces the retry."""
+        self._safe_reconcile(config)
         while not self._stop.is_set():
             try:
                 typ, _new = self._events.get(timeout=reconcile_interval)
             except queue.Empty:
-                self.reconcile(config)
+                self._safe_reconcile(config)
                 continue
             if typ == _EVENT_DELETE:
                 log.info("TpuJob %s deleted by the user", self.fullname)
@@ -436,6 +529,13 @@ class TrainingJob:
                 return
             if typ == _EVENT_MODIFY and _new is not None:
                 self._handle_modify(_new)
+
+    def _safe_reconcile(self, config: ControllerConfig) -> None:
+        try:
+            self.reconcile(config)
+        except Exception as e:
+            log.error("job %s: reconcile tick failed (%s); next tick retries",
+                      self.fullname, e)
 
     def _handle_modify(self, new_job: TpuJob) -> None:
         """Spec-change policy for MODIFIED events. The reference left
@@ -497,9 +597,7 @@ class TrainingJob:
         self.status.append_condition(
             "SpecChangeRejected", reason=f"immutable fields: {changed}"
         )
-        self.client.record_event(
-            self.job.metadata.namespace,
-            {"kind": "TpuJob", "name": self.name},
+        self._record_event(
             "SpecChangeRejected",
             f"spec fields {changed} are immutable on a running job; "
             "reverting to the running configuration — delete and "

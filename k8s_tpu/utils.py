@@ -37,6 +37,34 @@ def pformat(obj: Any) -> str:
         return repr(obj)
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: the top-level export (and
+    its ``check_vma`` kwarg) arrived after 0.4.x; older releases ship
+    the same transform as ``jax.experimental.shard_map`` with the knob
+    named ``check_rep``."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=check_vma)
+
+
+def axis_size_compat(axis_name: str) -> int:
+    """``jax.lax.axis_size`` for jax versions that predate it —
+    ``psum(1, axis)`` of a static literal folds to the static mesh-axis
+    extent on those releases."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
 class RetryError(Exception):
     def __init__(self, n: int):
         super().__init__(f"still failing after {n} retries")

@@ -13,8 +13,32 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # children spawned by the subprocess executor inherit these:
 os.environ["KTPU_FORCE_PLATFORM"] = "cpu"
 os.environ["KTPU_NUM_CPU_DEVICES"] = "8"
+# older jax has no jax_num_cpu_devices config; XLA_FLAGS predates it and
+# works on both, but must be set before the backend initializes
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+# persistent compilation cache: the suite's cost is dominated by XLA
+# compiles (AOT north-star configs, sharded train steps) repeated both
+# across runs and inside one run by every subprocess-executor child —
+# all of which hit this dir instead. KTPU_JAX_CACHE_DIR= (empty)
+# disables; children inherit the env var so they share the cache.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.environ.get("KTPU_JAX_CACHE_DIR", "/tmp/ktpu-jax-cache"),
+)
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-0.4.x-series option; XLA_FLAGS above already forced 8
+if _cache_dir:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass  # jax too old for the persistent cache: run uncached

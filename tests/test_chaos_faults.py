@@ -1,0 +1,796 @@
+"""Tier-1 robustness tests: the unified Backoff policy (growth, jitter
+bounds, cap, reset-after-stable) and every chaos fault injector in
+isolation. The soak in ``test_chaos_soak.py`` composes the same pieces
+under one seed; here each one is pinned on its own, fast, with fake
+clocks — no wall-clock sleeps.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.api import errors
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.election import LEADER_ANNOTATION, LOCK_KIND, LeaderElector
+from k8s_tpu.api.objects import (
+    Container,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+)
+from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy, retry_call
+from k8s_tpu.runtime.chaos import (
+    ApiFlakeFault,
+    ChaosMonkey,
+    CheckpointSaveFault,
+    FaultInjector,
+    FaultyCluster,
+    LeaseLossFault,
+    PodKillFault,
+    SlowHandlerFault,
+    WatchDropFault,
+)
+from k8s_tpu import spec as S
+from k8s_tpu.train import checkpoint as ckpt_mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Backoff policy
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffPolicy:
+    def test_growth_curve(self):
+        p = BackoffPolicy(base=1.0, factor=2.0, cap=300.0, jitter=0.0)
+        assert [p.raw_delay(n) for n in range(0, 6)] == [
+            0.0, 1.0, 2.0, 4.0, 8.0, 16.0]
+
+    def test_cap(self):
+        p = BackoffPolicy(base=1.0, factor=2.0, cap=10.0, jitter=0.0)
+        assert p.raw_delay(4) == 8.0
+        assert p.raw_delay(5) == 10.0
+        assert p.raw_delay(50) == 10.0  # no overflow past the cap
+
+    def test_jitter_bounds_under_seeded_rng(self):
+        p = BackoffPolicy(base=2.0, factor=2.0, cap=64.0, jitter=0.5)
+        bo = Backoff(p, seed=42, clock=FakeClock())
+        for n in range(1, 8):
+            d = bo.note_failure()
+            raw = p.raw_delay(n)
+            assert raw * 0.5 <= d <= raw, (n, d, raw)
+
+    def test_jitter_deterministic_given_seed(self):
+        p = BackoffPolicy(base=1.0, jitter=1.0)
+        seq = lambda seed: [  # noqa: E731
+            Backoff(p, seed=seed, clock=FakeClock()).note_failure()
+            for _ in range(1)
+        ]
+        a = Backoff(p, seed=7, clock=FakeClock())
+        b = Backoff(p, seed=7, clock=FakeClock())
+        c = Backoff(p, seed=8, clock=FakeClock())
+        sa = [a.note_failure() for _ in range(6)]
+        sb = [b.note_failure() for _ in range(6)]
+        sc = [c.note_failure() for _ in range(6)]
+        assert sa == sb
+        assert sa != sc
+
+    def test_remaining_counts_down_on_fake_clock(self):
+        clock = FakeClock()
+        bo = Backoff(BackoffPolicy(base=4.0, jitter=0.0), clock=clock)
+        d = bo.note_failure()
+        assert d == 4.0
+        assert bo.remaining() == pytest.approx(4.0)
+        assert not bo.ready()
+        clock.advance(3.0)
+        assert bo.remaining() == pytest.approx(1.0)
+        clock.advance(1.5)
+        assert bo.ready()
+
+    def test_reset_after_stable_period(self):
+        clock = FakeClock()
+        bo = Backoff(
+            BackoffPolicy(base=1.0, factor=2.0, jitter=0.0, reset_after=50.0),
+            clock=clock,
+        )
+        for _ in range(3):
+            bo.note_failure()
+        assert bo.failures == 3
+        clock.advance(60.0)  # stable longer than reset_after
+        assert bo.ready()
+        # the streak is forgiven: next failure is treated as the first
+        assert bo.note_failure() == 1.0
+        assert bo.failures == 1
+
+    def test_no_reset_within_stable_window(self):
+        clock = FakeClock()
+        bo = Backoff(
+            BackoffPolicy(base=1.0, factor=2.0, jitter=0.0, reset_after=50.0),
+            clock=clock,
+        )
+        bo.note_failure()
+        clock.advance(10.0)
+        assert bo.note_failure() == 2.0  # streak kept
+        assert bo.failures == 2
+
+    def test_note_success_resets(self):
+        bo = Backoff(BackoffPolicy(base=1.0, jitter=0.0), clock=FakeClock())
+        bo.note_failure()
+        bo.note_failure()
+        bo.note_success()
+        assert bo.failures == 0
+        assert bo.ready()
+        assert bo.note_failure() == 1.0
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {"base": -1.0},
+            {"factor": 0.5},
+            {"base": 10.0, "cap": 5.0},
+            {"jitter": 1.5},
+            {"jitter": -0.1},
+            {"reset_after": -1.0},
+        ],
+    )
+    def test_validate_rejects(self, kw):
+        with pytest.raises(ValueError):
+            BackoffPolicy(**kw).validate()
+
+    def test_validate_accepts_defaults(self):
+        BackoffPolicy().validate()
+
+
+class TestRetryCall:
+    def test_retries_then_succeeds_no_wall_sleep(self):
+        slept = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise errors.ApiError("transient")
+            return "ok"
+
+        out = retry_call(
+            flaky,
+            policy=BackoffPolicy(base=0.5, jitter=0.0),
+            max_attempts=4,
+            sleep=slept.append,
+        )
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert slept == [0.5, 1.0]  # exponential, injected sleep only
+
+    def test_exhausted_attempts_raise_last_error(self):
+        def always():
+            raise errors.ApiError("still down")
+
+        with pytest.raises(errors.ApiError, match="still down"):
+            retry_call(always, max_attempts=3, sleep=lambda d: None)
+
+    def test_should_retry_predicate_short_circuits(self):
+        calls = {"n": 0}
+
+        def notfound():
+            calls["n"] += 1
+            raise errors.NotFoundError("gone")
+
+        with pytest.raises(errors.NotFoundError):
+            retry_call(
+                notfound,
+                max_attempts=5,
+                should_retry=errors.is_transient,
+                sleep=lambda d: None,
+            )
+        assert calls["n"] == 1  # semantic error: no second attempt
+
+    def test_on_retry_observer(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise errors.ApiError("x")
+            return 1
+
+        retry_call(
+            flaky,
+            max_attempts=5,
+            sleep=lambda d: None,
+            on_retry=lambda a, e, d: seen.append((a, type(e).__name__)),
+        )
+        assert seen == [(1, "ApiError"), (2, "ApiError")]
+
+    def test_transient_classifier(self):
+        assert errors.is_transient(errors.ApiError("500"))
+        assert errors.is_transient(errors.TooManyRequestsError("429"))
+        assert not errors.is_transient(errors.NotFoundError("404"))
+        assert not errors.is_transient(errors.ConflictError("409"))
+        assert not errors.is_transient(errors.OutdatedVersionError("410"))
+
+
+# ---------------------------------------------------------------------------
+# FaultyCluster
+# ---------------------------------------------------------------------------
+
+
+def make_pod(name="p0", phase="Running"):
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = "default"
+    p.status = PodStatus(
+        phase=phase,
+        container_statuses=[
+            ContainerStatus(name="jax", state=ContainerState(running={}))
+        ],
+    )
+    return p
+
+
+class TestFaultyCluster:
+    def _world(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        return faulty, KubeClient(faulty)
+
+    def test_passthrough_when_unarmed(self):
+        faulty, client = self._world()
+        client.pods.create(make_pod())
+        assert client.pods.get("default", "p0").metadata.name == "p0"
+        assert len(client.pods.list()) == 1
+        assert faulty.api_errors_injected == 0
+
+    def test_armed_api_errors_fire_then_clear(self):
+        faulty, client = self._world()
+        client.pods.create(make_pod())
+        faulty.arm_api_errors(2)
+        with pytest.raises(errors.ApiError):
+            client.pods.list()
+        with pytest.raises(errors.ApiError):
+            client.pods.get("default", "p0")
+        # armed count spent: back to normal
+        assert client.pods.get("default", "p0")
+        assert faulty.api_errors_injected == 2
+
+    def test_armed_delay_fires(self):
+        faulty, client = self._world()
+        client.pods.create(make_pod())
+        faulty.arm_delay(0.02, n=1)
+        t0 = time.monotonic()
+        client.pods.list()
+        assert time.monotonic() - t0 >= 0.02
+        assert faulty.delays_injected == 1
+        # only armed once
+        t0 = time.monotonic()
+        client.pods.list()
+        assert time.monotonic() - t0 < 0.02
+
+    def test_watch_drop_forces_410_once(self):
+        faulty, client = self._world()
+        w = faulty.watch("Pod", "default")
+        assert faulty.drop_watches() == 1
+        with pytest.raises(errors.OutdatedVersionError):
+            w.next(timeout=0.01)
+        # one 410 per drop: the stream then serves again
+        assert w.next(timeout=0.01) is None
+        assert faulty.watch_drops_injected == 1
+        w.stop()
+
+    def test_drop_watches_none_live(self):
+        faulty, _ = self._world()
+        assert faulty.drop_watches() == 0
+
+
+# ---------------------------------------------------------------------------
+# Injectors in isolation
+# ---------------------------------------------------------------------------
+
+
+class _CountingFault(FaultInjector):
+    name = "counting"
+
+    def fire(self):
+        self.injected += 1
+        return "fired"
+
+
+class TestInjectorRateControl:
+    def test_rate_zero_never_fires(self):
+        f = _CountingFault(rate=0.0, seed=1)
+        assert all(f.maybe_fire() is None for _ in range(200))
+        assert f.injected == 0
+
+    def test_rate_one_always_fires(self):
+        f = _CountingFault(rate=1.0, seed=1)
+        assert all(f.maybe_fire() == "fired" for _ in range(50))
+        assert f.injected == 50
+
+    def test_fractional_rate_seeded_deterministic(self):
+        a = _CountingFault(rate=0.3, seed=9)
+        b = _CountingFault(rate=0.3, seed=9)
+        fa = [a.maybe_fire() is not None for _ in range(100)]
+        fb = [b.maybe_fire() is not None for _ in range(100)]
+        assert fa == fb
+        assert 5 < sum(fa) < 60  # roughly the armed rate, not 0 or 100
+
+
+class TestPodKillFault:
+    def test_kills_a_running_pod_with_retryable_exit(self):
+        client = KubeClient(InMemoryCluster())
+        client.pods.create(make_pod("victim"))
+        f = PodKillFault(client, rate=1.0, seed=3)
+        assert f.fire() == "victim"
+        p = client.pods.get("default", "victim")
+        assert p.status.phase == "Failed"
+        t = p.status.container_statuses[0].state.terminated
+        assert t.exit_code == 137  # SIGKILL: retryable class
+        assert f.injected == 1
+
+    def test_no_running_pods_is_a_noop(self):
+        client = KubeClient(InMemoryCluster())
+        client.pods.create(make_pod("done", phase="Succeeded"))
+        f = PodKillFault(client, rate=1.0, seed=3)
+        assert f.fire() is None
+        assert f.injected == 0
+
+
+class TestApiAndWatchFaults:
+    def test_api_flake_arms_the_faulty_cluster(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        f = ApiFlakeFault(faulty, rate=1.0, seed=5, burst=3)
+        f.fire()
+        assert f.injected == 1
+        with pytest.raises(errors.ApiError):
+            client.pods.list()
+
+    def test_watch_drop_fault(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        w = faulty.watch("Pod", "default")
+        f = WatchDropFault(faulty, rate=1.0, seed=5)
+        assert f.fire() == "1 streams"
+        with pytest.raises(errors.OutdatedVersionError):
+            w.next(timeout=0.01)
+        w.stop()
+
+    def test_watch_drop_fault_no_streams(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        f = WatchDropFault(faulty, rate=1.0, seed=5)
+        assert f.fire() is None
+        assert f.injected == 0
+
+    def test_slow_handler_arms_delay(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        f = SlowHandlerFault(faulty, rate=1.0, seed=5, delay=0.02, burst=1)
+        f.fire()
+        t0 = time.monotonic()
+        client.pods.list()
+        assert time.monotonic() - t0 >= 0.02
+        assert faulty.delays_injected == 1
+
+
+class TestCheckpointSaveFault:
+    def teardown_method(self):
+        ckpt_mod.arm_save_faults(0)  # never leak armed faults across tests
+
+    def test_armed_hook_raises_n_times(self):
+        ckpt_mod.arm_save_faults(2)
+        hook = ckpt_mod.SAVE_FAULT_HOOK
+        with pytest.raises(OSError):
+            hook(1)
+        with pytest.raises(OSError):
+            hook(2)
+        hook(3)  # spent: a noop
+
+    def test_disarm(self):
+        ckpt_mod.arm_save_faults(2)
+        ckpt_mod.arm_save_faults(0)
+        assert ckpt_mod.SAVE_FAULT_HOOK is None
+
+    def test_injector_arms_process_hook(self):
+        f = CheckpointSaveFault(rate=1.0, seed=11, burst=2)
+        out = f.fire()
+        assert out.endswith("saves")
+        assert ckpt_mod.SAVE_FAULT_HOOK is not None
+        assert f.injected == 1
+
+    def test_manager_save_retries_through_faults(self, tmp_path):
+        import jax.numpy as jnp
+
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        state = {"w": jnp.ones((4,)), "step": jnp.asarray(3)}
+        ckpt_mod.arm_save_faults(2)  # two attempts fail, retries absorb
+        assert mgr.save(3, state) is True
+        mgr.wait()
+        assert 3 in mgr.manager.all_steps()
+        restored = mgr.restore(state)
+        assert float(restored["w"].sum()) == 4.0
+
+    def test_manager_save_fails_when_faults_exceed_attempts(self, tmp_path):
+        import jax.numpy as jnp
+
+        from k8s_tpu.train.checkpoint import (
+            SAVE_RETRY_ATTEMPTS,
+            CheckpointManager,
+        )
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        state = {"w": jnp.ones((2,))}
+        ckpt_mod.arm_save_faults(SAVE_RETRY_ATTEMPTS + 1)
+        with pytest.raises(OSError):
+            mgr.save(1, state)
+
+
+class TestLeaseLossFault:
+    def test_steals_lease_and_leader_concedes_then_reacquires(self):
+        cluster = InMemoryCluster()
+        clock = FakeClock()
+        elector = LeaderElector(
+            cluster, "default", "tpu-operator", "op-1",
+            lease_duration=15.0, clock=clock,
+        )
+        assert elector.try_acquire_or_renew()
+        assert elector.is_leader()
+
+        f = LeaseLossFault(cluster, namespace="default",
+                           lock_name="tpu-operator", rate=1.0, seed=13)
+        assert f.fire() == "tpu-operator"
+        raw = cluster.get(LOCK_KIND, "default", "tpu-operator")
+        assert "chaos-monkey" in raw["metadata"]["annotations"][LEADER_ANNOTATION]
+
+        # next renew sees a foreign unexpired lease: concede
+        clock.advance(1.0)
+        assert not elector.try_acquire_or_renew()
+        # once the stolen lease expires, the real operator wins it back
+        clock.advance(20.0)
+        assert elector.try_acquire_or_renew()
+        assert elector.is_leader()
+
+    def test_no_election_running_is_a_noop(self):
+        f = LeaseLossFault(InMemoryCluster(), rate=1.0, seed=13)
+        assert f.fire() is None
+        assert f.injected == 0
+
+    def test_renew_thread_concedes_on_api_error_instead_of_dying(self):
+        # the renew loop must fail SAFE on a transient API error:
+        # leadership conceded (lost set), not a silently dead thread
+        faulty = FaultyCluster(InMemoryCluster())
+        elector = LeaderElector(
+            faulty, "default", "tpu-operator", "op-1",
+            renew_deadline=0.01, retry_period=0.01,
+        )
+        lost_seen = threading.Event()
+
+        def leading(lost):
+            faulty.arm_api_errors(1)  # the next renew CAS explodes
+            assert lost.wait(5.0)
+            lost_seen.set()
+
+        elector.run(leading, lambda: None)
+        assert lost_seen.is_set()
+
+
+# ---------------------------------------------------------------------------
+# ChaosMonkey profiles + scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestChaosMonkeyProfiles:
+    def _names(self, monkey):
+        return sorted(i.name for i in monkey.injectors)
+
+    def test_level_0_and_1_pod_kill_only(self):
+        client = KubeClient(InMemoryCluster())
+        m0 = ChaosMonkey.from_level(client, 0, seed=1)
+        m1 = ChaosMonkey.from_level(client, 1, seed=1)
+        assert self._names(m0) == ["pod-kill"]
+        assert self._names(m1) == ["pod-kill"]
+        assert m0.injectors[0].rate == 0.25
+        assert m1.injectors[0].rate == 1.0
+
+    def test_level_2_adds_apiserver_faults(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        m = ChaosMonkey.from_level(client, 2, seed=1, faulty=faulty)
+        assert self._names(m) == [
+            "api-flake", "pod-kill", "slow-handler", "watch-drop"]
+
+    def test_level_2_without_faulty_degrades_to_pod_kill(self):
+        client = KubeClient(InMemoryCluster())
+        m = ChaosMonkey.from_level(client, 2, seed=1, faulty=None)
+        assert self._names(m) == ["pod-kill"]
+
+    def test_level_3_full_matrix(self):
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty)
+        assert self._names(m) == [
+            "api-flake", "checkpoint-save", "lease-loss", "pod-kill",
+            "slow-handler", "watch-drop",
+        ]
+        ckpt_mod.arm_save_faults(0)  # in case a tick armed it
+
+    def test_tick_is_exception_safe_and_counts(self):
+        class Broken(FaultInjector):
+            name = "broken"
+
+            def fire(self):
+                raise RuntimeError("injector bug")
+
+        client = KubeClient(InMemoryCluster())
+        m = ChaosMonkey(client, injectors=[Broken(rate=1.0, seed=2),
+                                           _CountingFault(rate=1.0, seed=2)])
+        stats = m.tick()  # Broken must not abort the round
+        assert stats["counting"] == 1
+        assert stats["broken"] == 0
+
+    def test_back_compat_kill_one(self):
+        client = KubeClient(InMemoryCluster())
+        m = ChaosMonkey(client, level=1, seed=7)
+        assert m.kill_one() is None  # empty cluster
+        client.pods.create(make_pod("target"))
+        assert m.kill_one() == "target"
+        assert m.kills == 1
+
+
+# ---------------------------------------------------------------------------
+# Gang-restart backoff integration (fake clock, no sleeps)
+# ---------------------------------------------------------------------------
+
+
+def make_training_job(clock, base=10.0, jitter=0.0, reset_after=600.0,
+                      max_restarts=5, workers=2):
+    from k8s_tpu.trainer.training import TrainingJob
+
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    j = S.TpuJob()
+    j.metadata.name = "bk"
+    j.metadata.namespace = "default"
+    j.metadata.uid = "uid-1"
+    j.spec.runtime_id = "abcd"
+    j.spec.max_gang_restarts = max_restarts
+    j.spec.restart_backoff = S.RestartBackoffSpec(
+        base_seconds=base, jitter=jitter, reset_after_seconds=reset_after)
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(
+            replica_type="COORDINATOR",
+            template=PodTemplateSpec(
+                spec=PodSpec(containers=[Container(name="jax", image="i")])),
+        ),
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=workers),
+    ]
+    jc.create(j)
+    tj = TrainingJob(client, jc, j, clock=clock)
+    tj.reconcile(S.ControllerConfig())
+    return client, jc, tj
+
+
+def degrade_worker(client, index, exit_code=137):
+    name = f"bk-worker-abcd-{index}"
+    bjob = client.jobs.get("default", name)
+    bjob.status.failed = 1
+    client.jobs.update(bjob)
+    pod = Pod()
+    pod.metadata.name = name + "-pod-0"
+    pod.metadata.namespace = "default"
+    pod.metadata.labels = dict(bjob.metadata.labels)
+    pod.status = PodStatus(
+        phase="Failed",
+        container_statuses=[
+            ContainerStatus(
+                name="jax",
+                state=ContainerState(
+                    terminated=ContainerStateTerminated(exit_code=exit_code)),
+            )
+        ],
+    )
+    client.pods.create(pod)
+
+
+class TestGangRestartBackoff:
+    def test_first_restart_immediate_second_held(self):
+        from k8s_tpu.controller import metrics
+
+        clock = FakeClock()
+        client, jc, tj = make_training_job(clock, base=10.0)
+        cfg = S.ControllerConfig()
+
+        degrade_worker(client, 0)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1  # first restart: no hold-off
+        assert len(tj.restart_history) == 1
+        _, armed = tj.restart_history[0]
+        assert armed == 10.0  # jitter=0: exactly the base
+        tj.reconcile(cfg)  # recreate the gang
+
+        clock.advance(3.0)  # well inside the hold-off
+        degrade_worker(client, 1)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1  # held, NOT restarted
+        assert tj.status.state != S.TpuJobState.FAILED
+        assert any(c.type == "BackoffRestarting"
+                   for c in tj.status.conditions)
+        # visible on the gauge and in the CRD conditions
+        assert metrics.GANG_RESTART_BACKOFF.get(
+            {"job": "default:bk"}) == pytest.approx(7.0)
+        assert any(c.type == "BackoffRestarting"
+                   for c in jc.get("default", "bk").status.conditions)
+
+        clock.advance(7.5)  # past the armed delay
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 2
+        # recorded restart timestamps are spaced by >= the armed delay
+        (t1, d1), (t2, _) = tj.restart_history
+        assert t2 - t1 >= d1
+
+    def test_restart_spacing_follows_schedule_over_streak(self):
+        clock = FakeClock()
+        client, jc, tj = make_training_job(clock, base=5.0, max_restarts=10)
+        cfg = S.ControllerConfig()
+        for i in range(4):
+            degrade_worker(client, i % 2)
+            tj.reconcile(cfg)   # restart (first iteration) or held→restart
+            while tj.status.gang_restarts == i:  # held: walk the clock
+                clock.advance(1.0)
+                tj.reconcile(cfg)
+            tj.reconcile(cfg)   # recreate gang
+        hist = tj.restart_history
+        assert len(hist) == 4
+        # armed delays follow the exponential schedule (jitter=0)
+        assert [d for _, d in hist] == [5.0, 10.0, 20.0, 40.0]
+        # and actual spacing honors each armed delay
+        for (t_prev, d_prev), (t_next, _) in zip(hist, hist[1:]):
+            assert t_next - t_prev >= d_prev
+
+    def test_stable_window_earns_back_fast_restart(self):
+        clock = FakeClock()
+        client, jc, tj = make_training_job(
+            clock, base=10.0, reset_after=60.0)
+        cfg = S.ControllerConfig()
+        degrade_worker(client, 0)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        tj.reconcile(cfg)  # recreate
+
+        clock.advance(120.0)  # stable run, twice the reset window
+        degrade_worker(client, 1)
+        tj.reconcile(cfg)
+        # restart fired immediately (no hold-off left) and the armed
+        # delay is back to BASE — the streak was forgiven
+        assert tj.status.gang_restarts == 2
+        assert tj.restart_history[-1][1] == 10.0
+
+    def test_budget_exhaustion_still_beats_backoff(self):
+        clock = FakeClock()
+        client, jc, tj = make_training_job(clock, base=10.0, max_restarts=1)
+        cfg = S.ControllerConfig()
+        degrade_worker(client, 0)
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        tj.reconcile(cfg)
+        degrade_worker(client, 1)
+        tj.reconcile(cfg)  # budget spent: fail NOW, not after a hold-off
+        assert tj.status.state == S.TpuJobState.FAILED
+        assert "budget exhausted" in tj.status.reason
+
+    def test_terminal_job_clears_gauge(self):
+        from k8s_tpu.controller import metrics
+
+        clock = FakeClock()
+        client, jc, tj = make_training_job(clock, base=10.0)
+        cfg = S.ControllerConfig()
+        degrade_worker(client, 0)
+        tj.reconcile(cfg)
+        assert metrics.GANG_RESTART_BACKOFF.get({"job": "default:bk"}) > 0
+        # chief succeeds → terminal → gauge zeroed
+        tj.reconcile(cfg)
+        chief = client.jobs.get("default", "bk-coordinator-abcd-0")
+        chief.status.succeeded = 1
+        client.jobs.update(chief)
+        tj.reconcile(cfg)
+        assert tj.status.phase == S.TpuJobPhase.DONE
+        assert metrics.GANG_RESTART_BACKOFF.get({"job": "default:bk"}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Status-write retry under API flakes
+# ---------------------------------------------------------------------------
+
+
+class TestCrdStatusWriteRetry:
+    def test_flaked_status_write_stays_dirty_and_lands_next_tick(self):
+        """A transient error on the CRD status write must leave the
+        local mirror DIRTY: overwriting it pre-write made the
+        iff-changed check skip every later attempt, wedging e.g. a
+        terminal transition the apiserver never saw."""
+        from k8s_tpu.trainer.training import TrainingJob
+
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        jc = TpuJobClient(faulty)
+        j = S.TpuJob()
+        j.metadata.name = "st"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(
+                replica_type="COORDINATOR",
+                template=PodTemplateSpec(
+                    spec=PodSpec(containers=[Container(name="jax", image="i")])),
+            ),
+        ]
+        jc.create(j)
+        tj = TrainingJob(client, jc, j)
+        tj.reconcile(S.ControllerConfig())
+
+        tj.status.append_condition("Probe", reason="x")
+        faulty.arm_api_errors(1)
+        tj.update_crd_status()  # write flakes; swallowed, rolled back
+        assert all(c.type != "Probe"
+                   for c in jc.get("default", "st").status.conditions)
+
+        tj.update_crd_status()  # same diff, clean apiserver: it lands
+        assert any(c.type == "Probe"
+                   for c in jc.get("default", "st").status.conditions)
+
+
+# ---------------------------------------------------------------------------
+# restartBackoff spec surface
+# ---------------------------------------------------------------------------
+
+
+class TestRestartBackoffSpec:
+    def test_defaulted_when_missing(self):
+        j = S.TpuJob()
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER")]
+        j.spec.set_defaults()
+        assert j.spec.restart_backoff is not None
+        p = j.spec.restart_backoff.to_policy()
+        p.validate()
+        assert p.base == 10.0
+
+    def test_serde_roundtrip_camel_case(self):
+        j = S.TpuJob.from_dict({
+            "metadata": {"name": "x"},
+            "spec": {
+                "replicaSpecs": [{"replicaType": "WORKER"}],
+                "restartBackoff": {"baseSeconds": 5, "capSeconds": 60,
+                                   "jitter": 0.25},
+            },
+        })
+        rb = j.spec.restart_backoff
+        assert rb.base_seconds == 5
+        assert rb.cap_seconds == 60
+        d = j.spec.to_dict()["restartBackoff"]
+        assert d["baseSeconds"] == 5
+        assert d["resetAfterSeconds"] == 600.0
+
+    def test_validation_rejects_bad_values(self):
+        from k8s_tpu.spec.tpu_job import ValidationError
+
+        j = S.TpuJob()
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER")]
+        j.spec.restart_backoff = S.RestartBackoffSpec(factor=0.5)
+        j.spec.set_defaults()
+        with pytest.raises(ValidationError, match="restartBackoff"):
+            j.spec.validate()

@@ -185,7 +185,8 @@ class TestPipelineLlama:
 
     def test_pp_forward_matches_plain_model(self):
         """Pipelined hidden states == the plain scan forward with the
-        SAME param tree (no param surgery): bit-exact without fsdp."""
+        SAME param tree (no param surgery): same arithmetic order, so
+        equal up to backend fusion rounding (last-ulp f32)."""
         import flax.linen as nn
 
         mesh, rules, cfg, model, state, _, apply_fn = self._setup(
@@ -196,7 +197,11 @@ class TestPipelineLlama:
             h_pp = jax.jit(apply_fn)(state.params, ids)
         h_ref = model.apply({"params": state.params}, ids,
                             return_hidden=True)
-        np.testing.assert_array_equal(np.asarray(h_pp), np.asarray(h_ref))
+        # same arithmetic ORDER, but not always the same fusions: some
+        # backends compile the pipelined vs plain graph with different
+        # op fusion, so bit-exactness degrades to last-ulp f32 noise
+        np.testing.assert_allclose(
+            np.asarray(h_pp), np.asarray(h_ref), atol=1e-5, rtol=1e-6)
 
     def test_pp_fsdp_composes(self):
         """PP x FSDP: block params sharded ('stage', 'fsdp'), manual
@@ -238,7 +243,7 @@ class TestPipelineLlama:
         segment_ids ride the microbatch split as pipeline_apply's aux
         operand, and every stage indexes the microbatch it is currently
         processing — hidden states must equal the plain packed forward
-        bit-for-bit (no fsdp: same arithmetic order)."""
+        up to backend fusion rounding (no fsdp: same arithmetic order)."""
         import flax.linen as nn
 
         mesh, rules, cfg, model, state, _, apply_fn = self._setup(
@@ -251,11 +256,15 @@ class TestPipelineLlama:
             h_pp = jax.jit(apply_fn)(state.params, ids, seg)
         h_ref = model.apply({"params": state.params}, ids,
                             segment_ids=seg, return_hidden=True)
-        np.testing.assert_array_equal(np.asarray(h_pp), np.asarray(h_ref))
+        # see test_pp_forward_matches_plain_model: fusion differences
+        # reduce bit-exactness to last-ulp f32 noise on some backends
+        np.testing.assert_allclose(
+            np.asarray(h_pp), np.asarray(h_ref), atol=1e-5, rtol=1e-6)
         # and the segments MATTER: dropping them changes the output
         h_nosegs = model.apply({"params": state.params}, ids,
                                return_hidden=True)
-        assert not np.array_equal(np.asarray(h_pp), np.asarray(h_nosegs))
+        assert not np.allclose(np.asarray(h_pp), np.asarray(h_nosegs),
+                               atol=1e-5)
 
     def test_pp_packed_segments_train(self):
         """PP + FSDP + packed docs end-to-end through the standard

@@ -374,6 +374,48 @@ class TestEngineTrained:
         assert np.array_equal(out[rid], ref)
 
 
+class TestServedAccounting:
+    """``served`` counts results DELIVERED to a waiter; work that
+    finishes after the client timed out and left is ``abandoned`` —
+    counting it as served inflated the throughput the operator scales
+    on."""
+
+    class _StubEngine:
+        def __init__(self):
+            self.finished = {}
+            self.stats = {}
+
+        def pop_finished(self):
+            out, self.finished = self.finished, {}
+            return out
+
+    def test_resolve_finished_splits_served_and_abandoned(self):
+        import threading
+
+        from k8s_tpu.serving.server import ServingFrontend
+
+        class Req:
+            tokens = [1, 2, 3]
+
+        eng = self._StubEngine()
+        fe = ServingFrontend(eng, port=0)
+        try:
+            ev = threading.Event()
+            fe._waiters[1] = ev
+            eng.finished[1] = Req()
+            fe._resolve_finished()
+            assert (fe.served, fe.abandoned) == (1, 0)
+            assert ev.is_set() and 1 in fe._results
+
+            # waiter timed out and left: tokens dropped, not "served"
+            eng.finished[2] = Req()
+            fe._resolve_finished()
+            assert (fe.served, fe.abandoned) == (1, 1)
+            assert 2 not in fe._results
+        finally:
+            fe._server.server_close()
+
+
 class TestServingFrontend:
     """The HTTP front-end (serving/server.py): requests over the wire
     must produce oracle tokens, concurrent clients share the slots, and

@@ -10,6 +10,7 @@ import sys
 import xml.etree.ElementTree as ET
 
 import pytest
+import yaml
 
 from k8s_tpu.client.job_client import load_tpu_job_yaml
 from k8s_tpu import spec as S
@@ -870,6 +871,40 @@ class TestExampleChart:
             "a: {{ if .Values.x }}y{{ end }}\n")
         with pytest.raises(ValueError, match="unsupported"):
             helm_lite.render_chart(str(tmp_path))
+
+    def _mini_chart(self, tmp_path, template):
+        (tmp_path / "templates").mkdir()
+        (tmp_path / "Chart.yaml").write_text("name: x\nversion: 0.1.0\n")
+        (tmp_path / "values.yaml").write_text("set: present\n")
+        (tmp_path / "templates" / "t.yaml").write_text(template)
+        return str(tmp_path)
+
+    def test_default_accepts_bare_literals(self, tmp_path):
+        """Real helm renders `default 3` / `default true` verbatim —
+        bare numeric/bool literals are values, not dotted lookups."""
+        from k8s_tpu.tools import helm_lite
+
+        chart = self._mini_chart(
+            tmp_path,
+            "replicas: {{ .Values.workers | default 3 }}\n"
+            "preemptible: {{ .Values.flag | default true }}\n"
+            "lr: {{ .Values.lr | default -0.5 }}\n"
+            "kept: {{ .Values.set | default 9 }}\n",
+        )
+        doc = yaml.safe_load(helm_lite.render_chart(chart)["t.yaml"])
+        assert doc["replicas"] == 3
+        assert doc["preemptible"] is True
+        assert doc["lr"] == -0.5
+        assert doc["kept"] == "present"  # set value wins over default
+
+    def test_trim_markers_raise_loudly(self, tmp_path):
+        """`{{- -}}` eats whitespace in real helm; rendering WITHOUT
+        the trim silently diverges from helm output, so refuse."""
+        from k8s_tpu.tools import helm_lite
+
+        chart = self._mini_chart(tmp_path, "a: {{- .Values.set }}\n")
+        with pytest.raises(ValueError, match="trim marker"):
+            helm_lite.render_chart(chart)
 
 
 class TestRemoteOrchestrator:
