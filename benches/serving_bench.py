@@ -1,7 +1,8 @@
 """Continuous-batching serving: throughput AND latency vs static batching.
 
 Two scenarios over the same 705M decode model, same fixed-seed workload
-(mixed prompt lengths, mixed output budgets):
+(mixed prompt lengths, mixed output budgets, optionally an adversarial
+long-prompt fraction):
 
 **Throughput race** (``--arrival-rate 0``): all requests present at
 t=0. This is static batching's BEST case — perfect batch packing, no
@@ -14,8 +15,25 @@ inter-arrivals, fixed seed): the scenario serving systems actually
 face. The static server takes whatever has arrived when it frees up
 (≤ slots), pads the batch to full width, and decodes to the batch max
 — head-of-line blocking in both directions. The engine admits each
-request at the next chunk boundary. Reported: useful tok/s and
-p50/p95 request latency for both.
+request at the next chunk boundary. Reported: useful tok/s, p50/p95
+request latency, p50/p95 TTFT, and p50/p95 inter-token latency.
+
+**Long-prompt adversarial mix** (``--long-frac F``): a fraction of
+requests carry near-``--long-prompt`` prompts (default 4x the regular
+max). Under the legacy monolithic prefill, each one runs as a single
+batch-1 forward on the decode stream — every in-flight request's
+inter-token latency spikes by the full prefill wall. Chunked prefill
+(``--engine chunked``, the default) bounds that spike at one
+``max_tokens_per_round`` budget per round. ``--engine both`` measures
+the two engines on the identical workload and reports the p95
+inter-token win.
+
+Inter-token methodology: the engine attributes tokens at decode-chunk
+granularity, so per-token wall times don't exist; each request records
+(attribution time, tokens) events, and an inter-token sample is the
+gap between consecutive events divided by (and replicated for) the
+tokens it delivered — the stream cadence an HTTP streaming client
+would observe. TTFT is first-event time minus submit time.
 
 Static-server economics are modeled the way a static XLA server really
 ships: batch padded to ``slots`` rows, prompt padded to a bucket,
@@ -26,6 +44,9 @@ is exact because a static server's wall is shape-determined).
 
 The engine scenario is NOT simulated: requests are submitted by a
 timer thread and served in real wall-clock time.
+
+``--smoke`` shrinks everything to a seconds-scale CPU run that still
+emits the full JSON line shape (CI's `serving-sched` stage tracks it).
 """
 
 from __future__ import annotations
@@ -57,9 +78,35 @@ def _bucket(n, buckets):
 
 
 def _pcts(xs):
+    if len(xs) == 0:
+        return 0.0, 0.0
     xs = np.sort(np.asarray(xs))
     return (float(xs[int(0.5 * (len(xs) - 1))]),
             float(xs[int(0.95 * (len(xs) - 1))]))
+
+
+def _stream_stats(reqs):
+    """TTFT, inter-token, and stall percentiles from per-request
+    attribution events (see module docstring for the methodology).
+    ``stall`` is the RAW gap between consecutive token deliveries of a
+    stream — the dead air a streaming client watches — where ``itl``
+    normalizes each gap over the tokens it delivered."""
+    ttft = [r.first_token_at - r.submitted_at for r in reqs]
+    itl, stalls = [], []
+    for r in reqs:
+        for (t_prev, _), (t_cur, n_cur) in zip(r.token_times,
+                                               r.token_times[1:]):
+            itl.extend([(t_cur - t_prev) / n_cur] * n_cur)
+            stalls.append(t_cur - t_prev)
+    tp50, tp95 = _pcts(ttft)
+    ip50, ip95 = _pcts(itl)
+    sp50, sp95 = _pcts(stalls)
+    return (tp50, tp95, ip50, ip95, (max(itl) if itl else 0.0),
+            sp50, sp95, (max(stalls) if stalls else 0.0))
+
+
+def _round_up(n, g):
+    return -(-n // g) * g
 
 
 def main(argv=None) -> int:
@@ -78,6 +125,26 @@ def main(argv=None) -> int:
     p.add_argument("--arrival-rate", type=float, default=0.0,
                    help="requests/sec (exponential inter-arrivals, "
                         "fixed seed); 0 = all-at-once throughput race")
+    p.add_argument("--long-frac", type=float, default=None,
+                   help="fraction of requests with adversarial "
+                        "near---long-prompt prompts (default 0)")
+    p.add_argument("--long-prompt", type=int, default=None,
+                   help="adversarial prompt length (default "
+                        "4x --max-prompt, capped by the cache)")
+    p.add_argument("--engine", default="chunked",
+                   choices=["chunked", "monolithic", "both"],
+                   help="chunked: token-budget chunked prefill (the "
+                        "engine default); monolithic: legacy one-shot "
+                        "prefill; both: run the identical workload "
+                        "through each and report the p95 inter-token "
+                        "win")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="chunked engine: max padded tokens per prefill "
+                        "chunk (default: engine default, clamped to "
+                        "the buckets)")
+    p.add_argument("--max-tokens-per-round", type=int, default=None,
+                   help="chunked engine: per-round token budget "
+                        "(default: prefill_chunk + slots*decode_chunk)")
     p.add_argument("--kv-quant", default="none", choices=["none", "int8"])
     p.add_argument("--quant", default="none",
                    choices=["none", "int8_serving"],
@@ -87,6 +154,9 @@ def main(argv=None) -> int:
                         "weight-read term that dominates decode")
     p.add_argument("--skip-static", action="store_true",
                    help="measure only the engine (fast iteration)")
+    p.add_argument("--smoke", action="store_true",
+                   help="seconds-scale CPU run emitting the full JSON "
+                        "shape (CI serving-sched harness tracking)")
     p.add_argument("--cpu-model", default="tiny", choices=["tiny", "small"],
                    help="CPU-backend model size: 'small' (~30M) makes "
                         "step compute dominate dispatch, the "
@@ -103,18 +173,49 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     on_accel = jax.default_backend() in ("tpu", "gpu")
-    platform_defaults = (
-        dict(requests=32, slots=8, decode_chunk=64, max_prompt=512,
-             max_new=256)
-        if on_accel else
-        dict(requests=8, slots=3, decode_chunk=4, max_prompt=12,
-             max_new=12)
-    )
+    # prefill_chunk defaults deliberately BELOW the adversarial prompt
+    # length so a long prompt really spans multiple chunks (otherwise
+    # its own bucket would ride along as a single monolithic chunk)
+    if args.smoke:
+        platform_defaults = dict(requests=6, slots=2, decode_chunk=2,
+                                 max_prompt=8, max_new=6, long_frac=0.25,
+                                 prefill_chunk=8)
+    elif on_accel:
+        platform_defaults = dict(requests=32, slots=8, decode_chunk=32,
+                                 max_prompt=512, max_new=256,
+                                 long_frac=0.0, prefill_chunk=256)
+    else:
+        platform_defaults = dict(requests=8, slots=3, decode_chunk=4,
+                                 max_prompt=12, max_new=12, long_frac=0.0,
+                                 prefill_chunk=8)
     for k, v in platform_defaults.items():
         if getattr(args, k) is None:
             setattr(args, k, v)
-    if on_accel:
-        max_seq = args.max_prompt + args.max_new
+
+    if on_accel and not args.smoke:
+        buckets = tuple(b for b in (128, 256, 512, 1024, 2048)
+                        if b < args.max_prompt) + (args.max_prompt,)
+        prompt_lo, new_round = 32, 64
+    else:
+        buckets = tuple(b for b in (4, 8, 16, 32, 64, 128)
+                        if b < args.max_prompt) + (args.max_prompt,)
+        prompt_lo, new_round = 2, 4
+    g = buckets[0]
+    long_len = _round_up(
+        args.long_prompt if args.long_prompt else 4 * args.max_prompt, g)
+    prompt_hi = max(args.max_prompt,
+                    long_len if args.long_frac > 0 else 0)
+    max_seq = _round_up(prompt_hi + args.max_new, g)
+    if not (on_accel and not args.smoke):
+        max_seq = _round_up(max(64, max_seq), g)
+    # the monolithic engine needs a bucket covering the long prompts
+    # (its one-shot prefill pads to a bucket); the chunked engine
+    # accepts the same list and simply never uses buckets above its
+    # chunk size as chunk shapes
+    if args.long_frac > 0 and long_len > buckets[-1]:
+        buckets = buckets + (long_len,)
+
+    if on_accel and not args.smoke:
         base = dict(
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
             num_layers=24, num_heads=12, num_kv_heads=4, head_dim=128,
@@ -124,31 +225,22 @@ def main(argv=None) -> int:
             scan_layers=False,
         )
         cfg = LlamaConfig(**base)
-        buckets = tuple(b for b in (128, 256, 512, 1024, 2048)
-                        if b < args.max_prompt) + (args.max_prompt,)
-        prompt_lo, new_round = 32, 64
+    elif args.cpu_model == "small" and not args.smoke:
+        # big enough that a decode step (~tens of ms) dominates
+        # per-chunk Python dispatch — the compute:RTT ratio of the
+        # 705M model on a colocated chip, which is what the
+        # low-RTT claim is about; tiny's sub-ms steps measure the
+        # scheduler's Python overhead instead
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=512, intermediate_size=1536,
+            num_layers=8, num_heads=8, num_kv_heads=4, head_dim=64,
+            max_seq_len=max_seq, remat=False, decode=True,
+            kv_quant=args.kv_quant, scan_layers=False,
+        )
     else:
-        if args.cpu_model == "small":
-            # big enough that a decode step (~tens of ms) dominates
-            # per-chunk Python dispatch — the compute:RTT ratio of the
-            # 705M model on a colocated chip, which is what the
-            # low-RTT claim is about; tiny's sub-ms steps measure the
-            # scheduler's Python overhead instead
-            cfg = LlamaConfig(
-                vocab_size=2048, hidden_size=512, intermediate_size=1536,
-                num_layers=8, num_heads=8, num_kv_heads=4, head_dim=64,
-                max_seq_len=max(64, args.max_prompt + args.max_new),
-                remat=False, decode=True, kv_quant=args.kv_quant,
-                scan_layers=False,
-            )
-        else:
-            cfg = LlamaConfig.tiny(
-                decode=True,
-                max_seq_len=max(64, args.max_prompt + args.max_new),
-                kv_quant=args.kv_quant, scan_layers=False)
-        buckets = tuple(b for b in (4, 8, 16, 32, 64, 128)
-                        if b < args.max_prompt) + (args.max_prompt,)
-        prompt_lo, new_round = 2, 4
+        cfg = LlamaConfig.tiny(
+            decode=True, max_seq_len=max_seq,
+            kv_quant=args.kv_quant, scan_layers=False)
 
     import flax.linen as nn
 
@@ -170,7 +262,13 @@ def main(argv=None) -> int:
     model = LlamaForCausalLM(rcfg)
 
     rng = np.random.RandomState(0)
-    plens = rng.randint(prompt_lo, args.max_prompt + 1, size=args.requests)
+    plens = rng.randint(prompt_lo, args.max_prompt + 1,
+                        size=args.requests)
+    n_long = int(round(args.long_frac * args.requests))
+    if n_long:
+        long_idx = rng.permutation(args.requests)[:n_long]
+        plens[long_idx] = rng.randint(
+            max(prompt_lo, 3 * long_len // 4), long_len + 1, size=n_long)
     news = rng.randint(max(1, args.max_new // 8), args.max_new + 1,
                        size=args.requests)
     prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
@@ -184,11 +282,17 @@ def main(argv=None) -> int:
         arrivals = np.zeros(args.requests)
 
     # ---- engine (real time) ----
-    def run_engine():
+    def run_engine(chunked: bool):
+        kw = {}
+        if args.prefill_chunk is not None:
+            kw["prefill_chunk"] = args.prefill_chunk
+        if args.max_tokens_per_round is not None:
+            kw["max_tokens_per_round"] = args.max_tokens_per_round
         eng = ContinuousBatchingEngine(
             model, params, max_slots=args.slots,
             decode_chunk=args.decode_chunk, prompt_buckets=buckets,
-            pipeline_depth=args.pipeline_depth)
+            pipeline_depth=args.pipeline_depth,
+            chunked_prefill=chunked, **kw)
         rids = [None] * args.requests
         t_start = time.perf_counter()
 
@@ -208,33 +312,79 @@ def main(argv=None) -> int:
             finished.update(eng.pop_finished())
         wall = time.perf_counter() - t_start
         sub.join()
+        reqs = [finished[r] for r in rids]
         out = {r: np.asarray(finished[r].tokens, np.int32) for r in rids}
-        lats = [finished[r].finished_at - finished[r].submitted_at
-                for r in rids]
+        lats = [r.finished_at - r.submitted_at for r in reqs]
         eng.close()
-        return eng, out, wall, lats
+        return eng, out, wall, lats, reqs
 
-    eng, out, wall, lats = run_engine()  # warm: compiles everything
-    assert sum(len(v) for v in out.values()) == useful
-    eng, out, wall, lats = run_engine()
-    p50, p95 = _pcts(lats)
+    def measure(chunked: bool):
+        run_engine(chunked)  # warm: compiles everything
+        eng, out, wall, lats, reqs = run_engine(chunked)
+        assert sum(len(v) for v in out.values()) == useful
+        p50, p95 = _pcts(lats)
+        (tp50, tp95, ip50, ip95, imax,
+         sp50, sp95, smax) = _stream_stats(reqs)
+        return {
+            "tokens_per_sec": round(useful / wall, 1),
+            # raw values for downstream ratios — the rounded JSON
+            # fields above/below are for reading, not arithmetic
+            "_raw_tps": useful / wall,
+            "_raw_p95": p95,
+            "latency_p50_s": round(p50, 2),
+            "latency_p95_s": round(p95, 2),
+            "ttft_p50_s": round(tp50, 3),
+            "ttft_p95_s": round(tp95, 3),
+            "itl_p50_ms": round(1e3 * ip50, 2),
+            "itl_p95_ms": round(1e3 * ip95, 2),
+            "itl_max_ms": round(1e3 * imax, 2),
+            "stall_p50_ms": round(1e3 * sp50, 2),
+            "stall_p95_ms": round(1e3 * sp95, 2),
+            "stall_max_ms": round(1e3 * smax, 2),
+            "wasted_slot_frac": round(
+                eng.stats["wasted_slot_steps"]
+                / max(1, eng.stats["decode_steps"] * args.slots), 3),
+            "prefill_chunks": eng.stats["prefill_chunks"],
+            "_knobs": (eng.prefill_chunk, eng.max_tokens_per_round),
+        }
 
+    primary_chunked = args.engine != "monolithic"
+    m = measure(primary_chunked)
     result = {
         "metric": "serving_tokens_per_sec",
-        "value": round(useful / wall, 1),
+        "value": m["tokens_per_sec"],
         "unit": "useful tokens/sec",
         "requests": args.requests,
         "slots": args.slots,
         "decode_chunk": args.decode_chunk,
         "arrival_rate": args.arrival_rate,
+        "long_frac": args.long_frac,
+        "long_prompt": long_len if args.long_frac > 0 else 0,
+        "engine": "chunked" if primary_chunked else "monolithic",
+        "prefill_chunk": m["_knobs"][0] if primary_chunked else 0,
+        "max_tokens_per_round": m["_knobs"][1] if primary_chunked else 0,
         "quant": args.quant,
         "kv_quant": args.kv_quant,
-        "latency_p50_s": round(p50, 2),
-        "latency_p95_s": round(p95, 2),
-        "wasted_slot_frac": round(
-            eng.stats["wasted_slot_steps"]
-            / max(1, eng.stats["decode_steps"] * args.slots), 3),
     }
+    for k in ("latency_p50_s", "latency_p95_s", "ttft_p50_s",
+              "ttft_p95_s", "itl_p50_ms", "itl_p95_ms", "itl_max_ms",
+              "stall_p50_ms", "stall_p95_ms", "stall_max_ms",
+              "wasted_slot_frac", "prefill_chunks"):
+        result[k] = m[k]
+
+    if args.engine == "both":
+        mono = measure(False)
+        for k in ("tokens_per_sec", "latency_p95_s", "ttft_p50_s",
+                  "ttft_p95_s", "itl_p50_ms", "itl_p95_ms",
+                  "itl_max_ms", "stall_p50_ms", "stall_p95_ms",
+                  "stall_max_ms"):
+            result[f"mono_{k}"] = mono[k]
+        result["itl_p95_win"] = round(
+            mono["itl_p95_ms"] / max(1e-9, m["itl_p95_ms"]), 2)
+        result["stall_p95_win"] = round(
+            mono["stall_p95_ms"] / max(1e-9, m["stall_p95_ms"]), 2)
+        result["ttft_p95_win"] = round(
+            mono["ttft_p95_s"] / max(1e-9, m["ttft_p95_s"]), 2)
 
     # ---- static baseline (measured walls on a virtual clock) ----
     if not args.skip_static:
@@ -272,9 +422,12 @@ def main(argv=None) -> int:
         result["static_tokens_per_sec"] = round(useful / clock, 1)
         result["static_latency_p50_s"] = round(sp50, 2)
         result["static_latency_p95_s"] = round(sp95, 2)
-        result["vs_static"] = round(
-            (useful / wall) / (useful / clock), 2)
-        result["vs_static_p95_latency"] = round(sp95 / p95, 2)
+        # ratios from the RAW measurements, not the display-rounded
+        # JSON fields (a p95 that rounds to 0.00 would otherwise
+        # explode the ratio)
+        result["vs_static"] = round(m["_raw_tps"] / (useful / clock), 2)
+        result["vs_static_p95_latency"] = round(
+            sp95 / max(1e-9, m["_raw_p95"]), 2)
 
     print(json.dumps(result))
     return 0

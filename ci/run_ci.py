@@ -109,10 +109,28 @@ def main(argv=None) -> int:
         args.artifacts_dir, cases,
     )
     if not args.only_checks:
+        # fast serving-scheduler signal: the chunked-prefill tier-1
+        # tests (token-identity oracle, no-stall property, budget
+        # planner) plus the serving bench's --smoke JSON-shape check
+        # run first on CPU devices — a scheduler regression surfaces
+        # in ~a minute instead of after the full unit stage
+        ok = ok and stage(
+            "serving-sched",
+            [py, "-m", "pytest", "tests/test_serving_sched.py",
+             "tests/test_benches.py::TestBenches::test_serving_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_serving_sched.xml"],
+            args.artifacts_dir, cases,
+        )
         # slow-marked tests (the chaos soak) run in their own stage
         # below, never inside the tier-1 unit run
         marker = "not slow and not integration" if args.skip_slow else "not slow"
         pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q", "-m", marker,
+                      # already ran (and gated) in the serving-sched
+                      # stage above — don't pay for them twice
+                      "--ignore=tests/test_serving_sched.py",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_serving_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(

@@ -24,16 +24,34 @@ def prefetch_to_device(
     stop = threading.Event()
     _SENTINEL = object()
 
+    def _put(item) -> bool:
+        """Bounded put that a departed consumer cannot wedge: an
+        abandoning consumer sets ``stop`` and walks away, so a plain
+        blocking ``q.put`` into a full queue would park the producer
+        thread forever (the old shutdown leak — worse, its sentinel
+        put in ``finally`` could block too, pinning the thread, the
+        iterator, and every device batch in the queue for the process
+        lifetime). Timeout-put + stop-check keeps the producer's exit
+        latency bounded by one timeout tick."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def producer():
         try:
             for batch in it:
                 if stop.is_set():
                     return
-                q.put(sharder(batch))
+                if not _put(sharder(batch)):
+                    return
         except Exception as e:  # propagate into the consumer
-            q.put(e)
+            _put(e)
         finally:
-            q.put(_SENTINEL)
+            _put(_SENTINEL)
 
     t = threading.Thread(target=producer, daemon=True, name="prefetch")
     t.start()
@@ -47,6 +65,11 @@ def prefetch_to_device(
             yield item
     finally:
         stop.set()
-        # drain so the producer unblocks
+        # free queued device batches promptly (the producer no longer
+        # needs this drain to unblock — _put checks stop — but batches
+        # sitting in an orphaned queue would pin HBM until GC)
         while not q.empty():
-            q.get_nowait()
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

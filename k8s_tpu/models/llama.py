@@ -88,8 +88,13 @@ class LlamaConfig:
     # own cache depth. The append index comes from ``positions[:, 0]``
     # per row instead of a shared scalar "cache_index" variable — the
     # caller (k8s_tpu/serving's engine) owns per-slot lengths and the
-    # cache has no index state at all. Requires decode=True; prefill
-    # (s > 1) must be a fresh cache (one slot at position 0).
+    # cache has no index state at all. Requires decode=True. Prefill
+    # (s > 1) comes in two flavors: a FRESH cache is a first chunk
+    # (offset 0 by contract — rides the flash kernel), a warm cache is
+    # a CONTINUATION chunk appended at the per-row offset carried in
+    # ``positions[:, 0]`` — chunked prefill writes a prompt into its
+    # slot across multiple calls (the serving engine's token-budget
+    # scheduler interleaves these chunks with decode).
     ragged_decode: bool = False
 
     @staticmethod
@@ -336,13 +341,12 @@ class LlamaAttention(nn.Module):
                 )
             if cfg.ragged_decode:
                 # engine-owned depths: positions[:, 0] IS the per-row
-                # append index; the cache carries no index state
-                if s > 1 and not fresh_cache:
-                    raise ValueError(
-                        "ragged_decode prefill (s > 1) must start from "
-                        "a fresh cache: continuation chunks have no "
-                        "well-defined per-row write offset"
-                    )
+                # append index; the cache carries no index state. A
+                # warm-cache s > 1 call is a chunked-prefill
+                # continuation: rows [offset, offset+s) append at the
+                # per-row offset and attention sees exactly
+                # cache[:offset] + the chunk's own causal prefix (the
+                # per-row position mask below)
                 idx = None
                 cur = positions[:, 0]
             else:
@@ -377,12 +381,14 @@ class LlamaAttention(nn.Module):
                 out = out[:, None]  # [B, 1, Hq, D]
             else:
                 # XLA-fallback cache writes. Three index regimes:
-                # shared scalar (classic decode), ragged prefill
-                # (fresh slot, always offset 0), ragged single-token
-                # (per-row offsets via vmapped DUS).
+                # shared scalar (classic decode), ragged FIRST prefill
+                # chunk (fresh cache, offset 0 by contract), ragged
+                # per-row offsets via vmapped DUS (single-token decode
+                # AND warm-cache continuation chunks — DUS writes all
+                # s rows of a chunk at each row's own offset).
                 if not cfg.ragged_decode:
                     row_at, scale_at = cur, cur
-                elif s > 1:
+                elif s > 1 and fresh_cache:
                     row_at, scale_at = 0, 0
                 else:
                     row_at = scale_at = None  # vmapped per-row below

@@ -15,10 +15,18 @@ Run config (``KTPU_PROGRAM_ARGS``):
                             orbax layout); random init when empty
   --max_seq_len=N           KV-cache depth per slot (default 256)
   --max_slots=N             static decode batch width (default 8)
-  --decode_chunk=N          decode steps per host round-trip (default 16
-                            — the low-RTT setting; raise to 64 on a
-                            tunnel transport, docs/BENCHMARKS.md)
+  --decode_chunk=N          decode steps per host round-trip (default 32
+                            — the engine's reconciled default: amortizes
+                            tunnel RTT while keeping the scheduling
+                            quantum small; docs/SERVING.md)
   --pipeline_depth=N        chunks in flight ahead of harvest (default 2)
+  --chunked_prefill=0|1     token-budget chunked prefill (default 1;
+                            0 = legacy one-shot prefill, prompts capped
+                            at the largest bucket)
+  --prefill_chunk=N         max padded tokens per prefill chunk
+                            (default 256)
+  --max_tokens_per_round=N  per-round token budget (default:
+                            prefill_chunk + max_slots*decode_chunk)
   --prompt_buckets=a,b,c    static prefill lengths (default: powers of
                             two < max_seq_len starting at 16)
   --temperature=F           0 = greedy (default)
@@ -67,8 +75,13 @@ def main(rdzv) -> None:
     model_name = extra.get("model", "tiny")
     max_seq = int(extra.get("max_seq_len", "256"))
     max_slots = int(extra.get("max_slots", "8"))
-    decode_chunk = int(extra.get("decode_chunk", "16"))
+    decode_chunk = int(extra.get("decode_chunk", "32"))
     pipeline_depth = int(extra.get("pipeline_depth", "2"))
+    chunked_prefill = bool(int(extra.get("chunked_prefill", "1")))
+    prefill_chunk = int(extra.get("prefill_chunk", "256"))
+    max_tokens_per_round = (
+        int(extra["max_tokens_per_round"])
+        if "max_tokens_per_round" in extra else None)
     temperature = float(extra.get("temperature", "0"))
     eos_id = int(extra["eos_id"]) if "eos_id" in extra else None
     port = int(extra.get("port", "0"))
@@ -109,6 +122,8 @@ def main(rdzv) -> None:
         max_slots=max_slots, temperature=temperature, eos_id=eos_id,
         decode_chunk=decode_chunk, prompt_buckets=buckets,
         pipeline_depth=pipeline_depth,
+        chunked_prefill=chunked_prefill, prefill_chunk=prefill_chunk,
+        max_tokens_per_round=max_tokens_per_round,
     )
     frontend = ServingFrontend(engine, host=host, port=port)
     # use the SIGTERM grace period to drain instead of dying mid-request
@@ -117,6 +132,9 @@ def main(rdzv) -> None:
         "event": "serving_ready", "port": frontend.port,
         "model": model_name, "max_slots": max_slots,
         "decode_chunk": decode_chunk, "prompt_buckets": buckets,
+        "chunked_prefill": chunked_prefill,
+        "prefill_chunk": engine.prefill_chunk,
+        "max_tokens_per_round": engine.max_tokens_per_round,
         "restored": bool(cfg.checkpoint_dir),
     }), flush=True)
     frontend.serve(should_stop=preempt_requested)

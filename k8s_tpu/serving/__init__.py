@@ -4,7 +4,9 @@ The reference framework has no serving path at all (its operator only
 wires *training* clusters — SURVEY.md §0); this package is original
 capability built on the repo's decode stack: the fused single-token
 decode kernel (`k8s_tpu/ops/attention.py`) extended with per-row cache
-depths, and `LlamaConfig(ragged_decode=True)`.
+depths, and `LlamaConfig(ragged_decode=True)`. Prompts prefill in
+bounded chunks under a per-round token budget (docs/SERVING.md), so a
+long admission never stalls in-flight decode streams.
 """
 
 from k8s_tpu.serving.engine import ContinuousBatchingEngine, Request

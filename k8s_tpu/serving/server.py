@@ -30,7 +30,9 @@ shipped image as the launcher):
   → ``{"rid": n, "tokens": [int, ...], "latency_s": s}`` (blocks until
   the request finishes; token-id interface — tokenization is the
   caller's, same contract as :func:`k8s_tpu.models.llama.generate`).
-- ``GET /healthz`` → engine stats + in-flight counts (the operator's
+- ``GET /healthz`` → engine stats (TTFT, queue depth, prefill/decode
+  counters), the in-flight partial prompt's prefill progress, and the
+  scheduler's knobs + in-flight counts (the operator's
   ``--health-port`` idiom, per-pod).
 """
 
@@ -84,12 +86,30 @@ class ServingFrontend:
                     return self._json(404, {"error": "not found"})
                 with frontend._lock:
                     in_flight = len(frontend._waiters)
+                eng = frontend.engine
+                # scheduler observability (chunked prefill): queue
+                # depth and TTFT ride in stats; the in-flight partial
+                # prompt's progress and the scheduling knobs are
+                # engine attributes (getattr: stubs/legacy engines
+                # without them still serve a valid payload)
+                progress = getattr(eng, "prefill_progress", dict)()
                 return self._json(200, {
                     "ok": not frontend._draining,
                     "draining": frontend._draining,
                     "in_flight": in_flight,
                     "served": frontend.served,
                     "abandoned": frontend.abandoned,
+                    "prefill_progress": {
+                        str(rid): p for rid, p in progress.items()},
+                    "scheduler": {
+                        "chunked_prefill": getattr(
+                            eng, "chunked_prefill", None),
+                        "decode_chunk": getattr(eng, "decode_chunk", None),
+                        "prefill_chunk": getattr(
+                            eng, "prefill_chunk", None),
+                        "max_tokens_per_round": getattr(
+                            eng, "max_tokens_per_round", None),
+                    },
                     "stats": {k: round(v, 4) if isinstance(v, float) else v
                               for k, v in frontend.engine.stats.items()},
                 })

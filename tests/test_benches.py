@@ -56,6 +56,22 @@ class TestBenches:
         out = _last_json_line(capsys)
         assert out["value"] > 0 and out["quant"] == "int8"
 
+    def test_serving_bench_smoke(self, capsys):
+        """--smoke must emit the full serving JSON line shape — the CI
+        serving-sched stage and the bench harness track these keys."""
+        from benches import serving_bench
+
+        assert serving_bench.main(["--smoke", "--engine", "both"]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "serving_tokens_per_sec"
+        assert out["value"] > 0
+        for k in ("ttft_p50_s", "ttft_p95_s", "itl_p50_ms", "itl_p95_ms",
+                  "latency_p95_s", "long_frac", "long_prompt",
+                  "prefill_chunk", "max_tokens_per_round",
+                  "mono_itl_p95_ms", "itl_p95_win", "vs_static"):
+            assert k in out, k
+        assert out["engine"] == "chunked" and out["long_frac"] > 0
+
     def test_decode_bench_int8_serving(self, capsys):
         from benches import decode_bench
 

@@ -130,3 +130,26 @@ class TestPrefetch:
         )
         for _ in range(3):
             next(it)  # infinite source; bounded buffer must not OOM
+
+    def test_producer_terminates_when_consumer_abandons(self):
+        """A consumer that walks away mid-stream (generator .close(),
+        e.g. a training loop hitting its step budget) must not leave
+        the producer thread parked forever in a blocking q.put() —
+        the old shutdown leak pinned the thread, the iterator, and a
+        buffer of device batches for the process lifetime."""
+        import threading
+
+        mesh = build_mesh(MeshConfig(data=8))
+        sharder = make_batch_sharder(mesh, LogicalRules(LogicalRules.DP))
+        before = set(threading.enumerate())
+        it = prefetch_to_device(
+            synthetic_token_batches(8, 16, 100), sharder, buffer_size=1
+        )
+        next(it)  # producer is now live and blocked filling the buffer
+        producers = [t for t in threading.enumerate()
+                     if t.name == "prefetch" and t not in before]
+        assert producers, "prefetch producer thread not found"
+        it.close()  # abandon mid-stream
+        for t in producers:
+            t.join(timeout=5)
+            assert not t.is_alive(), "producer leaked after abandon"

@@ -152,18 +152,35 @@ class TestRaggedModel:
         for k, v in fr.items():
             assert np.array_equal(np.asarray(v), np.asarray(fs[k])), k
 
-    def test_ragged_continuation_prefill_rejected(self):
+    def test_ragged_continuation_prefill_appends_at_offset(self):
+        """s > 1 on a warm cache is a chunked-prefill CONTINUATION
+        (it used to raise): rows append at the per-row offset carried
+        in positions[:, 0], leaving earlier rows intact. Deeper
+        equivalence proofs live in test_serving_sched.py."""
         m = LlamaForCausalLM(LlamaConfig.tiny(ragged_decode=True, **_TINY))
-        prompt = jnp.zeros((1, 8), jnp.int32)
+        prompt = jnp.asarray(
+            np.arange(1, 17, dtype=np.int32).reshape(1, 16))
         params = nn.unbox(m.init(jax.random.PRNGKey(0), prompt)["params"])
-        _, mut = m.apply({"params": params}, prompt,
+        _, mut = m.apply({"params": params}, prompt[:, :8],
                          positions=jnp.broadcast_to(jnp.arange(8), (1, 8)),
                          mutable=["cache"])
-        with pytest.raises(ValueError, match="fresh cache"):
-            m.apply({"params": params, "cache": mut["cache"]},
-                    prompt, positions=8 + jnp.broadcast_to(
-                        jnp.arange(8), (1, 8)),
-                    mutable=["cache"])
+        before = jax.tree_util.tree_map(np.asarray, mut["cache"])
+        _, mut2 = m.apply({"params": params, "cache": mut["cache"]},
+                          prompt[:, 8:], positions=8 + jnp.broadcast_to(
+                              jnp.arange(8), (1, 8)),
+                          mutable=["cache"])
+        from flax.traverse_util import flatten_dict
+
+        fb, fa = flatten_dict(before), flatten_dict(mut2["cache"])
+        for k, v in fa.items():
+            v = np.asarray(v)
+            rows_axis = v.ndim - 2  # [B, Hkv, S, D]
+            # rows [0, 8) untouched, rows [8, 16) newly written
+            old = np.take(v, np.arange(8), axis=rows_axis)
+            assert np.array_equal(
+                old, np.take(fb[k], np.arange(8), axis=rows_axis)), k
+            new = np.take(v, np.arange(8, 16), axis=rows_axis)
+            assert np.abs(new).sum() > 0, k
 
 
 def _mk_engine(params, max_slots, **kw):
@@ -204,12 +221,17 @@ class TestEngineUntrained:
         eng = _mk_engine(params, max_slots=1)
         with pytest.raises(ValueError, match="empty"):
             eng.submit(np.zeros(0, np.int32), 4)
-        with pytest.raises(ValueError, match="largest bucket"):
-            eng.submit(np.zeros(17, np.int32), 4)
         with pytest.raises(ValueError, match="exceeds"):
             eng.submit(np.zeros(8, np.int32), 60)
         with pytest.raises(ValueError, match="max_new_tokens"):
             eng.submit(np.zeros(4, np.int32), 0)
+        # chunked prefill lifted the largest-bucket cap: a 17-token
+        # prompt (> bucket 16) is admissible now; the legacy one-shot
+        # engine keeps the cap
+        eng.submit(np.zeros(17, np.int32), 4)
+        mono = _mk_engine(params, max_slots=1, chunked_prefill=False)
+        with pytest.raises(ValueError, match="largest bucket"):
+            mono.submit(np.zeros(17, np.int32), 4)
 
     def test_requires_ragged_decode_config(self):
         m, params = self._params()
