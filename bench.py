@@ -166,9 +166,20 @@ def main() -> int:
     # through remote-tunnel PJRT transports block_until_ready can return
     # before execution completes (observed: a chained 8192^3 matmul loop
     # "finishing" at 100x hardware peak), while a value fetch cannot lie
-    for _ in range(warmup):
-        state, metrics = step(state, batch, rng)
-    float(metrics["loss"])
+    #
+    # the warmup pays the compile: capture fd-2 there so XLA's SPMD
+    # warning spew is (a) counted into the JSON the driver parses
+    # ("spmd_involuntary_remat" — the resharding-fallback trajectory)
+    # and (b) replayed to stderr as one block instead of interleaving
+    # with the machine-parsed last stdout line (MULTICHIP_r05's
+    # polluted tail)
+    from k8s_tpu.tools.hlo_lint import capture_stderr, count_involuntary_remat
+
+    with capture_stderr() as cap:
+        for _ in range(warmup):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])
+    spmd_remat = count_involuntary_remat(cap.text)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -197,10 +208,16 @@ def main() -> int:
         llama = {
             "llama_train_tokens_per_sec_per_chip": res["value"],
             "llama_mfu": res.get("mfu"),
+            "llama_step_time_ms": res.get("step_time_ms"),
+            "llama_collective_budget": res.get("collective_budget"),
         }
+        spmd_remat += int(res.get("spmd_involuntary_remat") or 0)
     except Exception as e:  # noqa: BLE001
         llama = {"llama_error": f"{type(e).__name__}: {e}"}
 
+    # the driver parses the LAST stdout line: flush stderr first so no
+    # late warning text can interleave into it
+    sys.stderr.flush()
     print(
         json.dumps(
             {
@@ -208,9 +225,11 @@ def main() -> int:
                 "value": round(images_per_sec_per_chip, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": 1.0,
+                "spmd_involuntary_remat": spmd_remat,
                 **llama,
             }
-        )
+        ),
+        flush=True,
     )
     return 0
 

@@ -42,7 +42,15 @@ def bench(fn, q, k, v, iters=50):
 
 
 def main(argv=None) -> int:
-    on_tpu = jax.default_backend() == "tpu"
+    import argparse
+
+    p = argparse.ArgumentParser(prog="attention-bench")
+    p.add_argument("--smoke", action="store_true",
+                   help="force the tiny interpret-mode row on any backend "
+                        "— a wiring/JSON-shape check "
+                        "(tests/test_benches.py), never a measurement")
+    args = p.parse_args(argv)
+    on_tpu = jax.default_backend() == "tpu" and not args.smoke
     if on_tpu:
         seqs, iters, interpret = (1024, 2048, 4096, 8192), 50, False
     else:

@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-experts", type=int, default=0,
                    help=">0: top-2 MoE MLP with this many experts "
                         "(intermediate_size shrinks to fit HBM)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes + 1 timed iter on any backend — a "
+                        "JSON-shape wiring check (tests/test_benches.py), "
+                        "never a measurement")
+    p.add_argument("--latency-hiding", action="store_true",
+                   help="compile the step with XLA's latency-hiding "
+                        "scheduler (async collectives; docs/PERF.md)")
     return p
 
 
@@ -66,7 +73,8 @@ def measure(args) -> dict:
     driver-facing bench.py so BENCH_r*.json records the LLM train path
     alongside resnet (VERDICT r4 item 3)."""
     n = len(jax.devices())
-    on_accel = jax.default_backend() in ("tpu", "gpu")
+    smoke = getattr(args, "smoke", False)
+    on_accel = jax.default_backend() in ("tpu", "gpu") and not smoke
     if on_accel:
         base = dict(
             vocab_size=32768, hidden_size=1536, intermediate_size=4096,
@@ -88,7 +96,7 @@ def measure(args) -> dict:
                                remat_policy=args.remat_policy,
                                quant=args.quant,
                                num_experts=args.num_experts)
-        batch, seq, warmup, iters = 2 * n, 128, 1, 3
+        batch, seq, warmup, iters = 2 * n, 128, 1, (1 if smoke else 3)
 
     mesh = build_mesh(MeshConfig(data=n))
     rules = LogicalRules(LogicalRules.DP)
@@ -119,19 +127,32 @@ def measure(args) -> dict:
                 mutable=["intermediates"],
             )
             ce = fused_lm_head_cross_entropy(
-                hidden[:, :-1], params["lm_head"]["kernel"], b["ids"][:, 1:]
+                hidden[:, :-1], params["lm_head"]["kernel"], b["ids"][:, 1:],
+                mesh=mesh,
             )
             return ce + sum_sown_losses(mut.get("intermediates", {})), {}
 
-    step = make_train_step(loss_fn, mesh, rules)
+    step = make_train_step(
+        loss_fn, mesh, rules,
+        latency_hiding=getattr(args, "latency_hiding", False),
+    )
     rng = jax.random.PRNGKey(1)
     data = make_batch_sharder(mesh, rules)(
         {"ids": jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)}
     )
 
-    for _ in range(warmup):
-        state, metrics = step(state, data, rng)
-    float(metrics["loss"])
+    # the warmup pays the compile: capture the SPMD partitioner's
+    # C++-stderr spew there so (a) involuntary-resharding fallbacks are
+    # COUNTED into the payload the trajectory tracks and (b) the
+    # warnings re-emit as one stderr block, never interleaved with the
+    # machine-parsed JSON line (they are replayed on context exit)
+    from k8s_tpu.tools.hlo_lint import capture_stderr, count_involuntary_remat
+
+    with capture_stderr() as cap:
+        for _ in range(warmup):
+            state, metrics = step(state, data, rng)
+        float(metrics["loss"])
+    spmd_remat = count_involuntary_remat(cap.text)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -139,6 +160,36 @@ def measure(args) -> dict:
     loss = float(metrics["loss"])
     elapsed = time.perf_counter() - t0
     assert loss == loss, "loss is NaN"
+
+    # attach the collective budget of the step actually measured: the
+    # linter's view of the EXECUTED program (step.jitted.compiled
+    # reuses the latency-hiding AOT cache entry, so the lint describes
+    # the same schedule that was timed — incl. its async fraction).
+    # Best-effort — a lint failure must never zero out the throughput
+    # record. Single-device meshes have no collectives: skip the
+    # compile and attach the empty budget directly.
+    budget = None
+    try:
+        if mesh.size == 1:
+            budget = {"collectives": {}, "backward": {},
+                      "async_fraction": None, "total_collective_gib": 0.0}
+        else:
+            import flax.linen as nn
+
+            from k8s_tpu.tools.hlo_lint import lint_compiled
+
+            with nn.logical_axis_rules(rules.to_flax()):
+                compiled = step.jitted.compiled(state, data, rng)
+            rep = lint_compiled(compiled, mesh)
+            budget = {
+                "collectives": rep["collectives"],
+                "backward": rep["backward"],
+                "async_fraction": rep["async_fraction"],
+                "total_collective_gib": round(
+                    rep["total_collective_bytes"] / 2**30, 3),
+            }
+    except Exception:  # noqa: BLE001
+        budget = None
 
     tokens_per_sec_chip = iters * batch * seq / elapsed / n
     # 6ND for fwd+bwd; the remat forward recompute is NOT counted
@@ -158,6 +209,11 @@ def measure(args) -> dict:
         "unit": "tokens/sec/chip",
         "params": n_params,
         "mfu": mfu,
+        "step_time_ms": round(elapsed / iters * 1000, 2),
+        "spmd_involuntary_remat": spmd_remat,
+        "latency_hiding": bool(getattr(args, "latency_hiding", False)),
+        "collective_budget": budget,
+        **({"mode": "smoke"} if smoke else {}),
     }
 
 

@@ -122,6 +122,19 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_sched.xml"],
             args.artifacts_dir, cases,
         )
+        # collective-budget gate (ISSUE 3): compile the stand-in sharded
+        # train steps on the 8-device virtual CPU mesh and enforce their
+        # golden budget manifests (ci/hlo_budgets/) — a sharding
+        # regression that sneaks a new all-gather into the backward pass
+        # or reintroduces involuntary-resharding fallbacks fails HERE,
+        # in ~a minute, not as warning spew in a dryrun log. The full
+        # north-star configs get the same check via `aot-northstar
+        # --lint` below when the deviceless TPU compiler is available.
+        ok = ok and stage(
+            "hlo-budget",
+            [py, "-m", "k8s_tpu.tools.hlo_lint", "--check"],
+            args.artifacts_dir, cases,
+        )
         # slow-marked tests (the chaos soak) run in their own stage
         # below, never inside the tier-1 unit run
         marker = "not slow and not integration" if args.skip_slow else "not slow"
@@ -159,7 +172,7 @@ def main(argv=None) -> int:
         if not args.skip_slow:
             ok = ok and stage(
                 "aot-northstar",
-                [py, "-m", "k8s_tpu.tools.aot_check", "--all",
+                [py, "-m", "k8s_tpu.tools.aot_check", "--all", "--lint",
                  "--skip-if-unsupported",
                  "--json", f"{args.artifacts_dir}/aot_northstar.json"],
                 args.artifacts_dir, cases,

@@ -76,6 +76,13 @@ def configure_platform(env=None):
     platform = env.get("KTPU_FORCE_PLATFORM", "")
     if platform:
         jax.config.update("jax_platforms", platform)
+    if env.get("KTPU_LATENCY_HIDING", "") in ("1", "true"):
+        # async-collective scheduling (docs/PERF.md): the libtpu flags
+        # must land before the TPU backend initializes — this is the
+        # earliest per-job hook (pod env → launcher → program)
+        from k8s_tpu.parallel.mesh import enable_latency_hiding
+
+        enable_latency_hiding(env)
     n_cpu = env.get("KTPU_NUM_CPU_DEVICES", "")
     if n_cpu and platform == "cpu":
         try:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from k8s_tpu.ops.attention import flash_attention
 from k8s_tpu.ops.norms import rms_norm
+from k8s_tpu.parallel.sharding import logical_constraint, sharded_embedding_lookup
 
 
 @dataclasses.dataclass(frozen=True)
@@ -279,9 +280,9 @@ class LlamaAttention(nn.Module):
                        cfg.dtype, cfg.quant)(x)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        q = nn.with_logical_constraint(q, ("batch", "length", "heads", "head_dim"))
-        k = nn.with_logical_constraint(k, ("batch", "length", "kv_heads", "head_dim"))
-        v = nn.with_logical_constraint(v, ("batch", "length", "kv_heads", "head_dim"))
+        q = logical_constraint(q, ("batch", "length", "heads", "head_dim"), cfg.mesh)
+        k = logical_constraint(k, ("batch", "length", "kv_heads", "head_dim"), cfg.mesh)
+        v = logical_constraint(v, ("batch", "length", "kv_heads", "head_dim"), cfg.mesh)
         # named so remat policies can pin the post-rope projections:
         # the flash backward consumes q/k/v directly, so saving them
         # (84 MB/layer at the 705M bench) removes the qkv-GEMM + rope
@@ -532,7 +533,7 @@ class LlamaMLP(nn.Module):
             up = _dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj",
                         cfg.dtype, cfg.quant)(x)
         y = nn.silu(gate) * up
-        y = nn.with_logical_constraint(y, ("batch", "length", "mlp"))
+        y = logical_constraint(y, ("batch", "length", "mlp"), cfg.mesh)
         return _dense(cfg.hidden_size, ("mlp_down", "embed"), "down_proj", cfg.dtype,
                       cfg.quant)(y)
 
@@ -558,7 +559,7 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.config
-        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        x = logical_constraint(x, ("batch", "length", "embed"), cfg.mesh)
         h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
         x = x + LlamaAttention(cfg, name="attn")(h, positions, segment_ids)
         h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
@@ -619,7 +620,11 @@ class LlamaForCausalLM(nn.Module):
             ),
             name="embed_tokens",
         )
-        x = embed(input_ids)
+        # use-site-gathered lookup with explicit boundary shardings —
+        # see parallel.sharding.sharded_embedding_lookup (shared with
+        # the pipeline apply path so the two lookups cannot drift)
+        x = sharded_embedding_lookup(
+            embed.embedding, input_ids, cfg.mesh, dtype=cfg.dtype)
         if cfg.scan_layers:
             block_cls = _ScannedBlock
             if cfg.remat:

@@ -49,6 +49,7 @@ def fused_lm_head_cross_entropy(
     target_chunk: int = 8192,
     bias: Optional[jax.Array] = None,  # [V] head bias (BERT-style heads)
     compute_dtype: Optional[jnp.dtype] = None,
+    mesh=None,  # jax Mesh: pin boundary shardings (see below)
 ) -> jax.Array:
     """Mean token cross-entropy of ``softmax(hidden @ kernel + bias)``
     vs ``labels``, computed without materializing the full logits.
@@ -66,8 +67,21 @@ def fused_lm_head_cross_entropy(
     default since accumulation stays f32 either way; pass
     ``jnp.float32`` for bit-closer parity with the unfused loss (small
     vocabs, parity tests).
+
+    ``mesh`` (with a ``nn.logical_axis_rules`` scope active) pins the
+    loss-boundary shardings explicitly: ``hidden`` stays on its
+    activation layout (batch/length-sharded, embed replicated) and the
+    head chunks keep only their vocab sharding — so each chunk matmul
+    all-gathers the SMALL ``[E, Vc]`` weight block instead of GSPMD
+    involuntarily full-rematerializing the [B, S, E] activations into
+    an embed-sharded layout inside the scan (the MULTICHIP_r05
+    fallback). Leave None on single-mesh-free callers.
     """
     e, v = kernel.shape
+    if mesh is not None:
+        from k8s_tpu.parallel.sharding import logical_constraint
+
+        hidden = logical_constraint(hidden, ("batch", "length", "embed"), mesh)
     num_chunks = _pick_num_chunks(v, target_chunk)
     vc = -(-v // num_chunks)  # chunk size, last chunk possibly padded
     cdt = compute_dtype if compute_dtype is not None else hidden.dtype
@@ -83,11 +97,29 @@ def fused_lm_head_cross_entropy(
     # [E, C*Vc] -> [C, E, Vc]: one transposed copy outside the scan; its
     # gradient is the inverse reshape of the stacked per-chunk dW.
     w_chunks = kernel.reshape(e, num_chunks, vc).transpose(1, 0, 2)
+    if mesh is not None:
+        # anchor the stacked chunks on the PARAM layout (embed/vocab
+        # sharding carried through the reshape): the backward's
+        # dynamic-update-slice dW accumulator adopts it instead of
+        # GSPMD guessing a layout mid-scan and full-rematerializing
+        from k8s_tpu.parallel.sharding import logical_constraint
+
+        w_chunks = logical_constraint(w_chunks, (None, "embed", "vocab"), mesh)
     b_chunks = None if bias is None else bias.reshape(num_chunks, vc)
     bases = (jnp.arange(num_chunks) * vc).astype(labels.dtype)
 
     @jax.checkpoint
     def chunk_stats(x, w_c, b_c, base):
+        if mesh is not None:
+            # un-shard THIS chunk's embed dim only (ZeRO use-site
+            # gather of one small [E, Vc] block per scan step, not the
+            # whole head): the contraction stays local and the logits
+            # chunk comes out batch/length-sharded × vocab-sharded —
+            # GSPMD left alone reshards the [B, S, E] activations
+            # embed-wise inside the scan instead (involuntary remat)
+            from k8s_tpu.parallel.sharding import logical_constraint
+
+            w_c = logical_constraint(w_c, (None, "vocab"), mesh)
         logits_c = jax.lax.dot_general(
             x.astype(cdt),
             w_c.astype(cdt),

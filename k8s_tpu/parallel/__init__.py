@@ -18,7 +18,9 @@ from k8s_tpu.parallel.ulysses import (  # noqa: F401
 )
 from k8s_tpu.parallel.sharding import (  # noqa: F401
     LogicalRules,
+    logical_constraint,
     logical_sharding,
+    resolve_logical_axes,
     shard_init,
     with_sharding,
 )

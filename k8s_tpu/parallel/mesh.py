@@ -112,3 +112,71 @@ def best_pow2_split(n: int, max_first: int) -> Tuple[int, int]:
     while first * 2 <= max_first and n % (first * 2) == 0:
         first *= 2
     return first, n // first
+
+
+# ---------------------------------------------------------------------------
+# Latency-hiding scheduler (async collectives)
+# ---------------------------------------------------------------------------
+
+# XLA:TPU's latency-hiding scheduler turns the blocking collectives the
+# SPMD partitioner emits (FSDP per-layer all-gathers, TP activation
+# all-reduces, the gradient reduce-scatter) into async start/done pairs
+# and schedules compute between them — the megascale recipe for hiding
+# ICI/DCN time behind the MXU. These are the curated libtpu flags; they
+# are read ONCE at TPU-backend init, hence the env-var route (the knob
+# must be set before the first device query).
+LATENCY_HIDING_LIBTPU_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_data_parallel_all_reduce_opt=true",
+    "--xla_tpu_data_parallel_opt_different_sized_ops=true",
+)
+
+
+def enable_latency_hiding(env=None) -> bool:
+    """Turn on XLA's latency-hiding scheduler for this process's TPU
+    backend by appending the flag set to ``LIBTPU_INIT_ARGS``.
+
+    Idempotent; returns False (and changes nothing) when the jax
+    backend is already initialized — libtpu has read the env var by
+    then, so a late call would silently do nothing, which is worse than
+    an honest refusal. Call it before the first device query (programs
+    do this at startup under ``KTPU_LATENCY_HIDING=1``). Off-TPU the
+    env var is ignored by every other backend — safe to set
+    unconditionally in launch configs."""
+    import os
+
+    if env is None:
+        env = os.environ
+    current = env.get("LIBTPU_INIT_ARGS", "")
+    missing = [f for f in LATENCY_HIDING_LIBTPU_FLAGS if f not in current]
+    if not missing:
+        return True
+    try:
+        import jax.extend.backend as _jeb  # noqa: F401
+
+        import jax
+
+        initialized = jax._src.xla_bridge._backends  # type: ignore[attr-defined]
+        if initialized:
+            return False
+    except Exception:
+        pass  # cannot introspect: set the env var anyway
+    env["LIBTPU_INIT_ARGS"] = (current + " " + " ".join(missing)).strip()
+    return True
+
+
+def latency_hiding_compiler_options() -> dict:
+    """The same scheduler knobs as per-compile XLA options — for AOT
+    paths (``lowered.compile(compiler_options=...)``) where backend-init
+    env vars are already too late. TPU compiles only; other backends
+    reject the unknown flags."""
+    return {
+        f.lstrip("-").split("=")[0]: f.split("=")[1]
+        for f in LATENCY_HIDING_LIBTPU_FLAGS
+    }

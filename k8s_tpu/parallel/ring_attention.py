@@ -330,6 +330,30 @@ def ring_flash_attention_sharded(
     )
 
 
+def _resolve_seq_parallel_axes(axis_name, batch_axes, head_axis):
+    """Consistent logical rules at the shard_map hand-off: when a
+    ``nn.logical_axis_rules`` scope is active, derive the ring/Ulysses
+    boundary layout (batch/length/heads mesh axes) from the SAME rules
+    table the model's boundary constraints resolve against — a rules
+    change then moves both sides together instead of the hardcoded
+    defaults silently diverging and forcing a reshard (or an
+    involuntary-remat fallback) at the hand-off. Without a rules scope
+    the defaults stand (the manual-caller contract)."""
+    from k8s_tpu.parallel.sharding import resolve_logical_axes
+
+    spec = resolve_logical_axes(("batch", "length", "heads"))
+    if spec is None:
+        return axis_name, batch_axes, head_axis
+    b_ax, l_ax, h_ax = tuple(spec)
+    if b_ax is not None:
+        batch_axes = b_ax
+    if isinstance(l_ax, str):
+        axis_name = l_ax
+    if isinstance(h_ax, str):
+        head_axis = h_ax
+    return axis_name, batch_axes, head_axis
+
+
 def seq_parallel_call(
     body,
     mesh: Mesh,
@@ -386,6 +410,8 @@ def ring_attention(
     flash kernels take separate q-side/kv-side rows, so segment chunks
     rotate around the ring with their KV chunk.
     """
+    axis_name, batch_axes, head_axis = _resolve_seq_parallel_axes(
+        axis_name, batch_axes, head_axis)
     if impl is None:
         d = q.shape[-1]
         n = mesh.shape[axis_name]

@@ -144,11 +144,76 @@ def logical_sharding(
     return NamedSharding(mesh, rules.spec(logical_axes))
 
 
+def resolve_logical_axes(logical_axes: Sequence[Optional[str]]) -> Optional[P]:
+    """Resolve logical axis names against the AMBIENT flax rules scope
+    (``nn.logical_axis_rules``) into a PartitionSpec, with flax's exact
+    once-per-spec mesh-axis semantics (a mesh axis consumed by an
+    earlier rule is skipped later — the ZeRO layout trick the FSDP
+    table relies on). Returns None when no rules are in scope."""
+    from flax.linen import spmd as _spmd
+
+    rules = _spmd._axis_rules.rules
+    if not rules:
+        return None
+    axes = _spmd._logical_to_mesh_axes(tuple(logical_axes), rules)
+    if axes is None:
+        return None
+    # unmatched names fall back to unsharded (flax AXIS_IS_UNSHARDED)
+    clean = [a if isinstance(a, (str, tuple)) or a is None else None
+             for a in axes]
+    return P(*clean)
+
+
+def logical_constraint(x, logical_axes: Sequence[Optional[str]],
+                       mesh: Optional[Mesh] = None):
+    """``nn.with_logical_constraint`` that is NOT a silent no-op on CPU.
+
+    flax's helper short-circuits whenever ``jax.devices()[0]`` is a CPU
+    — which is exactly where the multichip dryruns and the virtual-mesh
+    test harness compile, so every in-model boundary annotation
+    vanished there and GSPMD had to re-derive activation layouts from
+    the params alone: the source of the "Involuntary full
+    rematerialization" spew in MULTICHIP_r05. With an explicit ``mesh``
+    this resolves the ambient logical-rules scope and applies a real
+    ``NamedSharding`` constraint on every backend; with ``mesh=None``
+    it defers to flax (the single-chip / no-mesh case, where there is
+    nothing to constrain anyway)."""
+    import flax.linen as _nn
+
+    if mesh is None:
+        return _nn.with_logical_constraint(x, tuple(logical_axes))
+    spec = resolve_logical_axes(logical_axes)
+    if spec is None:  # no rules scope (e.g. inside a manual shard_map)
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def with_sharding(mesh: Mesh, rules: LogicalRules, x, logical_axes):
     """In-jit sharding constraint by logical names."""
     return jax.lax.with_sharding_constraint(
         x, logical_sharding(mesh, rules, logical_axes)
     )
+
+
+def sharded_embedding_lookup(table, ids, mesh: Optional[Mesh],
+                             dtype=None):
+    """Embedding lookup with explicit boundary shardings: gather the
+    table's sharded embed dim AT USE (ZeRO-style use-site gather of the
+    small ``[V, E]`` tensor) so the take partitions over the indices'
+    batch/length sharding. Left to propagation, the gather output
+    inherits the TABLE's embed sharding and GSPMD falls back to
+    involuntary full rematerialization (replicate-then-partition) of
+    the ``[B, S, E]`` activations — forward and again in the
+    scatter-add transpose (the MULTICHIP_r05 ``jvp(_take)`` spew).
+    Shared by the model forward and the pipeline apply path so the two
+    lookups cannot drift."""
+    import jax.numpy as jnp
+
+    table = logical_constraint(table, ("vocab", None), mesh)
+    if dtype is not None:
+        table = table.astype(dtype)
+    x = jnp.take(table, ids, axis=0)  # [B, S, E]
+    return logical_constraint(x, ("batch", "length", "embed"), mesh)
 
 
 def shard_init(mesh: Mesh, rules: LogicalRules, init_fn, annotations):

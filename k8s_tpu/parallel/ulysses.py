@@ -94,8 +94,17 @@ def ulysses_attention(
     segment_ids: Optional[jax.Array] = None,  # global [B, S]
 ):
     """Global-array form mirroring :func:`ring_attention`: length over
-    ``seq``, batch over data/fsdp, heads over tensor."""
-    from k8s_tpu.parallel.ring_attention import seq_parallel_call
+    ``seq``, batch over data/fsdp, heads over tensor — or whatever the
+    ambient logical-rules scope maps those names to (the hand-off stays
+    consistent with the model's boundary constraints by construction;
+    see ``ring_attention._resolve_seq_parallel_axes``)."""
+    from k8s_tpu.parallel.ring_attention import (
+        _resolve_seq_parallel_axes,
+        seq_parallel_call,
+    )
+
+    axis_name, batch_axes, head_axis = _resolve_seq_parallel_axes(
+        axis_name, batch_axes, head_axis)
 
     body = partial(
         ulysses_attention_sharded,

@@ -116,6 +116,7 @@ def main(rdzv) -> None:
             return fused_lm_head_cross_entropy(
                 gathered, params["mlm_head"]["kernel"], b["masked_labels"],
                 mask=b["masked_w"], bias=params["mlm_head"]["bias"],
+                mesh=mesh,
             ), {}
         if fused_ce:
             hidden, _ = state.apply_fn(
@@ -124,6 +125,7 @@ def main(rdzv) -> None:
             return fused_lm_head_cross_entropy(
                 hidden, params["mlm_head"]["kernel"], b["labels"],
                 mask=b["mask"], bias=params["mlm_head"]["bias"],
+                mesh=mesh,
             ), {}
         mlm, _ = state.apply_fn({"params": params}, b["input_ids"])
         return cross_entropy_loss(mlm, b["labels"], mask=b["mask"]), {}

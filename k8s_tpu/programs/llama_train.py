@@ -180,7 +180,7 @@ def main(rdzv) -> None:
             )
             ce = fused_lm_head_cross_entropy(
                 hidden[:, :-1], params["lm_head"]["kernel"],
-                b["input_ids"][:, 1:], z_loss=1e-4,
+                b["input_ids"][:, 1:], z_loss=1e-4, mesh=mesh,
             )
         else:
             logits, mut = state.apply_fn(
@@ -193,7 +193,17 @@ def main(rdzv) -> None:
         # z-loss) — named accordingly so it isn't misread as one of them
         return ce + aux, {"router_losses": aux}
 
-    step_fn = make_train_step(loss_fn, mesh, rules, accum_steps=cfg.accum_steps)
+    # --latency_hiding=1 (or KTPU_LATENCY_HIDING=1 in the pod env):
+    # async-collective scheduling, docs/PERF.md. The env var is also
+    # consumed at launcher import time (before backend init) via
+    # parallel.mesh.enable_latency_hiding — this per-compile route
+    # covers the already-initialized case.
+    lhs = extra.get(
+        "latency_hiding", os.environ.get("KTPU_LATENCY_HIDING", "0")
+    ) in ("1", "true")
+    step_fn = make_train_step(loss_fn, mesh, rules,
+                              accum_steps=cfg.accum_steps,
+                              latency_hiding=lhs)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)
     # pacing knob for chaos/e2e tests: widens the mid-training window a

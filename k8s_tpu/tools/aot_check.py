@@ -148,10 +148,18 @@ def _compile_and_report(name, step_fn, abs_state, abs_batch, mesh, rules,
                         hbm_budget=HBM_BUDGET):
     import flax.linen as nn
 
+    from k8s_tpu.tools.hlo_lint import capture_stderr
+
     with nn.logical_axis_rules(rules.to_flax()):
         lowered = step_fn.jitted.lower(abs_state, abs_batch, _abstract_rng(mesh))
-    compiled = lowered.compile()
-    return _report_compiled(name, compiled, mesh, hbm_budget)
+    # fd-level capture: the SPMD partitioner's involuntary-remat
+    # fallback warnings are C++ stderr, invisible to Python redirection
+    # and absent from the HLO text — this is the only place to count
+    # them (re-emitted on exit, nothing is swallowed)
+    with capture_stderr() as cap:
+        compiled = lowered.compile()
+    return _report_compiled(name, compiled, mesh, hbm_budget,
+                            spmd_log=cap.text)
 
 
 def count_collectives(hlo: str) -> Dict[str, int]:
@@ -160,39 +168,29 @@ def count_collectives(hlo: str) -> Dict[str, int]:
     fused reduce-scatter representation (kind=kCustom fusions calling
     %all-reduce-scatter.* computations whose body holds a layout-
     constrained all-reduce) — counting text alone reads those as
-    all-reduce and reports RS=0, the round-4 misread."""
-    import re
+    all-reduce and reports RS=0, the round-4 misread. One parser for
+    all of that lives in hlo_lint.parse_collectives; this is its
+    count-only aggregation (a fix there must not diverge from the
+    budget the CI gate enforces)."""
+    from k8s_tpu.tools.hlo_lint import parse_collectives
 
-    counts = {
-        op: hlo.count(f" {op}(") + hlo.count(f" {op}-start(")
-        for op in COLLECTIVES
-    }
-    rs_calls = len(re.findall(r"calls=%?all-reduce-scatter", hlo))
-    if rs_calls:
-        counts["reduce-scatter"] += rs_calls
-        # drop the representational all-reduces by counting the actual
-        # occurrences inside each matched computation BODY — a body may
-        # hold several (multi-operand fused variants) or none, so
-        # subtracting the def count miscounts either way. HLO text
-        # closes a computation with a line-leading "}"; inline braces
-        # (metadata={...}, replica_groups={...}) never start a line.
-        inner = 0
-        for m in re.finditer(
-            r"^\s*%?all-reduce-scatter[\w.\-]*\s*\(.*?\{(.*?)^\}",
-            hlo, re.M | re.S,
-        ):
-            body = m.group(1)
-            inner += body.count(" all-reduce(") + body.count(" all-reduce-start(")
-        counts["all-reduce"] = max(0, counts["all-reduce"] - inner)
+    counts = {op: 0 for op in COLLECTIVES}
+    for c in parse_collectives(hlo):
+        counts[c.kind] += 1
     return counts
 
 
-def _report_compiled(name, compiled, mesh, hbm_budget=HBM_BUDGET):
+def _report_compiled(name, compiled, mesh, hbm_budget=HBM_BUDGET,
+                     spmd_log=""):
+    from k8s_tpu.tools.hlo_lint import lint_report
+
     ma = compiled.memory_analysis()
     hlo = compiled.as_text()
     # note a lax.scan body counts each collective ONCE however many
     # layers iterate through it
     counts = count_collectives(hlo)
+    lint = lint_report(hlo, {k: int(v) for k, v in mesh.shape.items()},
+                       spmd_log=spmd_log)
     cost = compiled.cost_analysis()
     if isinstance(cost, list):  # older jax returns [dict]
         cost = cost[0] if cost else {}
@@ -219,6 +217,10 @@ def _report_compiled(name, compiled, mesh, hbm_budget=HBM_BUDGET):
         "collectives": counts,
         "flops_per_step_per_device": flops,
         "tflops_per_step_per_device": round(flops / 1e12, 1),
+        # the collective-budget linter's view: per-axis / fwd-vs-bwd
+        # counts, bytes moved, involuntary-resharding fallbacks — the
+        # shape ci/hlo_budgets/ manifests are checked against
+        "lint": lint,
     }
     return result
 
@@ -249,7 +251,7 @@ def check_llama3_8b_v5p128():
         )
         return fused_lm_head_cross_entropy(
             hidden[:, :-1], params["lm_head"]["kernel"],
-            b["input_ids"][:, 1:], z_loss=1e-4,
+            b["input_ids"][:, 1:], z_loss=1e-4, mesh=mesh,
         ), {}
 
     step_fn = make_train_step(loss_fn, mesh, rules)
@@ -300,7 +302,7 @@ def check_bert_base_v5p64():
         )
         return fused_lm_head_cross_entropy(
             gathered, params["mlm_head"]["kernel"], b["masked_labels"],
-            mask=b["masked_w"], bias=params["mlm_head"]["bias"],
+            mask=b["masked_w"], bias=params["mlm_head"]["bias"], mesh=mesh,
         ), {}
 
     step_fn = make_train_step(loss_fn, mesh, rules)
@@ -474,7 +476,7 @@ def check_llama3_8b_longctx_v5p128():
         )
         return fused_lm_head_cross_entropy(
             hidden[:, :-1], params["lm_head"]["kernel"],
-            b["input_ids"][:, 1:], z_loss=1e-4,
+            b["input_ids"][:, 1:], z_loss=1e-4, mesh=mesh,
         ), {}
 
     step_fn = make_train_step(loss_fn, mesh, rules)
@@ -525,7 +527,7 @@ def check_llama_moe_ep_v5p64():
         )
         ce = fused_lm_head_cross_entropy(
             hidden[:, :-1], params["lm_head"]["kernel"],
-            b["input_ids"][:, 1:], z_loss=1e-4,
+            b["input_ids"][:, 1:], z_loss=1e-4, mesh=mesh,
         )
         return ce + sum_sown_losses(mut.get("intermediates", {})), {}
 
@@ -590,6 +592,18 @@ def main(argv=None) -> int:
                          "TPU compiler (libtpu) is unavailable — for CI "
                          "hosts where that is an environment gap, not a "
                          "regression")
+    ap.add_argument("--lint", action="store_true",
+                    help="check each config's collective schedule against "
+                         "its golden budget manifest (ci/hlo_budgets/); a "
+                         "config with no checked-in golden is a notice, "
+                         "not a failure")
+    ap.add_argument("--write-budgets", action="store_true",
+                    help="(re)write the golden budget manifests from this "
+                         "run — the update procedure when a schedule "
+                         "change is intentional (docs/PERF.md)")
+    ap.add_argument("--budget-dir", default=None,
+                    help="override the manifest directory "
+                         "(default: ci/hlo_budgets)")
     args = ap.parse_args(argv)
     names = sorted(CONFIGS) if (args.all or not args.config) else args.config
 
@@ -603,6 +617,9 @@ def main(argv=None) -> int:
                               "reason": f"no deviceless TPU compiler: {e}"}))
             return 0
 
+    from k8s_tpu.tools import hlo_lint as _hl
+
+    budget_dir = args.budget_dir or _hl.DEFAULT_BUDGET_DIR
     ok = True
     results = []
     for name in names:
@@ -613,6 +630,24 @@ def main(argv=None) -> int:
             ok = False
             print(f"FAIL: {name} exceeds HBM budget "
                   f"({res['peak_gib_per_device']} GiB)", file=sys.stderr)
+        if args.write_budgets:
+            path = _hl.save_budget(budget_dir, name, res["lint"])
+            print(f"wrote budget manifest {path}", file=sys.stderr)
+        elif args.lint:
+            golden = _hl.load_budget(budget_dir, name)
+            if golden is None:
+                print(f"lint[{name}]: no golden manifest in {budget_dir} — "
+                      f"run with --write-budgets to create one",
+                      file=sys.stderr)
+            else:
+                violations, improvements = _hl.check_budget(
+                    res["lint"], golden)
+                for v in violations:
+                    ok = False
+                    print(f"BUDGET VIOLATION [{name}]: {v}", file=sys.stderr)
+                for i in improvements:
+                    print(f"budget improvement [{name}]: {i}",
+                          file=sys.stderr)
     if args.json:
         with open(args.json, "w") as f:
             for res in results:

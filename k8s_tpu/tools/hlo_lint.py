@@ -1,0 +1,718 @@
+"""Compiled-HLO collective-budget linter.
+
+The collective *schedule* of a sharded train step is the product: which
+collectives run, over which mesh axes, in forward or backward, and how
+many bytes they move. Before this tool that schedule was asserted
+nowhere — a sharding regression (a constraint dropped, a rules-table
+reorder) showed up only as XLA SPMD "Involuntary full
+rematerialization" warning spew in the multichip dryrun log and a
+quietly worse `llama_mfu` (MULTICHIP_r05 / BENCH_r05). This module
+parses ``lower().compile()`` output into a structured report and checks
+it against per-config golden budget manifests (``ci/hlo_budgets/``), so
+CI fails the moment a new all-gather sneaks into the backward pass.
+
+Three layers, separable on purpose:
+
+- **Parsing** (pure, unit-tested against canned HLO text —
+  ``tests/test_hlo_lint.py``): :func:`parse_collectives` extracts every
+  collective op (sync + async ``-start`` forms, the TPU backend's fused
+  ``%all-reduce-scatter`` kCustom representation reclassified), with
+  per-op mesh-axis attribution from ``replica_groups`` /
+  ``source_target_pairs`` and forward/backward classification from the
+  ``op_name`` metadata. :func:`parse_involuntary_remat` structures the
+  SPMD partitioner's fallback warnings (captured stderr — the warnings
+  never appear in the HLO text itself).
+- **Report/budget**: :func:`lint_report` aggregates ops into the budget
+  shape; :func:`check_budget` diffs a report against a golden manifest
+  and returns human-readable violations (exceeded counts, new axes, new
+  kinds, involuntary-remat regressions).
+- **Stand-in configs**: tiny sharded train steps compiled against the
+  8-device virtual CPU mesh (the multichip-dryrun shapes — FSDP×TP×SP
+  ring attention and PP×FSDP GPipe). They compile in seconds with no
+  libtpu, so the CI ``hlo-budget`` stage enforces their goldens on
+  every run; the full north-star configs get the same treatment through
+  ``aot_check --lint`` when the deviceless TPU compiler is available.
+
+CLI::
+
+    python -m k8s_tpu.tools.hlo_lint --check            # lint stand-ins
+    python -m k8s_tpu.tools.hlo_lint --check --write    # regenerate goldens
+
+See docs/PERF.md for how to read a budget and the update procedure when
+a schedule change is intentional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import sys
+from itertools import combinations
+from typing import Dict, List, Optional, Sequence, Tuple
+
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1, "s4": 1, "u4": 1,
+}
+
+_INVOLUNTARY_MARKER = "Involuntary full rematerialization"
+
+
+@dataclasses.dataclass
+class Collective:
+    """One collective op from optimized HLO text."""
+
+    kind: str           # one of COLLECTIVE_KINDS
+    name: str           # HLO value name (without %)
+    shape_bytes: int    # size of the op's largest array buffer
+    axes: str           # attributed mesh axes ("fsdp", "data+fsdp", "all", "unknown")
+    direction: str      # "fwd" | "bwd"
+    is_async: bool      # -start form
+    op_name: str        # metadata op_name ("" when absent)
+
+
+# ---------------------------------------------------------------------------
+# Replica-group parsing + mesh-axis attribution
+# ---------------------------------------------------------------------------
+
+
+def _parse_group_list(text: str) -> List[List[int]]:
+    """``{{0,2},{1,3}}`` → [[0,2],[1,3]] (also source_target_pairs)."""
+    return [
+        [int(x) for x in grp.split(",") if x.strip() != ""]
+        for grp in re.findall(r"\{([0-9, ]*)\}", text)
+    ]
+
+
+def _parse_iota_groups(text: str) -> Optional[List[List[int]]]:
+    """HLO v2 iota replica-group list: ``[G,S]<=[d0,d1,...]`` with an
+    optional ``T(perm)`` transpose — expand to explicit groups."""
+    m = re.match(
+        r"\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", text.strip()
+    )
+    if not m:
+        return None
+    import numpy as np
+
+    g, s = int(m.group(1)), int(m.group(2))
+    dims = [int(x) for x in m.group(3).split(",")]
+    ids = np.arange(int(np.prod(dims))).reshape(dims)
+    if m.group(4):
+        ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+    return ids.reshape(g, s).tolist()
+
+
+def parse_replica_groups(text: str) -> List[List[int]]:
+    """Either explicit ``{{...}}`` or iota ``[G,S]<=[...]`` form."""
+    text = text.strip()
+    if text.startswith("{"):
+        return _parse_group_list(text)
+    groups = _parse_iota_groups(text)
+    return groups if groups is not None else []
+
+
+def _canon(groups: Sequence[Sequence[int]]) -> frozenset:
+    return frozenset(frozenset(g) for g in groups)
+
+
+def axis_group_table(mesh_axes: Dict[str, int]) -> Dict[frozenset, str]:
+    """Canonical replica-group sets for every combination of >1-sized
+    mesh axes → axis label. Device ids are row-major over the full mesh
+    shape — exactly how jit numbers the mesh's device assignment."""
+    import numpy as np
+
+    names = list(mesh_axes)
+    sizes = [mesh_axes[n] for n in names]
+    n_dev = int(np.prod(sizes))
+    ids = np.arange(n_dev).reshape(sizes)
+    real = [n for n in names if mesh_axes[n] > 1]
+    table: Dict[frozenset, str] = {}
+    for r in range(1, len(real) + 1):
+        for combo in combinations(real, r):
+            idx = [names.index(c) for c in combo]
+            moved = np.moveaxis(ids, idx, range(ids.ndim - len(idx), ids.ndim))
+            group_size = int(np.prod([mesh_axes[c] for c in combo]))
+            groups = moved.reshape(-1, group_size)
+            table.setdefault(_canon(groups.tolist()), "+".join(combo))
+    return table
+
+
+def attribute_axes(
+    groups: List[List[int]], table: Dict[frozenset, str], n_devices: int
+) -> str:
+    """Mesh-axis label for a parsed replica-group set."""
+    if not groups or all(len(g) <= 1 for g in groups):
+        return "none"
+    if len(groups) == 1 and len(groups[0]) == n_devices:
+        # a single all-device group is also some axis combo's groups —
+        # prefer the named label when the table has one
+        return table.get(_canon(groups), "all")
+    return table.get(_canon(groups), "unknown")
+
+
+def attribute_permute(
+    pairs: List[List[int]], mesh_axes: Dict[str, int]
+) -> str:
+    """collective-permute attribution: the axis along whose ring the
+    source→target pairs move (each pair differs in exactly that mesh
+    coordinate)."""
+    import numpy as np
+
+    names = list(mesh_axes)
+    sizes = [mesh_axes[n] for n in names]
+    if not pairs:
+        return "none"
+    coords = {}
+
+    def coord(d):
+        if d not in coords:
+            coords[d] = np.unravel_index(d, sizes)
+        return coords[d]
+
+    hit: set = set()
+    for p in pairs:
+        if len(p) != 2:
+            return "unknown"
+        a, b = coord(p[0]), coord(p[1])
+        diff = [i for i in range(len(sizes)) if a[i] != b[i]]
+        if len(diff) != 1:
+            return "unknown"
+        hit.add(names[diff[0]])
+    return "+".join(sorted(hit)) if len(hit) > 1 else next(iter(hit))
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing
+# ---------------------------------------------------------------------------
+
+
+def _bytes_of(type_str: str) -> int:
+    """Largest array buffer in an HLO result type (tuples: the async
+    destination dominates; scalars → 0-d = dtype size)."""
+    best = 0
+    for dt, dims in re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", type_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        best = max(best, n * _DTYPE_BYTES[dt])
+    return best
+
+
+_OP_LINE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<type>.*?)\s"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<start>-start)?\(",
+    re.M,
+)
+
+# the TPU backend's fused reduce-scatter: kCustom fusions calling
+# %all-reduce-scatter.* computations whose BODY holds layout-constrained
+# all-reduces (see aot_check.count_collectives — the round-4 misread)
+_FUSED_RS_BODY = re.compile(
+    r"^\s*%?all-reduce-scatter[\w.\-]*\s*\(.*?\{(.*?)^\}", re.M | re.S
+)
+_FUSED_RS_CALL = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<type>.*?)\sfusion\("
+    r".*calls=%?(?P<callee>all-reduce-scatter[\w.\-]*)", re.M
+)
+
+
+def _direction(op_name: str) -> str:
+    return "bwd" if "transpose(" in op_name else "fwd"
+
+
+def parse_collectives(
+    hlo: str, mesh_axes: Optional[Dict[str, int]] = None
+) -> List[Collective]:
+    """Every collective op in optimized HLO text, with axis attribution
+    when ``mesh_axes`` (ordered name → size) is given."""
+    table = axis_group_table(mesh_axes) if mesh_axes else {}
+    n_devices = 1
+    if mesh_axes:
+        for s in mesh_axes.values():
+            n_devices *= s
+
+    # spans of fused reduce-scatter computation bodies: collectives
+    # inside them are the REPRESENTATION of the fused op, not schedule
+    body_spans = [m.span(1) for m in _FUSED_RS_BODY.finditer(hlo)]
+
+    def in_body(pos: int) -> bool:
+        return any(a <= pos < b for a, b in body_spans)
+
+    out: List[Collective] = []
+    for m in _OP_LINE.finditer(hlo):
+        if in_body(m.start()):
+            continue
+        line_end = hlo.find("\n", m.start())
+        line = hlo[m.start(): line_end if line_end != -1 else len(hlo)]
+        kind = m.group("kind")
+        opn = ""
+        om = re.search(r'op_name="([^"]*)"', line)
+        if om:
+            opn = om.group(1)
+        if kind == "collective-permute":
+            # source_target_pairs={{0,1},{1,2}} — grab the outer braces
+            pm = re.search(r"source_target_pairs=(\{\{.*?\}\})", line)
+            axes = (
+                attribute_permute(_parse_group_list(pm.group(1)), mesh_axes)
+                if (pm and mesh_axes) else ("unknown" if mesh_axes else "none")
+            )
+        else:
+            gm = re.search(r"replica_groups=(\{\{.*?\}\}|\{\}|\[[0-9,]+\]"
+                           r"<=\[[0-9,]+\](?:T\([0-9,]+\))?)", line)
+            if gm and mesh_axes:
+                groups = parse_replica_groups(gm.group(1))
+                axes = attribute_axes(groups, table, n_devices)
+            else:
+                axes = "unknown" if mesh_axes else "none"
+        out.append(Collective(
+            kind=kind,
+            name=m.group("name"),
+            shape_bytes=_bytes_of(m.group("type")),
+            axes=axes,
+            direction=_direction(opn),
+            is_async=bool(m.group("start")),
+            op_name=opn,
+        ))
+
+    for m in _FUSED_RS_CALL.finditer(hlo):
+        opn = ""
+        line_end = hlo.find("\n", m.start())
+        line = hlo[m.start(): line_end if line_end != -1 else len(hlo)]
+        om = re.search(r'op_name="([^"]*)"', line)
+        if om:
+            opn = om.group(1)
+        # axis attribution comes from the inner all-reduce's groups
+        axes = "unknown" if mesh_axes else "none"
+        if mesh_axes:
+            bm = re.search(
+                r"^\s*%?" + re.escape(m.group("callee")) +
+                r"\s*\(.*?\{(.*?)^\}", hlo, re.M | re.S)
+            if bm:
+                gm = re.search(r"replica_groups=(\{\{.*?\}\}|\{\}|\[[0-9,]+\]"
+                               r"<=\[[0-9,]+\](?:T\([0-9,]+\))?)", bm.group(1))
+                if gm:
+                    axes = attribute_axes(
+                        parse_replica_groups(gm.group(1)), table, n_devices)
+        out.append(Collective(
+            kind="reduce-scatter",
+            name=m.group("name"),
+            shape_bytes=_bytes_of(m.group("type")),
+            axes=axes,
+            direction=_direction(opn),
+            is_async=False,
+            op_name=opn,
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SPMD-warning parsing (involuntary resharding fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def count_involuntary_remat(log_text: str) -> int:
+    """Occurrences of the SPMD partitioner's replicate-then-partition
+    fallback warning in captured compile stderr."""
+    return log_text.count(_INVOLUNTARY_MARKER)
+
+
+_REMAT_RE = re.compile(
+    _INVOLUNTARY_MARKER +
+    r".*?from sharding \{(?P<src>[^}]*)\}[^{]*?to \{(?P<dst>[^}]*)\}"
+    r".*?HLO operation:?\s*%(?P<op>[\w.\-]+) = (?P<type>[a-z0-9]+\[[0-9,]*\])",
+    re.S,
+)
+
+
+def parse_involuntary_remat(log_text: str) -> List[Dict[str, str]]:
+    """Structured records of each involuntary-remat warning: the HLO op,
+    its array type, and the source/target shardings GSPMD could not
+    bridge. Both partitioner wordings (``was not able to go from`` /
+    ``cannot go from``) parse."""
+    out = []
+    for chunk in log_text.split(_INVOLUNTARY_MARKER)[1:]:
+        m = _REMAT_RE.match(_INVOLUNTARY_MARKER + chunk)
+        if m:
+            out.append({
+                "op": m.group("op"),
+                "type": m.group("type"),
+                "from": "{" + m.group("src") + "}",
+                "to": "{" + m.group("dst") + "}",
+            })
+        else:
+            out.append({"op": "unparsed", "type": "", "from": "", "to": ""})
+    return out
+
+
+class capture_stderr:
+    """fd-level stderr tee: XLA's C++ SPMD warnings bypass Python's
+    ``sys.stderr``, so counting them needs the real fd 2 swapped for
+    the duration. Captured bytes are re-emitted to the original stderr
+    on exit — nothing is swallowed, the machine-parsed stdout line just
+    stays clean of them. Usage::
+
+        with capture_stderr() as cap:
+            compiled = lowered.compile()
+        n = count_involuntary_remat(cap.text)
+    """
+
+    def __enter__(self):
+        import tempfile
+
+        self.text = ""
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        self._tmp = tempfile.TemporaryFile()
+        self._saved = os.dup(2)
+        os.dup2(self._tmp.fileno(), 2)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os.dup2(self._saved, 2)
+        os.close(self._saved)
+        try:
+            self._tmp.seek(0)
+            self.text = self._tmp.read().decode("utf-8", "replace")
+        finally:
+            self._tmp.close()
+        if self.text:
+            try:
+                sys.stderr.write(self.text)
+                sys.stderr.flush()
+            except Exception:
+                pass
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Report + budget check
+# ---------------------------------------------------------------------------
+
+
+def _bump(d: Dict[str, int], k: str, n: int = 1):
+    d[k] = d.get(k, 0) + n
+
+
+def lint_report(
+    hlo: str,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    spmd_log: str = "",
+) -> dict:
+    """Aggregate a compiled program's collective schedule into the
+    budget shape. ``spmd_log`` is captured compile stderr (see
+    :class:`capture_stderr`) — the involuntary-remat warnings live
+    there, never in the HLO text."""
+    ops = parse_collectives(hlo, mesh_axes)
+    collectives: Dict[str, int] = {}
+    fwd: Dict[str, int] = {}
+    bwd: Dict[str, int] = {}
+    by_axis: Dict[str, Dict[str, int]] = {}
+    bwd_by_axis: Dict[str, Dict[str, int]] = {}
+    bytes_by_kind: Dict[str, int] = {}
+    n_async = 0
+    for op in ops:
+        _bump(collectives, op.kind)
+        _bump(fwd if op.direction == "fwd" else bwd, op.kind)
+        _bump(by_axis.setdefault(op.axes, {}), op.kind)
+        if op.direction == "bwd":
+            _bump(bwd_by_axis.setdefault(op.axes, {}), op.kind)
+        _bump(bytes_by_kind, op.kind, op.shape_bytes)
+        n_async += int(op.is_async)
+    total = sum(collectives.values())
+    remats = parse_involuntary_remat(spmd_log)
+    return {
+        "collectives": collectives,
+        "forward": fwd,
+        "backward": bwd,
+        "by_axis": by_axis,
+        "backward_by_axis": bwd_by_axis,
+        "bytes_by_kind": bytes_by_kind,
+        "total_collective_bytes": sum(bytes_by_kind.values()),
+        "async_fraction": round(n_async / total, 3) if total else None,
+        "involuntary_remat": count_involuntary_remat(spmd_log),
+        "remat_fallbacks": remats[:8],
+    }
+
+
+_BUDGET_KEYS = ("collectives", "backward", "by_axis", "backward_by_axis")
+
+
+def budget_from_report(report: dict, config: str) -> dict:
+    """The golden manifest written by ``--write``: exact collective
+    counts (XLA is deterministic for a fixed version) + a 25%-headroom
+    bytes ceiling (layout/version drift moves bytes a little without a
+    schedule change) + the zero-involuntary-remat assertion."""
+    return {
+        "config": config,
+        "budget": {
+            **{k: report[k] for k in _BUDGET_KEYS},
+            "involuntary_remat": report["involuntary_remat"],
+            "max_collective_bytes": int(report["total_collective_bytes"] * 1.25),
+        },
+    }
+
+
+def _diff_counts(
+    got: Dict[str, int], want: Dict[str, int], label: str,
+    violations: List[str], improvements: List[str],
+):
+    for k in sorted(set(got) | set(want)):
+        g, w = got.get(k, 0), want.get(k, 0)
+        if g > w:
+            violations.append(
+                f"{label} {k}: {g} > budget {w} (+{g - w})"
+            )
+        elif g < w:
+            improvements.append(
+                f"{label} {k}: {g} < budget {w} (tighten the golden)"
+            )
+
+
+def check_budget(report: dict, golden: dict, strict: bool = False
+                 ) -> Tuple[List[str], List[str]]:
+    """Diff a lint report against a golden manifest.
+
+    Returns ``(violations, improvements)``. A non-empty violations list
+    fails the budget: counts above golden anywhere (total, backward,
+    per-axis), involuntary-remat regressions, or bytes above the
+    ceiling. Counts BELOW golden are improvements — reported so the
+    golden gets tightened, fatal only under ``strict``."""
+    budget = golden.get("budget", golden)
+    violations: List[str] = []
+    improvements: List[str] = []
+    _diff_counts(report.get("collectives", {}), budget.get("collectives", {}),
+                 "total", violations, improvements)
+    _diff_counts(report.get("backward", {}), budget.get("backward", {}),
+                 "backward", violations, improvements)
+    for scope in ("by_axis", "backward_by_axis"):
+        got_ax = report.get(scope, {})
+        want_ax = budget.get(scope, {})
+        for ax in sorted(set(got_ax) | set(want_ax)):
+            _diff_counts(got_ax.get(ax, {}), want_ax.get(ax, {}),
+                         f"{scope}[{ax}]", violations, improvements)
+    got_remat = report.get("involuntary_remat", 0)
+    want_remat = budget.get("involuntary_remat", 0)
+    if got_remat > want_remat:
+        detail = "; ".join(
+            f"{r['op']} {r['type']} {r['from']}->{r['to']}"
+            for r in report.get("remat_fallbacks", [])[:3]
+        )
+        violations.append(
+            f"involuntary_remat: {got_remat} > budget {want_remat}"
+            + (f" [{detail}]" if detail else "")
+        )
+    max_bytes = budget.get("max_collective_bytes")
+    got_bytes = report.get("total_collective_bytes", 0)
+    if max_bytes is not None and got_bytes > max_bytes:
+        violations.append(
+            f"total_collective_bytes: {got_bytes} > ceiling {max_bytes}"
+        )
+    if strict:
+        violations.extend(improvements)
+        improvements = []
+    return violations, improvements
+
+
+def budget_path(budget_dir: str, config: str) -> str:
+    return os.path.join(budget_dir, f"{config}.json")
+
+
+def load_budget(budget_dir: str, config: str) -> Optional[dict]:
+    path = budget_path(budget_dir, config)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_budget(budget_dir: str, config: str, report: dict) -> str:
+    os.makedirs(budget_dir, exist_ok=True)
+    path = budget_path(budget_dir, config)
+    with open(path, "w") as f:
+        json.dump(budget_from_report(report, config), f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    return path
+
+
+DEFAULT_BUDGET_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "ci", "hlo_budgets",
+)
+
+
+# ---------------------------------------------------------------------------
+# Stand-in configs: tiny sharded steps on the 8-device virtual CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _standin_compile(strategy: str):
+    """Compile the multichip-dryrun train step for ``strategy`` on 8
+    virtual CPU devices; returns (compiled, mesh, spmd_log)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+    from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
+    from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+    from k8s_tpu.train import create_sharded_state, make_train_step
+
+    devices = jax.devices()[:8]
+    if strategy == "fsdp-tp-sp":
+        mesh = build_mesh(MeshConfig(data=-1, fsdp=2, seq=2, tensor=2),
+                          devices=devices)
+        rules = LogicalRules(LogicalRules.FSDP_TP_SP)
+        cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=32,
+                               attention="ring", mesh=mesh)
+    elif strategy == "pp-fsdp":
+        mesh = build_mesh(MeshConfig(data=-1, fsdp=2, stage=2),
+                          devices=devices)
+        rules = LogicalRules(LogicalRules.PP_FSDP)
+        cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=32,
+                               num_layers=2, attention="flash")
+    else:
+        raise ValueError(f"unknown stand-in strategy {strategy!r}")
+
+    model = LlamaForCausalLM(cfg)
+    batch, seq = 8, 64
+    example = jnp.zeros((batch, seq), jnp.int32)
+    state = create_sharded_state(
+        model, optax.adamw(1e-3), mesh, rules, jax.random.PRNGKey(0), example
+    )
+
+    if strategy == "pp-fsdp":
+        from k8s_tpu.train import make_pp_llama_loss
+
+        loss_fn, _ = make_pp_llama_loss(
+            model, mesh, rules, example, num_microbatches=2,
+        )
+    else:
+        def loss_fn(st, params, b, rng):
+            hidden = st.apply_fn(
+                {"params": params}, b["input_ids"], return_hidden=True
+            )
+            return fused_lm_head_cross_entropy(
+                hidden[:, :-1], params["lm_head"]["kernel"],
+                b["input_ids"][:, 1:], target_chunk=cfg.vocab_size // 4,
+                mesh=mesh,
+            ), {}
+
+    step = make_train_step(loss_fn, mesh, rules)
+    import flax.linen as nn
+
+    with nn.logical_axis_rules(rules.to_flax()):
+        lowered = step.jitted.lower(
+            state, {"input_ids": example}, jax.random.PRNGKey(2)
+        )
+        with capture_stderr() as cap:
+            compiled = lowered.compile()
+    return compiled, mesh, cap.text
+
+
+STANDIN_CONFIGS = {
+    "standin-fsdp-tp-sp-cpu8": lambda: _standin_compile("fsdp-tp-sp"),
+    "standin-pp-fsdp-cpu8": lambda: _standin_compile("pp-fsdp"),
+}
+
+
+def lint_compiled(compiled, mesh, spmd_log: str = "") -> dict:
+    """Lint a jax compiled object against its mesh."""
+    mesh_axes = {k: int(v) for k, v in mesh.shape.items()}
+    return lint_report(compiled.as_text(), mesh_axes, spmd_log)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser("hlo-lint")
+    ap.add_argument("--check", action="store_true",
+                    help="compile the stand-in configs and check their "
+                         "golden budgets")
+    ap.add_argument("--config", action="append",
+                    choices=sorted(STANDIN_CONFIGS),
+                    help="subset of stand-ins (default: all)")
+    ap.add_argument("--write", action="store_true",
+                    help="(re)write the golden manifests from this run "
+                         "instead of checking")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on counts BELOW budget (stale golden)")
+    ap.add_argument("--budget-dir", default=DEFAULT_BUDGET_DIR)
+    args = ap.parse_args(argv)
+
+    if not (args.check or args.write):
+        ap.error("nothing to do: pass --check and/or --write")
+
+    # virtual CPU mesh before the first device query (the conftest /
+    # dryrun approach — env vars alone are too late under shims that
+    # import jax at interpreter startup)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    names = args.config or sorted(STANDIN_CONFIGS)
+    ok = True
+    for name in names:
+        compiled, mesh, spmd_log = STANDIN_CONFIGS[name]()
+        report = lint_compiled(compiled, mesh, spmd_log)
+        if args.write:
+            path = save_budget(args.budget_dir, name, report)
+            print(json.dumps({"config": name, "wrote": path,
+                              "collectives": report["collectives"],
+                              "involuntary_remat": report["involuntary_remat"]}))
+            continue
+        golden = load_budget(args.budget_dir, name)
+        if golden is None:
+            ok = False
+            print(json.dumps({
+                "config": name, "budget": "MISSING",
+                "hint": f"run: python -m k8s_tpu.tools.hlo_lint --write "
+                        f"--config {name}",
+                "collectives": report["collectives"],
+            }))
+            continue
+        violations, improvements = check_budget(report, golden,
+                                                strict=args.strict)
+        print(json.dumps({
+            "config": name,
+            "budget": "FAIL" if violations else "ok",
+            "collectives": report["collectives"],
+            "backward": report["backward"],
+            "involuntary_remat": report["involuntary_remat"],
+            "violations": violations,
+            "improvements": improvements,
+        }))
+        if violations:
+            ok = False
+            for v in violations:
+                print(f"BUDGET VIOLATION [{name}]: {v}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

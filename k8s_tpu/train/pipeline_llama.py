@@ -50,7 +50,11 @@ from k8s_tpu.models.llama import LlamaBlock, LlamaConfig, _remat_policy
 from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.ops.norms import rms_norm
 from k8s_tpu.parallel.pipeline import pipeline_apply
-from k8s_tpu.parallel.sharding import LogicalRules
+from k8s_tpu.parallel.sharding import (
+    LogicalRules,
+    logical_constraint,
+    sharded_embedding_lookup,
+)
 
 
 def block_param_specs(
@@ -157,9 +161,12 @@ def make_pp_llama_apply(
         return x
 
     def apply_fn(params, input_ids, segment_ids=None):
-        emb = params["embed_tokens"]["embedding"].astype(cfg.dtype)
-        x = jnp.take(emb, input_ids, axis=0)  # [B, S, E]
-        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        # use-site-gathered lookup with explicit boundary shardings —
+        # shared with the model forward (parallel.sharding) so the two
+        # lookups cannot drift
+        x = sharded_embedding_lookup(
+            params["embed_tokens"]["embedding"], input_ids, mesh,
+            dtype=cfg.dtype)
         x = pipeline_apply(
             stage_fn, params["layers"]["block"], x, mesh,
             num_microbatches=num_microbatches,
@@ -167,7 +174,7 @@ def make_pp_llama_apply(
             aux=(None if segment_ids is None
                  else segment_ids.astype(jnp.int32)),
         )
-        x = nn.with_logical_constraint(x, ("batch", "length", "embed"))
+        x = logical_constraint(x, ("batch", "length", "embed"), mesh)
         return rms_norm(x, params["final_norm"]["weight"], cfg.rms_eps)
 
     return apply_fn
@@ -201,7 +208,7 @@ def make_pp_llama_loss(
             mask = (seg == seg_next)[:, :-1]
         ce = fused_lm_head_cross_entropy(
             hidden[:, :-1], params["lm_head"]["kernel"],
-            batch["input_ids"][:, 1:], z_loss=z_loss,
+            batch["input_ids"][:, 1:], z_loss=z_loss, mesh=mesh,
             **({"mask": mask} if mask is not None else {}),
             **({"target_chunk": vocab_chunk} if vocab_chunk else {}),
         )

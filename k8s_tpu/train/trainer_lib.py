@@ -225,6 +225,8 @@ def make_train_step(
     rules: LogicalRules,
     donate: bool = True,
     accum_steps: int = 1,
+    latency_hiding: bool = False,
+    compiler_options: Optional[Dict[str, str]] = None,
 ) -> TrainStepFn:
     """Build the jitted SPMD train step.
 
@@ -248,8 +250,28 @@ def make_train_step(
     counts roughly balanced (e.g. pack sequences) when using
     ``accum_steps`` with masks. Aux outputs (metrics, ``batch_stats``)
     are averaged over microbatches.
+
+    ``latency_hiding=True`` compiles the step with XLA's latency-hiding
+    scheduler (async collectives overlapped with compute — see
+    ``parallel.mesh.LATENCY_HIDING_LIBTPU_FLAGS`` and docs/PERF.md).
+    Routed as per-compile XLA options through the AOT path, so it works
+    even after backend init (when the ``LIBTPU_INIT_ARGS`` env route is
+    too late). TPU meshes only — on other backends the knob is a no-op
+    (the flags don't exist there). ``compiler_options`` passes arbitrary
+    extra XLA options the same way.
     """
     shard_batch = make_batch_sharder(mesh, rules)
+    opts: Optional[Dict[str, str]] = None
+    if latency_hiding or compiler_options:
+        on_tpu = mesh.devices.flat[0].platform == "tpu"
+        if on_tpu:
+            opts = dict(compiler_options or {})
+            if latency_hiding:
+                from k8s_tpu.parallel.mesh import latency_hiding_compiler_options
+
+                opts = {**latency_hiding_compiler_options(), **opts}
+        elif compiler_options:
+            opts = dict(compiler_options)
 
     def grad_of(state, batch, rng):
         def compute(params):
@@ -309,7 +331,12 @@ def make_train_step(
                 to_f32 = lambda t: jax.tree_util.tree_map(
                     lambda x: x.astype(jnp.float32), t
                 )
-                g0 = to_f32(g_first)
+                # pin the f32 accumulator (the scan carry) to the
+                # params' layout up front: left to propagation GSPMD
+                # can keep a ZeRO accumulator replicated through all
+                # accum_steps iterations — accum_steps× the memory and
+                # an involuntary reshard at the optimizer boundary
+                g0 = constrain_grads(to_f32(g_first))
 
                 def body(carry, mb):
                     g_acc, l_acc, aux_acc, i = carry
@@ -350,7 +377,43 @@ def make_train_step(
             metrics = {"loss": loss, **{k: v for k, v in (aux or {}).items()}}
             return new_state, metrics
 
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+        if not opts:
+            return jitted
+
+        # compiler options only exist on the AOT path in this jax line:
+        # lower+compile per abstract signature, then call the executable
+        # (steady-state training is one signature → one compile)
+        aot_cache: Dict[Tuple, Any] = {}
+
+        def _sig(tree) -> Tuple:
+            return tuple(
+                (getattr(x, "shape", ()), str(getattr(x, "dtype", type(x))))
+                for x in jax.tree_util.tree_leaves(tree)
+            )
+
+        class _AotStep:
+            def _compiled(self, state, batch, rng):
+                key = (_sig(state), _sig(batch))
+                if key not in aot_cache:
+                    aot_cache[key] = jitted.lower(state, batch, rng).compile(
+                        compiler_options=opts
+                    )
+                return aot_cache[key]
+
+            def __call__(self, state, batch, rng):
+                return self._compiled(state, batch, rng)(state, batch, rng)
+
+            def lower(self, state, batch, rng):
+                return jitted.lower(state, batch, rng)
+
+            # the executable the step ACTUALLY runs (same compiler
+            # options, same cache entry) — what budget linting must
+            # inspect; a plain re-lower().compile() would describe a
+            # different program when options are in play
+            compiled = _compiled
+
+        return _AotStep()
 
     # one jitted step per distinct param layout (shardings are read off
     # the state ARGUMENT — concrete arrays or ShapeDtypeStructs — so the
@@ -379,6 +442,18 @@ def make_train_step(
 
         def lower(self, state, batch, rng):
             return jitted_for(state).lower(state, batch, rng)
+
+        def compiled(self, state, batch, rng):
+            """The executable this step runs for these arguments, with
+            its compiler options — reuses the AOT cache when the
+            latency-hiding/compiler-options path built one (no second
+            compile); the plain-jit path pays one best-effort
+            lower+compile (amortized by the persistent compilation
+            cache where enabled)."""
+            step = jitted_for(state)
+            if hasattr(step, "compiled"):
+                return step.compiled(state, batch, rng)
+            return step.lower(state, batch, rng).compile()
 
     run.jitted = _LazyJitted()
     return run

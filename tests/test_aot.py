@@ -176,6 +176,64 @@ def test_count_collectives_reclassifies_fused_reduce_scatter():
     assert counts["all-gather"] == 1, counts
 
 
+class TestBudgetManifests:
+    """Budget-manifest round-trip through the aot_check/hlo_lint file
+    helpers — write a golden from a report, re-check passes, a
+    perturbed golden fails with a readable diff (the CI `hlo-budget`
+    stage contract)."""
+
+    HLO = "\n".join([
+        "ENTRY %main {",
+        '  %ag = bf16[8,64]{1,0} all-gather(bf16[4,64] %p),'
+        ' replica_groups={{0,2},{1,3},{4,6},{5,7}},'
+        ' metadata={op_name="jit(step)/jvp(M)/g"}',
+        '  %ar = f32[64]{0} all-reduce(f32[64] %q),'
+        ' replica_groups={{0,1},{2,3},{4,5},{6,7}},'
+        ' metadata={op_name="jit(step)/transpose(jvp(M))/mm"}',
+        "}",
+    ])
+    MESH = {"data": 2, "fsdp": 2, "tensor": 2}
+
+    def _report(self, hlo=None):
+        from k8s_tpu.tools.hlo_lint import lint_report
+
+        return lint_report(hlo or self.HLO, self.MESH)
+
+    def test_write_then_check_passes(self, tmp_path):
+        from k8s_tpu.tools.hlo_lint import (
+            check_budget, load_budget, save_budget,
+        )
+
+        rep = self._report()
+        save_budget(str(tmp_path), "cfg", rep)
+        golden = load_budget(str(tmp_path), "cfg")
+        violations, improvements = check_budget(rep, golden)
+        assert violations == [] and improvements == []
+
+    def test_perturbed_golden_fails_with_readable_diff(self, tmp_path):
+        from k8s_tpu.tools.hlo_lint import (
+            check_budget, load_budget, save_budget,
+        )
+
+        rep = self._report()
+        save_budget(str(tmp_path), "cfg", rep)
+        golden = load_budget(str(tmp_path), "cfg")
+        # tighten the golden below reality: simulates a regression that
+        # added a backward tensor all-reduce beyond budget
+        golden["budget"]["backward"]["all-reduce"] = 0
+        golden["budget"]["backward_by_axis"]["tensor"]["all-reduce"] = 0
+        violations, _ = check_budget(rep, golden)
+        assert any(v == "backward all-reduce: 1 > budget 0 (+1)"
+                   for v in violations), violations
+        assert any("backward_by_axis[tensor] all-reduce" in v
+                   for v in violations)
+
+    def test_missing_budget_returns_none(self, tmp_path):
+        from k8s_tpu.tools.hlo_lint import load_budget
+
+        assert load_budget(str(tmp_path), "nope") is None
+
+
 def test_count_collectives_counts_body_occurrences_not_defs():
     """A matched %all-reduce-scatter computation body may hold several
     all-reduces (multi-operand fused variant) or none at all — the

@@ -100,3 +100,32 @@ class TestBenches:
         assert out["seq"] == 256
         assert out["mode"] == "interpret-smoke"
         assert out["fwd_flash_ms"] > 0 and out["fwdbwd_flash_ms"] > 0
+
+    def test_attention_bench_smoke_flag(self, capsys):
+        """--smoke must force the tiny interpret row on ANY backend —
+        the tier-1 drift guard for the bench CLI surface."""
+        from benches import attention_bench
+
+        assert attention_bench.main(["--smoke"]) == 0
+        out = _last_json_line(capsys)
+        assert out["mode"] == "interpret-smoke" and out["seq"] == 256
+
+    def test_llama_bench_smoke_shape(self, capsys):
+        """--smoke emits the full llama JSON line shape the driver and
+        BENCH_r*.json trajectory parse — incl. the collective-budget
+        block and the involuntary-remat counter (ISSUE 3)."""
+        from benches import llama_bench
+
+        assert llama_bench.main(["--smoke"]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "llama_train_tokens_per_sec_per_chip"
+        assert out["value"] > 0 and out["mode"] == "smoke"
+        for k in ("mfu", "step_time_ms", "spmd_involuntary_remat",
+                  "latency_hiding", "collective_budget"):
+            assert k in out, k
+        assert out["step_time_ms"] > 0
+        assert out["spmd_involuntary_remat"] == 0
+        # single-device DP mesh -> a budget dict with count keys (may be
+        # empty of collectives, but the block itself must be attached)
+        assert isinstance(out["collective_budget"], dict)
+        assert "collectives" in out["collective_budget"]

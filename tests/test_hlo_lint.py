@@ -1,0 +1,266 @@
+"""Collective-budget linter parser (k8s_tpu/tools/hlo_lint.py) against
+canned HLO text and SPMD-warning fixtures — no compiler involved, tier-1
+fast. The end-to-end path (compile a stand-in step → lint → check the
+checked-in golden) runs as the CI ``hlo-budget`` stage and is
+round-tripped in tests/test_aot.py."""
+
+import json
+
+import pytest
+
+from k8s_tpu.tools.hlo_lint import (
+    Collective,
+    attribute_axes,
+    attribute_permute,
+    axis_group_table,
+    budget_from_report,
+    check_budget,
+    count_involuntary_remat,
+    lint_report,
+    load_budget,
+    parse_collectives,
+    parse_involuntary_remat,
+    parse_replica_groups,
+    save_budget,
+)
+
+# mesh used throughout: 8 devices, row-major ids over (data=2, fsdp=2,
+# tensor=2) — data groups stride 4, fsdp stride 2, tensor stride 1
+MESH = {"data": 2, "fsdp": 2, "tensor": 2}
+
+
+HLO = "\n".join([
+    "ENTRY %main {",
+    # forward all-gather over fsdp (groups vary the middle axis)
+    '  %ag = bf16[8,64,128]{2,1,0} all-gather(bf16[4,64,128]{2,1,0} %p),'
+    ' channel_id=1, replica_groups={{0,2},{1,3},{4,6},{5,7}},'
+    ' dimensions={0}, use_global_device_ids=true,'
+    ' metadata={op_name="jit(step)/jit(main)/jvp(M)/layer/gather"}',
+    # async all-reduce over tensor in the backward (transpose scope)
+    '  %ar = (f32[128,256], f32[128,256]) all-reduce-start(f32[128,256] %q),'
+    ' replica_groups={{0,1},{2,3},{4,5},{6,7}},'
+    ' metadata={op_name="jit(step)/jit(main)/transpose(jvp(M))/layer/mm"}',
+    '  %ard = f32[128,256] all-reduce-done(%ar)',
+    # backward all-gather over fsdp in iota form [4,2]<=[2,2,2]T(0,1,2)
+    # is NOT fsdp (identity transpose groups pair the minor axis =
+    # tensor); use the explicit transpose that lands on fsdp
+    '  %agb = bf16[8,64,128]{2,1,0} all-gather(bf16[4,64,128]{2,1,0} %r),'
+    ' channel_id=2, replica_groups=[4,2]<=[2,2,2]T(0,2,1), dimensions={0},'
+    ' metadata={op_name="jit(step)/jit(main)/transpose(jvp(M))/layer/gather"}',
+    # gradient all-reduce over data+fsdp (batch axes), forward-less
+    # metadata (optimizer scope, no transpose marker -> fwd bucket)
+    '  %gr = f32[1024]{0} all-reduce(f32[1024]{0} %g),'
+    ' replica_groups={{0,1,2,3},{4,5,6,7}},'
+    ' metadata={op_name="jit(step)/jit(main)/add"}',
+    # ring collective-permute along tensor (pairs differ in minor axis)
+    '  %cp = bf16[4,64,128]{2,1,0} collective-permute(bf16[4,64,128] %s),'
+    ' source_target_pairs={{0,1},{1,0},{2,3},{3,2},{4,5},{5,4},{6,7},{7,6}},'
+    ' metadata={op_name="jit(step)/jit(main)/jvp(M)/ring/ppermute"}',
+    "}",
+])
+
+
+class TestReplicaGroupParsing:
+    def test_explicit(self):
+        assert parse_replica_groups("{{0,2},{1,3}}") == [[0, 2], [1, 3]]
+
+    def test_iota_plain(self):
+        assert parse_replica_groups("[2,4]<=[8]") == [
+            [0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_iota_transposed(self):
+        # [4,2]<=[4,2]T(1,0): ids reshaped (4,2), transposed -> (2,4),
+        # re-split into 4 groups of 2 pairing stride-2 neighbours
+        assert parse_replica_groups("[4,2]<=[4,2]T(1,0)") == [
+            [0, 2], [4, 6], [1, 3], [5, 7]]
+
+
+class TestAxisAttribution:
+    def test_single_axes(self):
+        table = axis_group_table(MESH)
+        assert attribute_axes([[0, 4], [1, 5], [2, 6], [3, 7]], table, 8) == "data"
+        assert attribute_axes([[0, 2], [1, 3], [4, 6], [5, 7]], table, 8) == "fsdp"
+        assert attribute_axes([[0, 1], [2, 3], [4, 5], [6, 7]], table, 8) == "tensor"
+
+    def test_combined_axes_label(self):
+        table = axis_group_table(MESH)
+        label = attribute_axes([[0, 1, 2, 3], [4, 5, 6, 7]], table, 8)
+        assert label == "fsdp+tensor", label
+        label = attribute_axes([[0, 2, 4, 6], [1, 3, 5, 7]], table, 8)
+        assert label == "data+fsdp", label
+
+    def test_all_devices(self):
+        table = axis_group_table(MESH)
+        assert attribute_axes([list(range(8))], table, 8) == "data+fsdp+tensor"
+
+    def test_unknown(self):
+        table = axis_group_table(MESH)
+        assert attribute_axes([[0, 3], [1, 2], [4, 7], [5, 6]], table, 8) == \
+            "unknown"
+
+    def test_permute_axis(self):
+        pairs = [[0, 1], [1, 0], [2, 3], [3, 2], [4, 5], [5, 4], [6, 7], [7, 6]]
+        assert attribute_permute(pairs, MESH) == "tensor"
+        ring = [[0, 4], [4, 0], [1, 5], [5, 1], [2, 6], [6, 2], [3, 7], [7, 3]]
+        assert attribute_permute(ring, MESH) == "data"
+
+
+class TestParseCollectives:
+    def test_counts_kinds_and_async(self):
+        ops = parse_collectives(HLO, MESH)
+        kinds = sorted(o.kind for o in ops)
+        assert kinds == ["all-gather", "all-gather", "all-reduce",
+                        "all-reduce", "collective-permute"]
+        # -done is never counted, -start is, flagged async
+        ar = [o for o in ops if o.kind == "all-reduce" and o.is_async]
+        assert len(ar) == 1
+
+    def test_direction_from_op_name(self):
+        ops = {o.name: o for o in parse_collectives(HLO, MESH)}
+        assert ops["ag"].direction == "fwd"
+        assert ops["ar"].direction == "bwd"
+        assert ops["agb"].direction == "bwd"
+        assert ops["gr"].direction == "fwd"
+
+    def test_axis_attribution(self):
+        ops = {o.name: o for o in parse_collectives(HLO, MESH)}
+        assert ops["ag"].axes == "fsdp"
+        assert ops["ar"].axes == "tensor"
+        assert ops["agb"].axes == "fsdp"
+        assert ops["gr"].axes == "fsdp+tensor"
+        assert ops["cp"].axes == "tensor"
+
+    def test_bytes(self):
+        ops = {o.name: o for o in parse_collectives(HLO, MESH)}
+        assert ops["ag"].shape_bytes == 8 * 64 * 128 * 2  # bf16
+        assert ops["gr"].shape_bytes == 1024 * 4  # f32
+        # async tuple: largest buffer, not the sum of both halves
+        assert ops["ar"].shape_bytes == 128 * 256 * 4
+
+    def test_fused_reduce_scatter_reclassified(self):
+        hlo = "\n".join([
+            "%all-reduce-scatter.3 (p: bf16[4096,256]) -> bf16[1024,256] {",
+            "  %r = bf16[4096,256] all-reduce(%p),"
+            " replica_groups={{0,2},{1,3},{4,6},{5,7}}",
+            "}",
+            "ENTRY %main {",
+            "  %f1 = bf16[1024,256] fusion(%a), kind=kCustom,"
+            " calls=%all-reduce-scatter.3,"
+            ' metadata={op_name="jit(step)/transpose(jvp(M))/mm"}',
+            "  %f2 = bf16[1024,256] fusion(%b), kind=kCustom,"
+            " calls=%all-reduce-scatter.3",
+            "  %y = f32[2] all-reduce(%x), replica_groups={{0,1,2,3,4,5,6,7}}",
+            "}",
+        ])
+        ops = parse_collectives(hlo, MESH)
+        rs = [o for o in ops if o.kind == "reduce-scatter"]
+        ar = [o for o in ops if o.kind == "all-reduce"]
+        # 2 call sites -> 2 reduce-scatters, attributed over fsdp from
+        # the body's groups; the representational inner all-reduce is
+        # dropped, the entry one survives
+        assert len(rs) == 2 and len(ar) == 1
+        assert all(o.axes == "fsdp" for o in rs)
+        assert rs[0].direction == "bwd" and rs[1].direction == "fwd"
+
+
+SPMD_LOG = (
+    'W0731 21:41:30.431564 9273 spmd_partitioner.cc:652] [SPMD] Involuntary'
+    " full rematerialization. The compiler cannot go from sharding"
+    " {devices=[4,1,1,2]<=[8] last_tile_dim_replicate} to"
+    " {devices=[1,1,2,4]<=[2,2,2]T(1,0,2) last_tile_dim_replicate}"
+    " efficiently for HLO operation %fake_parameter.2 = bf16[2,64,128]{2,1,0}"
+    " parameter(2), sharding={devices=[4,1,1,2]<=[8]"
+    " last_tile_dim_replicate}. As the last resort, SPMD will replicate the"
+    " tensor and then partition it to obtain the target sharding, which is"
+    " inefficient.\n"
+    "E0803 04:00:00.000000 1 spmd_partitioner.cc:613] [spmd] Involuntary"
+    " full rematerialization. The compiler was not able to go from sharding"
+    " {devices=[1,1,2,4]<=[8] last_tile_dim_replicate} to"
+    " {devices=[2,2,1,2]<=[8] last_tile_dim_replicate} without doing a full"
+    " rematerialization of the tensor for HLO operation: %gather ="
+    " bf16[8,64,64]{2,1,0} gather(bf16[512,64]{1,0} %all-gather), ...\n"
+)
+
+
+class TestInvoluntaryRemat:
+    def test_count(self):
+        assert count_involuntary_remat(SPMD_LOG) == 2
+        assert count_involuntary_remat("clean compile\n") == 0
+
+    def test_structured_parse_both_wordings(self):
+        recs = parse_involuntary_remat(SPMD_LOG)
+        assert len(recs) == 2
+        assert recs[0]["op"] == "fake_parameter.2"
+        assert recs[0]["type"] == "bf16[2,64,128]"
+        assert "devices=[4,1,1,2]" in recs[0]["from"]
+        assert recs[1]["op"] == "gather"
+        assert "devices=[2,2,1,2]" in recs[1]["to"]
+
+
+class TestBudget:
+    def _report(self):
+        return lint_report(HLO, MESH, spmd_log="")
+
+    def test_report_shape(self):
+        rep = self._report()
+        assert rep["collectives"] == {
+            "all-gather": 2, "all-reduce": 2, "collective-permute": 1}
+        assert rep["backward"] == {"all-gather": 1, "all-reduce": 1}
+        assert rep["by_axis"]["fsdp"]["all-gather"] == 2
+        assert rep["involuntary_remat"] == 0
+        assert rep["async_fraction"] == pytest.approx(1 / 5)
+
+    def test_round_trip_passes(self):
+        rep = self._report()
+        golden = budget_from_report(rep, "canned")
+        violations, improvements = check_budget(rep, golden)
+        assert violations == [] and improvements == []
+
+    def test_injected_backward_all_gather_fails_readably(self):
+        rep = self._report()
+        golden = budget_from_report(rep, "canned")
+        # a sharding regression sneaks one extra all-gather into the
+        # backward pass over fsdp
+        evil = HLO.replace(
+            "ENTRY %main {",
+            "ENTRY %main {\n"
+            '  %agx = bf16[8,64,128]{2,1,0} all-gather(bf16[4,64,128] %z),'
+            ' replica_groups={{0,2},{1,3},{4,6},{5,7}},'
+            ' metadata={op_name="jit(step)/jit(main)/transpose(jvp(M))/leak"}',
+            1)
+        rep2 = lint_report(evil, MESH)
+        violations, _ = check_budget(rep2, golden)
+        assert violations, "extra backward all-gather must fail the budget"
+        joined = "\n".join(violations)
+        assert "backward all-gather: 2 > budget 1 (+1)" in joined
+        assert "by_axis[fsdp]" in joined
+
+    def test_remat_regression_fails_with_detail(self):
+        rep = self._report()
+        golden = budget_from_report(rep, "canned")
+        rep2 = lint_report(HLO, MESH, spmd_log=SPMD_LOG)
+        violations, _ = check_budget(rep2, golden)
+        assert any("involuntary_remat: 2 > budget 0" in v for v in violations)
+        assert any("fake_parameter.2" in v for v in violations)
+
+    def test_improvement_is_not_a_violation_unless_strict(self):
+        rep = self._report()
+        golden = budget_from_report(rep, "canned")
+        # remove the permute op entirely
+        lines = [l for l in HLO.splitlines() if "%cp" not in l]
+        slim = lint_report("\n".join(lines), MESH)
+        violations, improvements = check_budget(slim, golden)
+        assert violations == []
+        assert any("collective-permute" in i for i in improvements)
+        violations, _ = check_budget(slim, golden, strict=True)
+        assert violations
+
+    def test_manifest_file_round_trip(self, tmp_path):
+        rep = self._report()
+        path = save_budget(str(tmp_path), "canned", rep)
+        golden = load_budget(str(tmp_path), "canned")
+        assert golden["config"] == "canned"
+        violations, improvements = check_budget(rep, golden)
+        assert violations == [] and improvements == []
+        with open(path) as f:
+            assert json.load(f)["budget"]["involuntary_remat"] == 0
