@@ -122,6 +122,18 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_sched.xml"],
             args.artifacts_dir, cases,
         )
+        # checkpoint-tier gate (ISSUE 4): commit-marker protocol,
+        # restore-planner tier selection, and the peer-fetch unit path
+        # (filesystem + REST shard wire) — always on and fast, so a
+        # regression in the recovery subsystem fails in seconds; the
+        # full local-tier fault matrix runs in the chaos-soak stage
+        ok = ok and stage(
+            "ckpt-tiers",
+            [py, "-m", "pytest", "tests/test_ckpt_tiers.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_ckpt_tiers.xml"],
+            args.artifacts_dir, cases,
+        )
         # collective-budget gate (ISSUE 3): compile the stand-in sharded
         # train steps on the 8-device virtual CPU mesh and enforce their
         # golden budget manifests (ci/hlo_budgets/) — a sharding
@@ -139,9 +151,10 @@ def main(argv=None) -> int:
         # below, never inside the tier-1 unit run
         marker = "not slow and not integration" if args.skip_slow else "not slow"
         pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q", "-m", marker,
-                      # already ran (and gated) in the serving-sched
-                      # stage above — don't pay for them twice
+                      # already ran (and gated) in the serving-sched /
+                      # ckpt-tiers stages above — don't pay for them twice
                       "--ignore=tests/test_serving_sched.py",
+                      "--ignore=tests/test_ckpt_tiers.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]

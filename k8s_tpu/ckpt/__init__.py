@@ -1,0 +1,33 @@
+"""Multi-tier emergency checkpointing (docs/CHECKPOINT.md).
+
+Local tier (frequent, node-local, two-phase-committed sharded
+snapshots) + the persistent orbax tier (rare durable saves) behind one
+manager, with a restore planner that picks the newest consistent step
+and sources a replaced pod's shards from data-parallel peers before
+paying a full persistent-store restore.
+"""
+
+from k8s_tpu.ckpt.local import (  # noqa: F401
+    LocalTier,
+    arm_partial_commit,
+    index_key,
+    parse_index_key,
+)
+from k8s_tpu.ckpt.peer import (  # noqa: F401
+    FilesystemPeerTransport,
+    PeerShardServer,
+    RestPeerTransport,
+)
+from k8s_tpu.ckpt.planner import (  # noqa: F401
+    SOURCE_LOCAL,
+    SOURCE_LOCAL_PEER,
+    SOURCE_NONE,
+    SOURCE_PERSISTENT,
+    RestorePlan,
+    RestorePlanner,
+)
+from k8s_tpu.ckpt.manager import (  # noqa: F401
+    CheckpointPolicy,
+    GoodputStats,
+    MultiTierCheckpointManager,
+)

@@ -1,0 +1,511 @@
+"""Local checkpoint tier: per-host sharded snapshots on node-local disk.
+
+The cheap, frequent half of the multi-tier model (docs/CHECKPOINT.md).
+Every few steps each host snapshots ONLY its addressable shards of the
+sharded TrainState — a device→host copy plus a node-local disk write,
+orders of magnitude cheaper than a durable-store save — so a gang
+restart loses at most ``local_interval`` steps instead of
+``persistent_interval``.
+
+Crash-safety is a **two-phase commit**:
+
+1. *Write phase*: shards + a per-host manifest land in
+   ``step-<N>.pending/``; every shard carries a crc32 recorded in the
+   manifest.
+2. *Commit phase*: after the (pluggable) gang barrier — no host may
+   commit until every host finished writing, or a crash between two
+   hosts' saves would leave the newest step half-present — the pending
+   dir is atomically renamed to ``step-<N>/`` and a ``COMMIT`` marker
+   file is fsynced into it.
+
+A step counts as committed ONLY when the marker exists; a crash at any
+point leaves either the previous committed step intact (pending dir is
+garbage-collected) or the new one fully committed. The restore planner
+(:mod:`k8s_tpu.ckpt.planner`) additionally verifies crcs at read time,
+so torn writes that survive the marker protocol (disk corruption) are
+detected and routed to a peer or the persistent tier.
+
+Shard files are keyed by their **global index** — the slice tuple of
+the global array the shard covers. Under SPMD two devices holding the
+same index hold identical bytes (replication invariant), which is what
+makes peer-shard restore correct: any host whose local tier holds an
+index can serve it to a replaced pod, no matter which mesh axes were
+data-parallel.
+
+Chaos hooks (``arm_partial_commit``, ``corrupt_one_shard``,
+``drop_host``) are installed by the fault matrix
+(:mod:`k8s_tpu.runtime.chaos`) — never in production.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+COMMIT_MARKER = "COMMIT"
+MANIFEST = "manifest.json"
+PENDING_SUFFIX = ".pending"
+PROGRESS_FILE = "progress.json"
+
+# Chaos hook: when armed, the next n commits stop after the write phase
+# (pending dir on disk, no rename, no marker) and raise — exactly what
+# a host crash between phase 1 and phase 2 leaves behind.
+_partial_commit_lock = threading.Lock()
+_partial_commit_remaining = 0
+
+
+def arm_partial_commit(n: int) -> None:
+    """Make the next ``n`` local-tier commits (process-wide) fail after
+    the write phase. ``n=0`` disarms."""
+    global _partial_commit_remaining
+    with _partial_commit_lock:
+        _partial_commit_remaining = n
+
+
+def _take_partial_commit() -> bool:
+    global _partial_commit_remaining
+    with _partial_commit_lock:
+        if _partial_commit_remaining > 0:
+            _partial_commit_remaining -= 1
+            return True
+    return False
+
+
+def index_key(idx: Tuple, shape: Tuple[int, ...]) -> str:
+    """Serialize a shard's global index (tuple of slices) as
+    ``"0:4,8:16"`` — one ``start:stop`` per dim, scalars as ``"-"``."""
+    if not shape:
+        return "-"
+    parts = []
+    for s, dim in zip(idx, shape):
+        start, stop, _ = s.indices(dim)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts)
+
+
+def parse_index_key(key: str) -> Optional[Tuple[slice, ...]]:
+    if key == "-":
+        return ()
+    out = []
+    for part in key.split(","):
+        start, _, stop = part.partition(":")
+        out.append(slice(int(start), int(stop)))
+    return tuple(out)
+
+
+def _leaf_paths(tree) -> List[Tuple[str, Any]]:
+    """Stable ``(path-string, leaf)`` pairs: '/'-joined key path of each
+    leaf — the manifest vocabulary both save and restore agree on."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        out.append(("/".join(_key_str(k) for k in path), leaf))
+    return out
+
+
+def _key_str(k) -> str:
+    # DictKey('params') -> params, SequenceKey(0) -> 0, GetAttrKey -> name
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _leaf_dirname(path: str) -> str:
+    """Filesystem-safe per-leaf directory: a short hash prefix guards
+    against collisions after character replacement."""
+    safe = path.replace("/", "__").replace(".", "_")[:120]
+    return f"{zlib.crc32(path.encode()) & 0xFFFFFFFF:08x}-{safe}"
+
+
+def local_shards_of(leaf, devices=None) -> Dict[str, np.ndarray]:
+    """This host's shards of a jax array, deduplicated by global index
+    (multiple local devices may hold the same replicated shard — one
+    copy is enough). Plain numpy/python leaves are treated as one
+    fully-replicated shard. ``devices`` narrows "this host" to a device
+    subset — how the in-process soak simulates multiple hosts on one
+    runtime."""
+    shards: Dict[str, np.ndarray] = {}
+    addressable = getattr(leaf, "addressable_shards", None)
+    if addressable is None:
+        arr = np.asarray(leaf)
+        full = index_key(tuple(slice(0, d) for d in arr.shape), arr.shape)
+        return {full: arr}
+    for sh in addressable:
+        if devices is not None and sh.device not in devices:
+            continue
+        key = index_key(sh.index, leaf.shape)
+        if key not in shards:
+            shards[key] = np.asarray(sh.data)
+    return shards
+
+
+def required_indices(template_leaf, devices=None) -> List[str]:
+    """The shard indices THIS host must source to rebuild its portion
+    of ``template_leaf`` (a concrete array or a ShapeDtypeStruct
+    carrying a sharding). ``devices`` narrows the host as in
+    :func:`local_shards_of`."""
+    import jax
+
+    sharding = getattr(template_leaf, "sharding", None)
+    shape = tuple(getattr(template_leaf, "shape", ()))
+    if sharding is None:
+        return [index_key(tuple(slice(0, d) for d in shape), shape)]
+    keys = []
+    seen = set()
+    try:
+        imap = sharding.devices_indices_map(shape)
+    except Exception:
+        return [index_key(tuple(slice(0, d) for d in shape), shape)]
+    local = set(jax.local_devices()) if devices is None else set(devices)
+    for dev, idx in imap.items():
+        if dev not in local:
+            continue
+        key = index_key(idx, shape)
+        if key not in seen:
+            seen.add(key)
+            keys.append(key)
+    return keys
+
+
+class LocalTier:
+    """One host's local snapshot store.
+
+    ``root`` is the node-local directory (emptyDir / local SSD in a real
+    pod); this host's snapshots live under ``root/host-<host_id>/``. In
+    the test harness every "node" shares one tmp filesystem, so sibling
+    ``host-*`` dirs stand in for peers' node-local disks — which is
+    exactly what :class:`k8s_tpu.ckpt.peer.FilesystemPeerTransport`
+    reads.
+
+    ``barrier(step)`` is the gang-wide commit barrier — in a distributed
+    run, a callable that returns only when every host finished its write
+    phase (e.g. ``multihost_utils.sync_global_devices``); ``None`` is
+    the single-host no-op.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        host_id: int = 0,
+        max_to_keep: int = 2,
+        barrier: Optional[Callable[[int], None]] = None,
+        sync: bool = False,
+        devices=None,
+    ):
+        self.root = root
+        self.host_id = int(host_id)
+        self.max_to_keep = max_to_keep
+        self.barrier = barrier
+        self.sync = sync
+        self.devices = devices  # None = all of this process's devices
+        # created lazily on first WRITE: instantiating a tier (or a
+        # peer transport / read-side probe) must not resurrect a
+        # dropped host's dir as an empty husk — chaos drop_host and
+        # peer discovery both read the directory layout as truth
+        self.host_dir = os.path.join(root, f"host-{self.host_id}")
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+        self.saves = 0
+        self.commit_failures = 0
+
+    # ------------------------------------------------------------ paths
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.host_dir, f"step-{step}")
+
+    def _pending_dir(self, step: int) -> str:
+        return self.step_dir(step) + PENDING_SUFFIX
+
+    # ------------------------------------------------------------ save
+
+    def save(self, step: int, tree: Any) -> bool:
+        """Snapshot this host's shards of ``tree`` at ``step``.
+
+        The device→host copy happens NOW (so the caller may donate /
+        mutate the arrays immediately after); the disk write + commit
+        run on a background thread (double-buffered: at most one write
+        in flight — a new save first drains the previous one). Returns
+        False if the step is already committed.
+        """
+        if step in self.committed_steps():
+            return False
+        self.wait()  # drain the previous in-flight write (double buffer)
+        host_buffers: Dict[str, Dict[str, np.ndarray]] = {}
+        meta: Dict[str, Dict[str, Any]] = {}
+        for path, leaf in _leaf_paths(tree):
+            shards = local_shards_of(leaf, devices=self.devices)
+            host_buffers[path] = shards
+            # NB: getattr with an eager np.asarray default would fetch
+            # the GLOBAL array (explodes on multi-host shardings)
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                shape, dtype = leaf.shape, leaf.dtype
+            else:
+                as_np = np.asarray(leaf)
+                shape, dtype = as_np.shape, as_np.dtype
+            meta[path] = {"shape": list(shape), "dtype": str(dtype)}
+        if self.sync:
+            self._write_and_commit(step, host_buffers, meta)
+        else:
+            t = threading.Thread(
+                target=self._write_guarded,
+                args=(step, host_buffers, meta),
+                daemon=True,
+                name=f"ckpt-local-{self.host_id}",
+            )
+            self._writer = t
+            t.start()
+        return True
+
+    def _write_guarded(self, step, host_buffers, meta) -> None:
+        try:
+            self._write_and_commit(step, host_buffers, meta)
+        except BaseException as e:  # surfaced by the next wait()/save()
+            self._writer_error = e
+
+    def _write_and_commit(self, step, host_buffers, meta) -> None:
+        os.makedirs(self.host_dir, exist_ok=True)
+        pending = self._pending_dir(step)
+        if os.path.exists(pending):
+            shutil.rmtree(pending, ignore_errors=True)
+        os.makedirs(pending)
+        manifest: Dict[str, Any] = {
+            "step": step,
+            "host": self.host_id,
+            "leaves": {},
+        }
+        for path, shards in host_buffers.items():
+            leaf_dir = os.path.join(pending, _leaf_dirname(path))
+            os.makedirs(leaf_dir, exist_ok=True)
+            entry = dict(meta[path])
+            entry["shards"] = {}
+            for key, arr in shards.items():
+                fname = key.replace(":", "_").replace(",", "+") or "scalar"
+                fpath = os.path.join(leaf_dir, fname + ".npy")
+                with open(fpath, "wb") as f:
+                    np.save(f, arr)
+                    f.flush()
+                    os.fsync(f.fileno())
+                entry["shards"][key] = {
+                    "file": os.path.relpath(fpath, pending),
+                    "crc": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+                }
+            manifest["leaves"][path] = entry
+        mpath = os.path.join(pending, MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # ---- phase 2: barrier, then atomic publish --------------------
+        if _take_partial_commit():
+            self.commit_failures += 1
+            raise OSError(
+                f"chaos: injected partial local commit at step {step} "
+                f"(pending dir left behind)"
+            )
+        if self.barrier is not None:
+            self.barrier(step)
+        final = self.step_dir(step)
+        os.rename(pending, final)
+        cpath = os.path.join(final, COMMIT_MARKER)
+        with open(cpath, "w") as f:
+            f.write(f"{step}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.saves += 1
+        self._retain()
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) finished; re-raise
+        its error exactly once."""
+        t = self._writer
+        if t is not None:
+            t.join()
+            self._writer = None
+        err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise err
+
+    def _retain(self) -> None:
+        steps = self.committed_steps()
+        for old in steps[: max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(self.step_dir(old), ignore_errors=True)
+        # stale pending dirs from a crashed/failed commit are garbage
+        for name in os.listdir(self.host_dir):
+            if name.endswith(PENDING_SUFFIX):
+                try:
+                    pstep = int(name[len("step-"):-len(PENDING_SUFFIX)])
+                except ValueError:
+                    continue
+                if steps and pstep < steps[-1]:
+                    shutil.rmtree(
+                        os.path.join(self.host_dir, name), ignore_errors=True
+                    )
+
+    # ------------------------------------------------------------ progress
+
+    def note_progress(self, step: int) -> None:
+        """Record the last COMPLETED train step — a tiny atomic write
+        per step. Restore reads it (from any surviving host) to compute
+        lost-steps-per-restart: progress - restored_step."""
+        os.makedirs(self.host_dir, exist_ok=True)
+        tmp = os.path.join(self.host_dir, PROGRESS_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step)}, f)
+        os.replace(tmp, os.path.join(self.host_dir, PROGRESS_FILE))
+
+    def progress(self) -> int:
+        try:
+            with open(os.path.join(self.host_dir, PROGRESS_FILE)) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            return -1
+
+    # ------------------------------------------------------------ read side
+
+    def committed_steps(self, host_id: Optional[int] = None) -> List[int]:
+        """Ascending committed steps for a host on THIS filesystem
+        (committed = dir renamed AND marker present)."""
+        hdir = (
+            self.host_dir
+            if host_id is None
+            else os.path.join(self.root, f"host-{host_id}")
+        )
+        steps = []
+        try:
+            names = os.listdir(hdir)
+        except OSError:
+            return []
+        for name in names:
+            if not name.startswith("step-") or name.endswith(PENDING_SUFFIX):
+                continue
+            if not os.path.exists(os.path.join(hdir, name, COMMIT_MARKER)):
+                continue
+            try:
+                steps.append(int(name[len("step-"):]))
+            except ValueError:
+                continue
+        return sorted(steps)
+
+    def manifest(self, step: int, host_id: Optional[int] = None) -> Optional[dict]:
+        hdir = (
+            self.host_dir
+            if host_id is None
+            else os.path.join(self.root, f"host-{host_id}")
+        )
+        sdir = os.path.join(hdir, f"step-{step}")
+        if not os.path.exists(os.path.join(sdir, COMMIT_MARKER)):
+            return None
+        try:
+            with open(os.path.join(sdir, MANIFEST)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def read_shard(
+        self, step: int, leaf_path: str, key: str, host_id: Optional[int] = None
+    ) -> Optional[np.ndarray]:
+        """Load + crc-verify one shard; None when missing or corrupt
+        (the caller falls back to a peer / the persistent tier)."""
+        man = self.manifest(step, host_id=host_id)
+        if man is None:
+            return None
+        entry = (man.get("leaves") or {}).get(leaf_path)
+        if entry is None:
+            return None
+        shard = (entry.get("shards") or {}).get(key)
+        if shard is None:
+            return None
+        hdir = (
+            self.host_dir
+            if host_id is None
+            else os.path.join(self.root, f"host-{host_id}")
+        )
+        fpath = os.path.join(hdir, f"step-{step}", shard["file"])
+        try:
+            arr = np.load(fpath)
+        except (OSError, ValueError):
+            return None
+        if (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF) != shard["crc"]:
+            log.warning(
+                "local tier: crc mismatch for %s[%s] step %d host %s — "
+                "treating shard as lost",
+                leaf_path, key, step, host_id if host_id is not None
+                else self.host_id,
+            )
+            return None
+        return arr
+
+    # ------------------------------------------------------------ chaos
+    # helpers operating on a whole local root (any host) — used by the
+    # fault matrix; deterministic given the injector's seeded rng.
+
+    @staticmethod
+    def corrupt_one_shard(root: str, rng) -> Optional[str]:
+        """Flip bytes in one random committed shard file under ``root``.
+        Returns the corrupted path, or None when nothing is committed."""
+        candidates = []
+        for host in sorted(os.listdir(root) if os.path.isdir(root) else []):
+            hdir = os.path.join(root, host)
+            if not host.startswith("host-") or not os.path.isdir(hdir):
+                continue
+            for sname in sorted(os.listdir(hdir)):
+                sdir = os.path.join(hdir, sname)
+                if sname.endswith(PENDING_SUFFIX) or not os.path.isdir(sdir):
+                    continue
+                if not os.path.exists(os.path.join(sdir, COMMIT_MARKER)):
+                    continue
+                for dirpath, _, files in os.walk(sdir):
+                    for fn in files:
+                        if fn.endswith(".npy"):
+                            candidates.append(os.path.join(dirpath, fn))
+        if not candidates:
+            return None
+        victim = rng.choice(sorted(candidates))
+        with open(victim, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            # stomp the tail (payload bytes, past the npy header)
+            f.seek(max(0, size - 16))
+            f.write(b"\xde\xad\xbe\xef" * 4)
+        return victim
+
+    @staticmethod
+    def drop_host(root: str, rng, keep_at_least: int = 1) -> Optional[int]:
+        """Delete one random host's entire local dir — the replaced-pod
+        / lost-node simulation. Refuses to drop below ``keep_at_least``
+        surviving hosts WITH DATA (an empty dir — a fresh pod that has
+        not committed yet — neither counts as a survivor nor shields a
+        populated tier from being the last one standing). Returns the
+        dropped host id."""
+        populated = []
+        for n in sorted(os.listdir(root) if os.path.isdir(root) else []):
+            hdir = os.path.join(root, n)
+            if not n.startswith("host-") or not os.path.isdir(hdir):
+                continue
+            has_commit = any(
+                s.startswith("step-") and not s.endswith(PENDING_SUFFIX)
+                and os.path.exists(os.path.join(hdir, s, COMMIT_MARKER))
+                for s in os.listdir(hdir)
+            )
+            if has_commit:
+                try:
+                    populated.append(int(n[len("host-"):]))
+                except ValueError:
+                    continue
+        if len(populated) <= keep_at_least:
+            return None
+        victim = rng.choice(populated)
+        shutil.rmtree(os.path.join(root, f"host-{victim}"), ignore_errors=True)
+        return victim

@@ -1,0 +1,334 @@
+"""Multi-tier checkpoint manager + goodput accounting.
+
+One object with the same surface the training programs already use
+(``save / restore / wait / close / reached_preemption / latest_step``),
+composing:
+
+- the **local tier** (:mod:`k8s_tpu.ckpt.local`): cheap per-host
+  snapshots every ``local_interval`` steps;
+- the **persistent tier** (the existing orbax
+  :class:`k8s_tpu.train.checkpoint.CheckpointManager`), demoted to
+  low-frequency durable saves every ``persistent_interval`` steps;
+- the **restore planner** (:mod:`k8s_tpu.ckpt.planner`): newest
+  consistent step across tiers, peer-shard sourcing for replaced pods.
+
+Goodput accounting rides along: every save is timed against loop
+wall-clock (checkpoint overhead fraction), every restore records its
+source tier and the steps lost since the last recorded progress
+(lost-steps-per-restart). The numbers are exported three ways —
+``goodput()`` (the ``engine.stats`` analogue), JSON event lines on
+stdout (the harness/e2e contract), and the process-global metrics
+registry (:mod:`k8s_tpu.controller.metrics`, served by any /metrics
+endpoint).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from k8s_tpu.ckpt.local import LocalTier
+from k8s_tpu.ckpt.peer import FilesystemPeerTransport, RestPeerTransport
+from k8s_tpu.ckpt.planner import (
+    SOURCE_NONE,
+    RestorePlan,
+    RestorePlanner,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class CheckpointPolicy:
+    """Resolved checkpointPolicy (spec block → env → here). Zero
+    intervals disable a tier."""
+
+    local_dir: str = ""
+    local_interval_steps: int = 0
+    local_max_to_keep: int = 2
+    persistent_dir: str = ""
+    persistent_interval_steps: int = 0
+    peer_fetch: bool = True
+
+    @classmethod
+    def from_env(cls, env=None) -> "CheckpointPolicy":
+        env = env if env is not None else os.environ
+
+        def num(name, default):
+            try:
+                return int(env.get(name, "") or default)
+            except ValueError:
+                return default
+
+        return cls(
+            local_dir=env.get("KTPU_CKPT_LOCAL_DIR", ""),
+            local_interval_steps=num("KTPU_CKPT_LOCAL_EVERY", 0),
+            local_max_to_keep=num("KTPU_CKPT_LOCAL_KEEP", 2),
+            persistent_dir=env.get("KTPU_CKPT_DIR", ""),
+            persistent_interval_steps=num("KTPU_CKPT_PERSIST_EVERY", 0),
+            peer_fetch=env.get("KTPU_CKPT_PEER_FETCH", "1")
+            not in ("0", "false"),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.local_dir or self.persistent_dir)
+
+
+@dataclass
+class GoodputStats:
+    """Counters the acceptance criteria read. ``lost_steps_last`` /
+    ``per restart``: progress the gang had made past the step the
+    restart restored — the work a faster local tier exists to shrink."""
+
+    restores: int = 0
+    restore_sources: Dict[str, int] = field(default_factory=dict)
+    lost_steps_total: int = 0
+    lost_steps_last: int = -1  # -1: no restore yet / progress unknown
+    peer_shards_fetched: int = 0
+    local_saves: int = 0
+    local_save_failures: int = 0
+    persistent_saves: int = 0
+    save_seconds_total: float = 0.0
+    loop_seconds_total: float = 0.0
+
+    def overhead_fraction(self) -> float:
+        if self.loop_seconds_total <= 0:
+            return 0.0
+        return min(1.0, self.save_seconds_total / self.loop_seconds_total)
+
+    def lost_steps_per_restart(self) -> float:
+        if self.restores == 0:
+            return 0.0
+        return self.lost_steps_total / self.restores
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "restores": self.restores,
+            "restore_sources": dict(self.restore_sources),
+            "lost_steps_total": self.lost_steps_total,
+            "lost_steps_last": self.lost_steps_last,
+            "lost_steps_per_restart": round(self.lost_steps_per_restart(), 3),
+            "peer_shards_fetched": self.peer_shards_fetched,
+            "local_saves": self.local_saves,
+            "local_save_failures": self.local_save_failures,
+            "persistent_saves": self.persistent_saves,
+            "ckpt_overhead_fraction": round(self.overhead_fraction(), 5),
+        }
+
+
+class MultiTierCheckpointManager:
+    """Drop-in for :class:`k8s_tpu.train.checkpoint.CheckpointManager`
+    with a local tier in front of it."""
+
+    def __init__(
+        self,
+        policy: CheckpointPolicy,
+        host_id: int = 0,
+        barrier=None,
+        transport=None,
+        consensus=None,
+        persistent=None,
+        gang_consistent: bool = False,
+    ):
+        self.policy = policy
+        self.host_id = host_id
+        self.stats = GoodputStats()
+        self._loop_t0 = time.monotonic()
+        self.local: Optional[LocalTier] = None
+        if policy.local_dir and policy.local_interval_steps > 0:
+            self.local = LocalTier(
+                policy.local_dir,
+                host_id=host_id,
+                max_to_keep=policy.local_max_to_keep,
+                barrier=barrier,
+            )
+        self.persistent = persistent
+        if self.persistent is None and policy.persistent_dir:
+            from k8s_tpu.train.checkpoint import CheckpointManager
+
+            self.persistent = CheckpointManager(
+                policy.persistent_dir,
+                save_interval_steps=max(
+                    1, policy.persistent_interval_steps or 1),
+            )
+        if transport is None and self.local is not None and policy.peer_fetch:
+            peers_env = os.environ.get("KTPU_CKPT_PEERS", "")
+            if peers_env:
+                transport = RestPeerTransport.from_env_value(
+                    peers_env, self_host=host_id)
+            else:
+                # shared-root harness / scratch-tier deployments: sibling
+                # host-* dirs ARE the peers' node-local disks
+                transport = FilesystemPeerTransport(
+                    policy.local_dir, self_host=host_id)
+        self.transport = transport
+        self.planner = RestorePlanner(
+            self.local, self.persistent, transport=transport,
+            consensus=consensus, gang_consistent=gang_consistent,
+        )
+        self.last_restore_plan: Optional[RestorePlan] = None
+
+    @classmethod
+    def from_env(cls, host_id: int = 0, env=None, barrier=None,
+                 consensus=None, gang_consistent: bool = False,
+                 ) -> Optional["MultiTierCheckpointManager"]:
+        policy = CheckpointPolicy.from_env(env)
+        if not policy.enabled:
+            return None
+        return cls(policy, host_id=host_id, barrier=barrier,
+                   consensus=consensus, gang_consistent=gang_consistent)
+
+    # ------------------------------------------------------------ save
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Tier routing: local every ``local_interval`` steps,
+        persistent every ``persistent_interval`` steps; ``force`` writes
+        BOTH (the preemption-flush / final-save path must land durably
+        AND be the newest local step so the restart restores it fast)."""
+        t0 = time.monotonic()
+        wrote = False
+        try:
+            if self.local is not None and (
+                force or step % self.policy.local_interval_steps == 0
+            ):
+                # best-effort: a failed local snapshot (full node disk,
+                # chaos partial commit) degrades THIS interval's restart
+                # cost, never the training job — the persistent tier is
+                # the correctness floor
+                try:
+                    if self.local.save(step, state):
+                        self.stats.local_saves += 1
+                        self._metric("CKPT_LOCAL_SAVES").inc()
+                        wrote = True
+                except Exception as e:
+                    self.stats.local_save_failures += 1
+                    log.warning(
+                        "local checkpoint save failed at step %d (%s: %s); "
+                        "local tier degraded this interval",
+                        step, type(e).__name__, e)
+            if self.persistent is not None and (
+                force
+                or (
+                    self.policy.persistent_interval_steps > 0
+                    and step % self.policy.persistent_interval_steps == 0
+                )
+            ):
+                if self.persistent.save(step, state, force=force):
+                    self.stats.persistent_saves += 1
+                    wrote = True
+        finally:
+            self.stats.save_seconds_total += time.monotonic() - t0
+            self._update_gauges()
+        return wrote
+
+    def note_step(self, step: int) -> None:
+        """Per-step bookkeeping (cheap): progress marker for
+        lost-steps accounting + loop-time accumulation for the overhead
+        fraction."""
+        now = time.monotonic()
+        self.stats.loop_seconds_total += now - self._loop_t0
+        self._loop_t0 = now
+        if self.local is not None:
+            self.local.note_progress(step)
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self, state_template: Any,
+                step: Optional[int] = None) -> Optional[Any]:
+        if step is not None and self.persistent is not None:
+            # explicit-step restore bypasses planning (debug surface)
+            return self.persistent.restore(state_template, step=step)
+        tree, plan = self.planner.restore(state_template)
+        self.last_restore_plan = plan
+        if plan.source != SOURCE_NONE:
+            self.stats.restores += 1
+            self.stats.restore_sources[plan.source] = (
+                self.stats.restore_sources.get(plan.source, 0) + 1
+            )
+            self.stats.peer_shards_fetched += plan.peer_fetches
+            self._metric("CKPT_RESTORES").inc({"source": plan.source})
+            progress = self._best_progress()
+            if progress >= 0 and plan.step is not None:
+                lost = max(0, progress - plan.step)
+                self.stats.lost_steps_last = lost
+                self.stats.lost_steps_total += lost
+                self._metric("CKPT_LOST_STEPS").inc(by=lost)
+            print(json.dumps({
+                "event": "ckpt_restore", "step": plan.step,
+                "source": plan.source, "peer_shards": plan.peer_fetches,
+                "lost_steps": self.stats.lost_steps_last,
+            }), flush=True)
+        self._update_gauges()
+        return tree
+
+    def _best_progress(self) -> int:
+        best = self.local.progress() if self.local is not None else -1
+        if self.transport is not None:
+            try:
+                best = max(best, self.transport.progress())
+            except Exception:
+                pass
+        return best
+
+    # ------------------------------------------------------------ passthrough
+
+    def reached_preemption(self, step: int) -> bool:
+        if self.persistent is not None:
+            return self.persistent.reached_preemption(step)
+        # local-only policy: no orbax manager → no coordination-service
+        # consensus poll. Fall back to the launcher's SIGTERM flag: the
+        # node drain SIGTERMs every pod of the slice, and a local-tier
+        # flush is collective-free (own shards → own disk), so each
+        # host flushing at its own step boundary is safe — the restore
+        # planner's gang rule reconciles off-by-one commits.
+        return os.environ.get("KTPU_PREEMPT_REQUESTED") == "1"
+
+    def latest_step(self) -> Optional[int]:
+        steps = []
+        if self.local is not None:
+            steps.extend(self.local.committed_steps())
+        if self.persistent is not None:
+            ps = self.persistent.latest_step()
+            if ps is not None:
+                steps.append(ps)
+        return max(steps) if steps else None
+
+    def wait(self) -> None:
+        if self.local is not None:
+            try:
+                self.local.wait()
+            except Exception as e:  # async local write failed: degraded,
+                self.stats.local_save_failures += 1  # not fatal
+                log.warning("local checkpoint flush failed (%s: %s)",
+                            type(e).__name__, e)
+        if self.persistent is not None:
+            self.persistent.wait()
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            if self.persistent is not None:
+                self.persistent.close()
+
+    # ------------------------------------------------------------ goodput
+
+    def goodput(self) -> Dict[str, Any]:
+        return self.stats.to_dict()
+
+    def _metric(self, name: str):
+        from k8s_tpu.controller import metrics
+
+        return getattr(metrics, name)
+
+    def _update_gauges(self) -> None:
+        from k8s_tpu.controller import metrics
+
+        metrics.CKPT_OVERHEAD_FRACTION.set(self.stats.overhead_fraction())
+        metrics.CKPT_LOST_STEPS_PER_RESTART.set(
+            self.stats.lost_steps_per_restart())

@@ -1,0 +1,364 @@
+"""Restore planner: pick the newest *consistent* step across tiers and
+source every shard this host needs.
+
+Decision table (docs/CHECKPOINT.md):
+
+==========================  ============================================
+situation                   restore
+==========================  ============================================
+local step > persistent,    local tier (fast path — no durable-store
+all shards on own disk      read at all)
+local step > persistent,    own disk + data-parallel peers for the
+own shards missing/corrupt  missing indices ("local+peer")
+no achievable local step    persistent tier (orbax)
+newer than persistent
+nothing anywhere            fresh start (restore returns None)
+==========================  ============================================
+
+A local step is *achievable* for this host when every shard index its
+target sharding requires can be sourced — own committed+crc-valid file
+first, else any peer advertising that (step, leaf, index). Uncommitted
+steps (pending dirs without the COMMIT marker) are invisible by
+construction: :meth:`LocalTier.committed_steps` never lists them.
+
+Gang consistency: in a distributed run every process must restore the
+SAME step — a host restoring step 6 next to a host restoring step 4 is
+silent divergence. Two mechanisms compose:
+
+- ``gang_consistent=True`` (the default for multi-process runs)
+  replaces per-host achievability with **full global coverage**: a
+  local step is a candidate only when the union of every visible
+  manifest (own + peers) covers ALL indices of every leaf. Every host
+  evaluates the same manifests, so every host reaches the same verdict
+  with zero communication — and full coverage implies every host's
+  subset is sourcible. Conservative by construction: a step only some
+  hosts could restore is rejected for all of them.
+- ``consensus`` (pluggable, e.g. a min-all-reduce over the
+  coordination service) remains available as a belt-and-suspenders
+  reduction on top; the single-host default is identity.
+
+The chosen step is only a *plan* — if sourcing fails mid-way (a peer
+died between planning and fetching), the planner degrades to the
+persistent tier instead of wedging.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from k8s_tpu.ckpt.local import (
+    LocalTier,
+    _leaf_paths,
+    parse_index_key,
+    required_indices,
+)
+
+log = logging.getLogger(__name__)
+
+def _full_indices(template_leaf) -> List[str]:
+    """EVERY shard index of the leaf's global array across the whole
+    sharding (not just this host's) — the gang-coverage vocabulary."""
+    from k8s_tpu.ckpt.local import index_key
+
+    sharding = getattr(template_leaf, "sharding", None)
+    shape = tuple(getattr(template_leaf, "shape", ()))
+    if sharding is None:
+        return [index_key(tuple(slice(0, d) for d in shape), shape)]
+    try:
+        imap = sharding.devices_indices_map(shape)
+    except Exception:
+        return [index_key(tuple(slice(0, d) for d in shape), shape)]
+    keys, seen = [], set()
+    for idx in imap.values():
+        key = index_key(idx, shape)
+        if key not in seen:
+            seen.add(key)
+            keys.append(key)
+    return keys
+
+
+SOURCE_LOCAL = "local"
+SOURCE_LOCAL_PEER = "local+peer"
+SOURCE_PERSISTENT = "persistent"
+SOURCE_NONE = "none"
+
+
+@dataclass
+class RestorePlan:
+    step: Optional[int]
+    source: str
+    # leaf path -> {index_key: host_id} for shards sourced from peers
+    peer_shards: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    peer_fetches: int = 0
+
+
+class RestorePlanner:
+    """Plans and executes a restore across the local tier, peers, and
+    the persistent (orbax) tier."""
+
+    def __init__(
+        self,
+        local: Optional[LocalTier],
+        persistent=None,
+        transport=None,
+        consensus: Optional[Callable[[int], int]] = None,
+        devices=None,
+        gang_consistent: bool = False,
+    ):
+        self.local = local
+        self.persistent = persistent  # train.checkpoint.CheckpointManager
+        self.transport = transport
+        self.consensus = consensus or (lambda step: step)
+        # device subset defining "this host" (virtual-host simulation);
+        # None = all of this process's devices
+        self.devices = devices
+        # multi-process mode: candidate steps must be FULLY covered by
+        # the union of visible manifests (see module docstring) so every
+        # host picks the same step without communicating
+        self.gang_consistent = gang_consistent
+
+    # ------------------------------------------------------------ planning
+
+    def _peer_steps(self) -> Dict[int, List[int]]:
+        if self.transport is None:
+            return {}
+        try:
+            return self.transport.steps()
+        except Exception as e:
+            log.warning("restore planner: peer step discovery failed (%s); "
+                        "continuing without peers", e)
+            return {}
+
+    def _candidate_steps(
+        self, peer_steps: Dict[int, List[int]]
+    ) -> List[int]:
+        """Local-tier candidate steps, newest first: own committed steps
+        plus any step some peer committed (a replaced pod has NO own
+        steps — peers are its only local-tier source)."""
+        steps = set(self.local.committed_steps() if self.local else [])
+        for peer_list in peer_steps.values():
+            steps.update(peer_list)
+        return sorted(steps, reverse=True)
+
+    def plan(self, template: Any) -> RestorePlan:
+        """Choose the step + per-shard sources for this host. Template
+        leaves are concrete arrays or ShapeDtypeStructs carrying the
+        target shardings (same contract as CheckpointManager.restore)."""
+        if self.transport is not None and hasattr(self.transport, "reset"):
+            # a peer blacklisted during an earlier restore (booting,
+            # transient timeout) gets a fresh chance each plan
+            self.transport.reset()
+        persistent_step = None
+        if self.persistent is not None:
+            try:
+                persistent_step = self.persistent.latest_step()
+            except Exception as e:
+                log.warning("restore planner: persistent tier latest_step "
+                            "failed (%s)", e)
+        needed = {
+            path: required_indices(leaf, devices=self.devices)
+            for path, leaf in _leaf_paths(template)
+        }
+        # gang mode additionally demands the union of manifests cover
+        # EVERY index of every leaf — the deterministic, communication-
+        # free proof that each peer can restore this step too
+        coverage = None
+        if self.gang_consistent:
+            coverage = {
+                path: _full_indices(leaf)
+                for path, leaf in _leaf_paths(template)
+            }
+        # one peer round-trip per plan, shared by candidate listing and
+        # every per-step achievability check (a dead peer costs one
+        # timeout, not one per retained step)
+        peer_steps = self._peer_steps()
+        best_local: Optional[Tuple[int, Dict[str, Dict[str, int]], int]] = None
+        for step in self._candidate_steps(peer_steps):
+            if persistent_step is not None and step <= persistent_step:
+                break  # older than the durable tier — no point
+            achievable, peer_shards, fetches = self._achievable(
+                step, needed, coverage, peer_steps)
+            if achievable:
+                best_local = (step, peer_shards, fetches)
+                break
+        if best_local is not None:
+            step = self.consensus(best_local[0])
+            if step != best_local[0]:
+                # the gang agreed on an older step (some peer couldn't
+                # source ours) — re-plan shard sources for THAT step
+                achievable, peer_shards, fetches = self._achievable(
+                    step, needed, coverage, peer_steps)
+                if not achievable:
+                    return self._persistent_plan(persistent_step)
+                best_local = (step, peer_shards, fetches)
+            step, peer_shards, fetches = best_local
+            return RestorePlan(
+                step=step,
+                source=SOURCE_LOCAL_PEER if fetches else SOURCE_LOCAL,
+                peer_shards=peer_shards,
+                peer_fetches=fetches,
+            )
+        return self._persistent_plan(persistent_step)
+
+    def _persistent_plan(self, persistent_step) -> RestorePlan:
+        if persistent_step is None:
+            return RestorePlan(step=None, source=SOURCE_NONE)
+        return RestorePlan(step=persistent_step, source=SOURCE_PERSISTENT)
+
+    def _achievable(
+        self, step: int, needed: Dict[str, List[str]],
+        coverage: Optional[Dict[str, List[str]]] = None,
+        peer_steps: Optional[Dict[int, List[int]]] = None,
+    ) -> Tuple[bool, Dict[str, Dict[str, int]], int]:
+        """Can this host source every required shard at ``step``?
+        Checks manifests only (no payload reads): own manifest first,
+        then peers'. crc validation happens at fetch time; a corrupt
+        own-shard is re-sourced from a peer then. ``coverage`` (gang
+        mode) additionally requires the union of visible manifests to
+        hold EVERY listed index — proving every peer could restore this
+        step too."""
+        own = self.local.manifest(step) if self.local else None
+        peer_manifests: Dict[int, dict] = {}
+        peer_hosts = []
+        if self.transport is not None:
+            if peer_steps is None:
+                peer_steps = self._peer_steps()
+            for h, steps in sorted(peer_steps.items()):
+                if step in steps:
+                    peer_hosts.append(h)
+        peer_shards: Dict[str, Dict[str, int]] = {}
+        fetches = 0
+        for path, keys in needed.items():
+            own_entry = ((own or {}).get("leaves") or {}).get(path, {})
+            own_keys = set((own_entry.get("shards") or {}))
+            for key in keys:
+                if key in own_keys:
+                    continue
+                host = self._peer_with(step, path, key, peer_hosts,
+                                       peer_manifests)
+                if host is None:
+                    return False, {}, 0
+                peer_shards.setdefault(path, {})[key] = host
+                fetches += 1
+        if coverage is not None:
+            for path, keys in coverage.items():
+                own_entry = ((own or {}).get("leaves") or {}).get(path, {})
+                own_keys = set((own_entry.get("shards") or {}))
+                for key in keys:
+                    if key in own_keys:
+                        continue
+                    if self._peer_with(step, path, key, peer_hosts,
+                                       peer_manifests) is None:
+                        return False, {}, 0
+        return True, peer_shards, fetches
+
+    def _peer_with(self, step, path, key, peer_hosts, peer_manifests):
+        for h in peer_hosts:
+            man = peer_manifests.get(h)
+            if man is None:
+                try:
+                    man = self.transport.manifest(step, h) or {}
+                except Exception:
+                    man = {}
+                peer_manifests[h] = man
+            entry = (man.get("leaves") or {}).get(path, {})
+            if key in (entry.get("shards") or {}):
+                return h
+        return None
+
+    # ------------------------------------------------------------ execution
+
+    def restore(self, template: Any) -> Tuple[Optional[Any], RestorePlan]:
+        """Execute the plan. Returns ``(tree, plan)``; tree is None for
+        a fresh start. A mid-restore sourcing failure (peer died after
+        planning, crc rot) degrades to the persistent tier.
+
+        Virtual-host planners (``devices=`` a subset) are PLANNING-ONLY:
+        execution materializes the full sharding, whose indices a
+        subset-scoped plan never validated — restore through a
+        full-device planner instead (what the soak's harness does)."""
+        if self.devices is not None:
+            raise ValueError(
+                "RestorePlanner(devices=...) is planning-only; execute "
+                "the restore with a full-device planner")
+        plan = self.plan(template)
+        if plan.source in (SOURCE_LOCAL, SOURCE_LOCAL_PEER):
+            tree = self._restore_local(plan, template)
+            if tree is not None:
+                return tree, plan
+            log.warning(
+                "restore: local-tier restore of step %s failed mid-way; "
+                "falling back to the persistent tier", plan.step)
+            persistent_step = (
+                self.persistent.latest_step()
+                if self.persistent is not None else None
+            )
+            plan = self._persistent_plan(persistent_step)
+        if plan.source == SOURCE_PERSISTENT:
+            tree = self.persistent.restore(template, step=plan.step)
+            if tree is None:
+                return None, RestorePlan(step=None, source=SOURCE_NONE)
+            return tree, plan
+        return None, plan
+
+    def _restore_local(self, plan: RestorePlan, template) -> Optional[Any]:
+        import jax
+
+        step = plan.step
+        leaves_out = []
+        for path, leaf in _leaf_paths(template):
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = getattr(leaf, "dtype", None)
+            sharding = getattr(leaf, "sharding", None)
+            shard_data: Dict[str, np.ndarray] = {}
+            for key in required_indices(leaf):
+                arr = None
+                peer = plan.peer_shards.get(path, {}).get(key)
+                if peer is None and self.local is not None:
+                    arr = self.local.read_shard(step, path, key)
+                    if arr is None and self.transport is not None:
+                        # own shard corrupt/raced away — any peer will do
+                        for h in sorted(self.transport.steps()):
+                            arr = self.transport.fetch(step, path, key, h)
+                            if arr is not None:
+                                break
+                elif peer is not None:
+                    arr = self.transport.fetch(step, path, key, peer)
+                    if arr is None:
+                        # planned peer died: try the others
+                        for h in sorted(self.transport.steps()):
+                            if h == peer:
+                                continue
+                            arr = self.transport.fetch(step, path, key, h)
+                            if arr is not None:
+                                break
+                if arr is None:
+                    return None
+                shard_data[key] = arr
+            if sharding is None or not shape:
+                # replicated / host / scalar leaf: the single full shard
+                arr = next(iter(shard_data.values()))
+                if dtype is not None:
+                    arr = np.asarray(arr, dtype=dtype)
+                if sharding is not None:
+                    # honor the template placement — a committed
+                    # single-device scalar next to mesh-committed
+                    # arrays would poison the next jit call
+                    arr = jax.device_put(arr, sharding)
+                leaves_out.append(arr)
+                continue
+
+            def cb(idx, _data=shard_data, _shape=shape):
+                from k8s_tpu.ckpt.local import index_key
+
+                return _data[index_key(idx, _shape)]
+
+            leaves_out.append(
+                jax.make_array_from_callback(shape, sharding, cb)
+            )
+        flat, treedef = jax.tree_util.tree_flatten(template)
+        return jax.tree_util.tree_unflatten(treedef, leaves_out)

@@ -33,9 +33,31 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         if self.path in ("/healthz", "/", "/readyz"):
             healthy = self.server.owner.healthy
-            body = b"ok\n" if healthy else b"unhealthy\n"
+            provider = self.server.owner.stats_provider
+            if provider is not None:
+                # stats-enriched healthz (the serving-server idiom):
+                # liveness verdict + a JSON block of component stats,
+                # e.g. checkpoint goodput (docs/CHECKPOINT.md)
+                import json
+
+                try:
+                    # default=str: numpy scalars out of a training loop
+                    # must not break serialization; the whole pipeline
+                    # stays inside the guard — an unserializable stats
+                    # dict must never break the LIVENESS probe either
+                    body = json.dumps(
+                        {"ok": healthy, **(provider() or {})},
+                        default=str).encode() + b"\n"
+                except Exception as e:  # stats must never break liveness
+                    body = json.dumps(
+                        {"ok": healthy, "stats_error": str(e)}
+                    ).encode() + b"\n"
+                ctype = "application/json"
+            else:
+                body = b"ok\n" if healthy else b"unhealthy\n"
+                ctype = "text/plain; charset=utf-8"
             self.send_response(200 if healthy else 503)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -67,9 +89,13 @@ class HealthServer:
     """
 
     def __init__(self, port: int, registry: Optional[metrics.Registry] = None,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", stats_provider=None):
         self.registry = registry or metrics.REGISTRY
         self.healthy = True
+        # optional callable returning a dict merged into the /healthz
+        # body (checkpoint goodput, scheduler stats, ...); None keeps
+        # the plain "ok" contract
+        self.stats_provider = stats_provider
         self._server = _Server((host, port), _Handler)
         self._server.owner = self
         self.port = self._server.server_address[1]

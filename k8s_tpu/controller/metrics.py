@@ -156,3 +156,26 @@ CHAOS_FAULTS = REGISTRY.counter(
     "ktpu_operator_chaos_faults_total",
     "Faults injected by the chaos matrix, by fault class",
 )
+# Multi-tier checkpoint goodput (k8s_tpu/ckpt, docs/CHECKPOINT.md).
+# Registered here so any /metrics endpoint — operator health port or a
+# trainer-side server — exposes them without new plumbing.
+CKPT_RESTORES = REGISTRY.counter(
+    "ktpu_ckpt_restores_total",
+    "Checkpoint restores, by source tier (local / local+peer / persistent)",
+)
+CKPT_LOST_STEPS = REGISTRY.counter(
+    "ktpu_ckpt_lost_steps_total",
+    "Train steps lost to restarts (progress past the restored step)",
+)
+CKPT_LOST_STEPS_PER_RESTART = REGISTRY.gauge(
+    "ktpu_ckpt_lost_steps_per_restart",
+    "Mean steps lost per restart since process start",
+)
+CKPT_LOCAL_SAVES = REGISTRY.counter(
+    "ktpu_ckpt_local_saves_total",
+    "Local-tier snapshot commits",
+)
+CKPT_OVERHEAD_FRACTION = REGISTRY.gauge(
+    "ktpu_ckpt_overhead_fraction",
+    "Fraction of loop wall-clock spent in checkpoint saves",
+)

@@ -55,6 +55,16 @@ class Rendezvous:
         self.program = env.get("KTPU_PROGRAM", "")
         self.program_args = env.get("KTPU_PROGRAM_ARGS", "")
         self.init_timeout = float(env.get("KTPU_INIT_TIMEOUT", "300"))
+        # multi-tier checkpoint contract (spec.checkpointPolicy →
+        # operator env; consumed by k8s_tpu.ckpt via programs.common —
+        # parsed here so the contract is visible at the launch boundary)
+        self.ckpt_local_dir = env.get("KTPU_CKPT_LOCAL_DIR", "")
+        self.ckpt_persistent_dir = env.get("KTPU_CKPT_DIR", "")
+        self.ckpt_peers = env.get("KTPU_CKPT_PEERS", "")
+        try:
+            self.ckpt_peer_port = int(env.get("KTPU_CKPT_PEER_PORT", "0"))
+        except ValueError:
+            self.ckpt_peer_port = 0
 
     @property
     def is_distributed(self):

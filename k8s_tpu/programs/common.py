@@ -82,7 +82,75 @@ def parse_run_config(rdzv, defaults: Optional[dict] = None) -> RunConfig:
     cfg.extra = extra
     if os.environ.get("KTPU_STEPS"):
         cfg.steps = int(os.environ["KTPU_STEPS"])
+    # spec.checkpointPolicy env (operator-injected) backs the program
+    # args: explicit --checkpoint_dir/--checkpoint_every win, the
+    # policy's persistent tier fills the gaps — so a job spec alone can
+    # turn on checkpointing without touching KTPU_PROGRAM_ARGS
+    if not cfg.checkpoint_dir and os.environ.get("KTPU_CKPT_DIR"):
+        cfg.checkpoint_dir = os.environ["KTPU_CKPT_DIR"]
+        if not cfg.checkpoint_every:
+            try:
+                cfg.checkpoint_every = int(
+                    os.environ.get("KTPU_CKPT_PERSIST_EVERY", "0") or 0)
+            except ValueError:
+                pass
     return cfg
+
+
+def build_checkpoint_manager(cfg: RunConfig, rdzv):
+    """The one checkpoint-construction path every training program
+    shares: a :class:`k8s_tpu.ckpt.MultiTierCheckpointManager` when the
+    job's checkpointPolicy enables the local tier (KTPU_CKPT_LOCAL_DIR),
+    else the plain persistent orbax manager, else None.
+
+    Host identity is the SPMD process id (one launcher process per
+    host); the control replica (process_id < 0) never checkpoints.
+    When ``KTPU_CKPT_PEER_PORT`` is set the host also serves its local
+    tier on the REST shard wire (returned as ``(mgr, server)`` —
+    callers that don't start the wire get ``server=None``).
+    """
+    if getattr(rdzv, "process_id", 0) < 0:
+        return None, None
+    host_id = max(0, getattr(rdzv, "process_id", 0))
+    if os.environ.get("KTPU_CKPT_LOCAL_DIR"):
+        from k8s_tpu.ckpt import MultiTierCheckpointManager, PeerShardServer
+        from k8s_tpu.ckpt.manager import CheckpointPolicy
+
+        policy = CheckpointPolicy.from_env()
+        env_dir = os.environ.get("KTPU_CKPT_DIR", "")
+        if cfg.checkpoint_dir and cfg.checkpoint_dir != env_dir:
+            # an EXPLICIT --checkpoint_dir (it differs from the policy
+            # env, so it can't be parse_run_config's own fallback)
+            # overrides the spec's persistent tier — program args win
+            policy.persistent_dir = cfg.checkpoint_dir
+            policy.persistent_interval_steps = (
+                cfg.checkpoint_every or policy.persistent_interval_steps)
+        elif not policy.persistent_dir and cfg.checkpoint_dir:
+            policy.persistent_dir = cfg.checkpoint_dir
+            policy.persistent_interval_steps = cfg.checkpoint_every
+        mgr = MultiTierCheckpointManager(
+            policy, host_id=host_id,
+            # multi-process: candidate local steps must be fully covered
+            # by the union of visible manifests so every host restores
+            # the SAME step without communicating (planner docstring)
+            gang_consistent=getattr(rdzv, "num_processes", 1) > 1,
+        )
+        server = None
+        try:
+            peer_port = int(os.environ.get("KTPU_CKPT_PEER_PORT", "0") or 0)
+        except ValueError:
+            peer_port = 0
+        if peer_port and mgr.local is not None:
+            server = PeerShardServer(mgr.local, port=peer_port).start()
+            print(json.dumps({"event": "ckpt_peer_server",
+                              "host": host_id, "port": server.port}),
+                  flush=True)
+        return mgr, server
+    if cfg.checkpoint_dir:
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager(cfg.checkpoint_dir), None
+    return None, None
 
 
 class maybe_profile:

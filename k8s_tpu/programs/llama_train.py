@@ -26,6 +26,7 @@ from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
 from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import (
     MetricLogger,
+    build_checkpoint_manager,
     mark_preempt_aware,
     maybe_preempt_exit,
     parse_run_config,
@@ -129,16 +130,19 @@ def main(rdzv) -> None:
         jax.random.PRNGKey(0), jnp.asarray(next(data)["input_ids"]),
     )
 
-    mgr = None
-    if cfg.checkpoint_dir:
-        from k8s_tpu.train.checkpoint import CheckpointManager
-
-        mgr = CheckpointManager(cfg.checkpoint_dir)
+    # multi-tier when the job's checkpointPolicy enables the local tier
+    # (KTPU_CKPT_LOCAL_DIR), plain persistent orbax otherwise — one
+    # construction path for every training program (docs/CHECKPOINT.md)
+    mgr, peer_server = build_checkpoint_manager(cfg, rdzv)
+    multi_tier = hasattr(mgr, "note_step")
+    if mgr is not None:
         restored = mgr.restore(state)
         if restored is not None:
             state = restored
             # machine-readable resume marker: the gang-restart e2e
-            # asserts training continued PAST the checkpoint
+            # asserts training continued PAST the checkpoint (the
+            # multi-tier manager additionally printed ckpt_restore with
+            # its source tier + lost-steps accounting)
             print(json.dumps({"event": "restored",
                               "step": int(state.step)}), flush=True)
 
@@ -232,7 +236,13 @@ def main(rdzv) -> None:
         if step % cfg.log_every == 0 or step == cfg.steps:
             logger.log(step, {"loss": float(final_loss)})
         maybe_preempt_exit(mgr, rdzv, step, state)
-        if mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+        if multi_tier:
+            # the manager routes: local tier every localIntervalSteps
+            # (cheap device→host + node-local write), persistent tier
+            # every persistentIntervalSteps
+            mgr.save(step, state)
+            mgr.note_step(step)
+        elif mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
             mgr.save(step, state)
     if first_loss is not None:
         first_loss = float(first_loss)
@@ -240,7 +250,14 @@ def main(rdzv) -> None:
     if mgr is not None:
         mgr.save(cfg.steps, state, force=True)
         mgr.wait()
+        if multi_tier and rdzv.process_id <= 0:
+            # goodput report: restore sources, lost-steps-per-restart,
+            # checkpoint overhead fraction (docs/CHECKPOINT.md)
+            print(json.dumps({"event": "ckpt_goodput", **mgr.goodput()}),
+                  flush=True)
         mgr.close()
+    if peer_server is not None:
+        peer_server.stop()
     # --require_convergence=R: the job FAILS (permanent — a learning
     # bug is deterministic, retrying wastes the gang-restart budget)
     # unless final_loss < R * first_loss. With --data=learnable this

@@ -21,6 +21,13 @@ slow-handler        injected API latency inside event handling → the
 checkpoint-save     CheckpointManager.save retry_call via the fault hook
 lease-loss          stolen leader lease → renew CAS conflict → concede →
                     re-acquire after expiry
+ckpt-partial-commit local-tier commit dies between write phase and
+                    marker → restore planner must skip the uncommitted
+                    step (k8s_tpu/ckpt two-phase commit)
+ckpt-corruption     bytes flipped in a committed local shard → crc
+                    detection → peer / persistent-tier fallback
+ckpt-peer-loss      one host's whole local dir deleted (replaced pod)
+                    → peer-shard restore for the new pod
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -352,6 +359,76 @@ class CheckpointSaveFault(FaultInjector):
         return f"{n} saves"
 
 
+class LocalCommitFault(FaultInjector):
+    """Arm partial local-tier commits: the next save(s) die AFTER the
+    write phase (pending dir on disk) but BEFORE the rename + COMMIT
+    marker — a host crash in the middle of the two-phase protocol. The
+    restore planner must treat the step as nonexistent."""
+
+    name = "ckpt-partial-commit"
+
+    def __init__(self, rate: float = 1.0, seed: Optional[int] = None,
+                 burst: int = 1):
+        super().__init__(rate, seed)
+        self.burst = burst
+
+    def fire(self) -> str:
+        from k8s_tpu.ckpt import local as ckpt_local
+
+        n = 1 + self.rng.randrange(self.burst)
+        ckpt_local.arm_partial_commit(n)
+        self.injected += 1
+        log.info("chaos[%s]: armed %d partial local commits", self.name, n)
+        return f"{n} commits"
+
+
+class LocalCorruptionFault(FaultInjector):
+    """Flip bytes in one committed local shard file — disk rot the
+    COMMIT marker can't catch; the planner's crc check must route the
+    shard to a peer or the persistent tier."""
+
+    name = "ckpt-corruption"
+
+    def __init__(self, ckpt_root: str, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.ckpt_root = ckpt_root
+
+    def fire(self) -> Optional[str]:
+        from k8s_tpu.ckpt.local import LocalTier
+
+        victim = LocalTier.corrupt_one_shard(self.ckpt_root, self.rng)
+        if victim is None:
+            return None  # nothing committed yet
+        self.injected += 1
+        log.info("chaos[%s]: corrupted %s", self.name, victim)
+        return victim
+
+
+class RestorePeerLossFault(FaultInjector):
+    """Delete one host's entire local dir — the replaced-pod /
+    lost-node case peer-shard restore exists for. Always leaves at
+    least one host's tier standing (losing EVERY local disk at once is
+    the persistent-tier-only scenario, covered separately)."""
+
+    name = "ckpt-peer-loss"
+
+    def __init__(self, ckpt_root: str, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.ckpt_root = ckpt_root
+
+    def fire(self) -> Optional[str]:
+        from k8s_tpu.ckpt.local import LocalTier
+
+        dropped = LocalTier.drop_host(self.ckpt_root, self.rng)
+        if dropped is None:
+            return None  # not enough hosts to drop one safely
+        self.injected += 1
+        log.info("chaos[%s]: dropped host-%d local tier", self.name, dropped)
+        return f"host-{dropped}"
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -439,6 +516,7 @@ class ChaosMonkey:
         interval: float = 30.0,
         faulty: Optional[FaultyCluster] = None,
         lease_namespace: str = "default",
+        ckpt_root: Optional[str] = None,
     ) -> "ChaosMonkey":
         """``--chaos-level`` profiles. Levels are cumulative:
 
@@ -446,7 +524,10 @@ class ChaosMonkey:
         - 1: aggressive pod kills (every tick)
         - 2: + apiserver flakes, watch drops, slow handlers (needs the
           FaultyCluster wrapper; silently narrower without one)
-        - 3+: + checkpoint-save failures, leader-lease loss
+        - 3+: + checkpoint-save failures, leader-lease loss, and — when
+          ``ckpt_root`` names a multi-tier local checkpoint root —
+          partial local commits, local shard corruption, and whole-host
+          local-tier loss (the k8s_tpu/ckpt recovery matrix)
         """
         rng = random.Random(seed)
 
@@ -466,6 +547,12 @@ class ChaosMonkey:
             inj.append(CheckpointSaveFault(rate=0.5, seed=s(), burst=2))
             inj.append(LeaseLossFault(
                 client.cluster, namespace=lease_namespace, rate=0.2, seed=s()))
+            if ckpt_root:
+                inj += [
+                    LocalCommitFault(rate=0.3, seed=s(), burst=1),
+                    LocalCorruptionFault(ckpt_root, rate=0.3, seed=s()),
+                    RestorePeerLossFault(ckpt_root, rate=0.15, seed=s()),
+                ]
         return cls(client, level=level, interval=interval, seed=s(),
                    injectors=inj)
 

@@ -22,6 +22,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     GKE_TPU_ACCEL_LABEL,
     GKE_TPU_TOPO_LABEL,
     VALID_REPLICA_TYPES,
+    CheckpointPolicySpec,
     ChiefSpec,
     ReplicaState,
     ReplicaStatus,

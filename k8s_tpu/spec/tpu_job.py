@@ -192,6 +192,73 @@ class RestartBackoffSpec(K8sObject):
 
 @register_type
 @dataclass
+class CheckpointPolicySpec(K8sObject):
+    """Multi-tier checkpoint policy (docs/CHECKPOINT.md).
+
+    ``localDir`` names a node-local path (emptyDir / local SSD) for the
+    cheap frequent tier — per-host sharded snapshots every
+    ``localIntervalSteps`` with a two-phase commit marker.
+    ``persistentDir`` is the durable orbax store, demoted to every
+    ``persistentIntervalSteps``. ``peerFetch`` lets a replaced pod pull
+    its missing local shards from a data-parallel peer before falling
+    back to the persistent tier; ``peerPort`` > 0 additionally serves
+    each host's local tier over the REST shard wire on that port (0 =
+    shared-filesystem peers only). The whole block flows operator →
+    kubelet env (``KTPU_CKPT_*``) → launcher → training program."""
+
+    local_dir: str = ""
+    local_interval_steps: int = 0
+    local_max_to_keep: int = 2
+    persistent_dir: str = ""
+    persistent_interval_steps: int = 0
+    peer_fetch: bool = True
+    peer_port: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.local_interval_steps < 0 or self.persistent_interval_steps < 0:
+            raise ValidationError(
+                "checkpointPolicy: interval steps must be >= 0")
+        if self.local_dir and self.local_interval_steps == 0:
+            raise ValidationError(
+                "checkpointPolicy: localDir set but localIntervalSteps is 0 "
+                "(the local tier would never write)")
+        if self.local_interval_steps > 0 and not self.local_dir:
+            raise ValidationError(
+                "checkpointPolicy: localIntervalSteps set without localDir")
+        if self.local_max_to_keep < 1:
+            raise ValidationError(
+                "checkpointPolicy: localMaxToKeep must be >= 1")
+        if self.peer_port < 0 or self.peer_port > 65535:
+            raise ValidationError("checkpointPolicy: peerPort out of range")
+        if (
+            self.persistent_interval_steps > 0
+            and self.local_interval_steps > self.persistent_interval_steps
+        ):
+            raise ValidationError(
+                "checkpointPolicy: localIntervalSteps must not exceed "
+                "persistentIntervalSteps (the local tier is the FREQUENT one)")
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher/program contract (consumed by
+        ``k8s_tpu.ckpt.manager.CheckpointPolicy.from_env``)."""
+        env: Dict[str, str] = {}
+        if self.local_dir:
+            env["KTPU_CKPT_LOCAL_DIR"] = self.local_dir
+            env["KTPU_CKPT_LOCAL_EVERY"] = str(self.local_interval_steps)
+            env["KTPU_CKPT_LOCAL_KEEP"] = str(self.local_max_to_keep)
+        if self.persistent_dir:
+            env["KTPU_CKPT_DIR"] = self.persistent_dir
+            env["KTPU_CKPT_PERSIST_EVERY"] = str(
+                self.persistent_interval_steps)
+        env["KTPU_CKPT_PEER_FETCH"] = "1" if self.peer_fetch else "0"
+        if self.peer_port:
+            env["KTPU_CKPT_PEER_PORT"] = str(self.peer_port)
+        return env
+
+
+@register_type
+@dataclass
 class TpuJobSpec(K8sObject):
     runtime_id: str = field(default="", metadata={"json": "RuntimeId"})
     tensorboard: Optional[TensorBoardSpec] = None
@@ -209,6 +276,10 @@ class TpuJobSpec(K8sObject):
     # crash-looping image burns the whole budget in seconds (restart
     # storm). None → defaulted in set_defaults().
     restart_backoff: Optional[RestartBackoffSpec] = None
+    # Multi-tier checkpointing (docs/CHECKPOINT.md): local emergency
+    # snapshots + demoted durable saves + peer-shard restore. None →
+    # the job checkpoints however its program args say (or not at all).
+    checkpoint_policy: Optional[CheckpointPolicySpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -255,6 +326,8 @@ class TpuJobSpec(K8sObject):
             raise ValidationError("maxGangRestarts must be >= 0")
         if self.restart_backoff is not None:
             self.restart_backoff.validate()
+        if self.checkpoint_policy is not None:
+            self.checkpoint_policy.validate()
         if self.tpu is not None and self.tpu.accelerator:
             t = self.tpu.topology()
             if t is None:

@@ -93,6 +93,9 @@ class RendezvousSpec:
     cluster: Optional[Dict[str, List[str]]] = None  # full name map (debug/prober)
     tb_log_dir: str = ""  # TpuJob tensorboard.logDir: programs write
     # TB scalar events there (the deployment the operator ships reads it)
+    # KTPU_CKPT_* from spec.checkpointPolicy (+ KTPU_CKPT_PEERS: per-
+    # index peer shard endpoints) — the multi-tier checkpoint contract
+    checkpoint_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -113,6 +116,8 @@ class RendezvousSpec:
             env["MEGASCALE_COORDINATOR_ADDRESS"] = self.coordinator_address
         if self.tb_log_dir:
             env["KTPU_TB_LOGDIR"] = self.tb_log_dir
+        if self.checkpoint_env:
+            env.update(self.checkpoint_env)
         return env
 
 
@@ -374,7 +379,24 @@ class TpuReplicaSet:
                 self.job.job.spec.tensorboard.log_dir
                 if self.job.job.spec.tensorboard is not None else ""
             ),
+            checkpoint_env=self._checkpoint_env(workers),
         )
+
+    def _checkpoint_env(self, workers) -> Optional[Dict[str, str]]:
+        """spec.checkpointPolicy → KTPU_CKPT_* (+ per-index peer shard
+        endpoints when the REST wire is enabled: the per-index Service
+        names the operator already maintains give every host a stable
+        DNS address for its peers' local tiers)."""
+        policy = self.job.job.spec.checkpoint_policy
+        if policy is None:
+            return None
+        env = policy.to_env()
+        if policy.peer_port and self.spec.replica_type == WORKER:
+            env["KTPU_CKPT_PEERS"] = ",".join(
+                f"{i}=http://{w.rsplit(':', 1)[0]}:{policy.peer_port}"
+                for i, w in enumerate(workers)
+            )
+        return env
 
     # ------------------------------------------------------------- delete
 

@@ -529,6 +529,23 @@ class TestChaosMonkeyProfiles:
         ]
         ckpt_mod.arm_save_faults(0)  # in case a tick armed it
 
+    def test_level_3_with_ckpt_root_adds_local_tier_faults(self, tmp_path):
+        """A configured multi-tier local root arms the three local-tier
+        fault kinds on top of the level-3 matrix (docs/CHECKPOINT.md)."""
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty,
+                                   ckpt_root=str(tmp_path))
+        assert self._names(m) == [
+            "api-flake", "checkpoint-save", "ckpt-corruption",
+            "ckpt-partial-commit", "ckpt-peer-loss", "lease-loss",
+            "pod-kill", "slow-handler", "watch-drop",
+        ]
+        from k8s_tpu.ckpt import local as ckpt_local
+
+        ckpt_local.arm_partial_commit(0)
+        ckpt_mod.arm_save_faults(0)
+
     def test_tick_is_exception_safe_and_counts(self):
         class Broken(FaultInjector):
             name = "broken"

@@ -1,0 +1,772 @@
+"""Tier-1 tests for the multi-tier checkpoint subsystem (k8s_tpu/ckpt,
+docs/CHECKPOINT.md): commit-marker protocol, restore-planner tier
+selection, the peer-fetch unit path (filesystem AND the REST shard
+wire), goodput accounting, the checkpointPolicy spec→env flow, and the
+``reached_preemption`` SIGTERM/launcher-flag fallback (ISSUE 4
+satellite). All fast — the always-on ``ckpt-tiers`` CI stage runs this
+file; the slow chaos extension lives in test_chaos_soak.py.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_tpu.ckpt import (
+    FilesystemPeerTransport,
+    LocalTier,
+    MultiTierCheckpointManager,
+    PeerShardServer,
+    RestPeerTransport,
+    RestorePlanner,
+    SOURCE_LOCAL,
+    SOURCE_LOCAL_PEER,
+    SOURCE_NONE,
+    SOURCE_PERSISTENT,
+    arm_partial_commit,
+)
+from k8s_tpu.ckpt.manager import CheckpointPolicy
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    arm_partial_commit(0)
+
+
+def small_mesh():
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    return Mesh(devs, ("data", "fsdp"))
+
+
+def make_tree(mesh, scale=1.0):
+    w = jax.device_put(
+        (jnp.arange(16, dtype=jnp.float32) * scale).reshape(8, 2),
+        NamedSharding(mesh, P("fsdp", None)))
+    b = jax.device_put(
+        jnp.full((4,), 2.0 * scale, jnp.float32),
+        NamedSharding(mesh, P()))
+    return {"w": w, "b": b}
+
+
+def template_of(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=a.sharding), tree)
+
+
+def assert_tree_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# commit-marker protocol
+# ---------------------------------------------------------------------------
+
+
+class TestCommitProtocol:
+    def test_two_phase_commit_marker(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tree = make_tree(mesh)
+        assert tier.save(4, tree) is True
+        assert tier.committed_steps() == [4]
+        sdir = tier.step_dir(4)
+        assert os.path.exists(os.path.join(sdir, "COMMIT"))
+        assert os.path.exists(os.path.join(sdir, "manifest.json"))
+        # re-save of a committed step is a no-op
+        assert tier.save(4, tree) is False
+
+    def test_partial_commit_invisible(self, tmp_path):
+        """A crash between write phase and marker (armed fault) leaves a
+        pending dir that committed_steps/manifest NEVER report."""
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(2, make_tree(mesh))
+        arm_partial_commit(1)
+        with pytest.raises(OSError):
+            tier.save(4, make_tree(mesh, scale=2.0))
+        assert tier.committed_steps() == [2]
+        assert tier.manifest(4) is None
+        assert os.path.isdir(tier.step_dir(4) + ".pending")
+        # a later successful save still works and GCs the stale pending
+        tier.save(6, make_tree(mesh, scale=3.0))
+        assert tier.committed_steps() == [2, 6]
+        assert not os.path.isdir(tier.step_dir(4) + ".pending")
+
+    def test_async_double_buffer(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0)  # async
+        tier.save(1, make_tree(mesh))
+        tier.save(2, make_tree(mesh, scale=2.0))  # drains save(1) first
+        tier.wait()
+        assert tier.committed_steps() == [1, 2]
+
+    def test_async_error_surfaces_once(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0)
+        arm_partial_commit(1)
+        tier.save(2, make_tree(mesh))
+        with pytest.raises(OSError):
+            tier.wait()
+        tier.wait()  # error not raised twice
+
+    def test_retention(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, max_to_keep=2, sync=True)
+        for s in (2, 4, 6):
+            tier.save(s, make_tree(mesh, scale=s))
+        assert tier.committed_steps() == [4, 6]
+
+    def test_crc_detects_corruption(self, tmp_path):
+        import random
+
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(2, make_tree(mesh))
+        victim = LocalTier.corrupt_one_shard(str(tmp_path),
+                                             random.Random(0))
+        assert victim is not None
+        # the corrupted shard reads as None; intact ones still load
+        man = tier.manifest(2)
+        missing = 0
+        for path, entry in man["leaves"].items():
+            for key in entry["shards"]:
+                if tier.read_shard(2, path, key) is None:
+                    missing += 1
+        assert missing == 1
+
+    def test_barrier_called_before_commit(self, tmp_path):
+        mesh = small_mesh()
+        calls = []
+
+        def barrier(step):
+            # at barrier time the step must NOT be committed yet
+            calls.append((step, LocalTier(str(tmp_path),
+                                          host_id=0).committed_steps()))
+
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True,
+                         barrier=barrier)
+        tier.save(3, make_tree(mesh))
+        assert calls == [(3, [])]
+        assert tier.committed_steps() == [3]
+
+
+# ---------------------------------------------------------------------------
+# restore-planner tier selection
+# ---------------------------------------------------------------------------
+
+
+class TestRestorePlanner:
+    class FakePersistent:
+        """Stub of train.checkpoint.CheckpointManager's restore surface."""
+
+        def __init__(self, step, tree):
+            self._step = step
+            self._tree = tree
+
+        def latest_step(self):
+            return self._step
+
+        def restore(self, template, step=None):
+            if self._step is None:
+                return None
+            return self._tree
+
+    def test_local_newer_wins(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        old = make_tree(mesh)
+        new = make_tree(mesh, scale=5.0)
+        tier.save(10, new)
+        persistent = self.FakePersistent(6, old)
+        planner = RestorePlanner(tier, persistent)
+        restored, plan = planner.restore(template_of(new))
+        assert plan.source == SOURCE_LOCAL and plan.step == 10
+        assert_tree_equal(restored, new)
+
+    def test_persistent_newer_wins(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        old = make_tree(mesh)
+        tier.save(4, old)
+        newer = make_tree(mesh, scale=7.0)
+        planner = RestorePlanner(tier, self.FakePersistent(8, newer))
+        restored, plan = planner.restore(template_of(old))
+        assert plan.source == SOURCE_PERSISTENT and plan.step == 8
+        assert_tree_equal(restored, newer)
+
+    def test_nothing_anywhere_is_fresh_start(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        planner = RestorePlanner(tier, self.FakePersistent(None, None))
+        restored, plan = planner.restore(template_of(make_tree(mesh)))
+        assert restored is None and plan.source == SOURCE_NONE
+
+    def test_uncommitted_step_skipped(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tree6 = make_tree(mesh, scale=6.0)
+        tier.save(6, tree6)
+        arm_partial_commit(1)
+        with pytest.raises(OSError):
+            tier.save(8, make_tree(mesh, scale=8.0))
+        planner = RestorePlanner(tier, self.FakePersistent(None, None))
+        restored, plan = planner.restore(template_of(tree6))
+        assert plan.step == 6 and plan.source == SOURCE_LOCAL
+        assert_tree_equal(restored, tree6)
+
+    def test_replaced_pod_restores_from_peer(self, tmp_path):
+        """A host with an EMPTY local dir sources every shard from its
+        data-parallel peer's tier over the filesystem transport."""
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=3.0)
+        donor = LocalTier(str(tmp_path), host_id=1, sync=True)
+        donor.save(12, tree)
+        fresh = LocalTier(str(tmp_path), host_id=0, sync=True)
+        planner = RestorePlanner(
+            fresh, self.FakePersistent(None, None),
+            transport=FilesystemPeerTransport(str(tmp_path), self_host=0))
+        restored, plan = planner.restore(template_of(tree))
+        assert plan.source == SOURCE_LOCAL_PEER and plan.step == 12
+        assert plan.peer_fetches > 0
+        assert_tree_equal(restored, tree)
+
+    def test_corrupt_own_shard_resourced_from_peer(self, tmp_path):
+        """crc failure at read time reroutes the one bad shard to a
+        peer holding the same global index — not a full fallback."""
+        import random
+
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=4.0)
+        own = LocalTier(str(tmp_path), host_id=0, sync=True)
+        own.save(6, tree)
+        peer = LocalTier(str(tmp_path), host_id=1, sync=True)
+        peer.save(6, tree)
+        # corrupt one of host-0's shards specifically
+        rng = random.Random(1)
+        for _ in range(50):
+            victim = LocalTier.corrupt_one_shard(str(tmp_path), rng)
+            if victim and f"host-0{os.sep}" in victim:
+                break
+        planner = RestorePlanner(
+            own, self.FakePersistent(None, None),
+            transport=FilesystemPeerTransport(str(tmp_path), self_host=0))
+        restored, plan = planner.restore(template_of(tree))
+        assert restored is not None, "peer reroute failed"
+        assert_tree_equal(restored, tree)
+
+    def test_gang_consistent_prevents_divergent_steps(self, tmp_path):
+        """Multi-process mode: a step only SOME hosts could restore
+        must be rejected for ALL of them. Leaf sharded over the host
+        boundary with no replica (P('data', None), hosts = data rows):
+        host 1 crashed before committing step 6, so its rows exist
+        nowhere — naive per-host planning diverges (host 0 picks 6,
+        host 1 picks 4); the full-coverage gang rule lands both on 4."""
+        mesh = small_mesh()
+        devs = mesh.devices
+        host_devs = {0: set(devs[0, :].flat), 1: set(devs[1, :].flat)}
+        x = jax.device_put(
+            jnp.arange(8, dtype=jnp.float32).reshape(4, 2),
+            NamedSharding(mesh, P("data", None)))
+        tree = {"x": x}
+        tiers = {
+            h: LocalTier(str(tmp_path), host_id=h, sync=True, devices=d)
+            for h, d in host_devs.items()
+        }
+        tiers[0].save(4, tree)
+        tiers[1].save(4, tree)
+        tiers[0].save(6, tree)  # host 1 crashed before step 6
+
+        def planner(h, gang):
+            return RestorePlanner(
+                tiers[h], None,
+                transport=FilesystemPeerTransport(str(tmp_path),
+                                                  self_host=h),
+                devices=host_devs[h], gang_consistent=gang)
+
+        # naive per-host planning: divergence (the bug the rule closes)
+        assert planner(0, gang=False).plan(template_of(tree)).step == 6
+        assert planner(1, gang=False).plan(template_of(tree)).step == 4
+        # gang rule: both hosts deterministically agree on 4
+        for h in (0, 1):
+            p = planner(h, gang=True).plan(template_of(tree))
+            assert p.step == 4, (h, p)
+
+        # ...but a fully-covered step IS accepted gang-wide: a
+        # data-replicated layout (make_tree: fsdp-sharded, data rows
+        # replicate) committed by host 0 alone still covers every index,
+        # so host 1 restores it from its peer
+        rep = make_tree(mesh, scale=2.0)
+        tiers[0].save(8, rep)
+        p1 = planner(1, gang=True).plan(template_of(rep))
+        assert p1.step == 8 and p1.peer_shards, p1
+
+    def test_consensus_can_lower_the_step(self, tmp_path):
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tree8 = make_tree(mesh, scale=8.0)
+        tier.save(8, tree8)
+        tier.save(10, make_tree(mesh, scale=10.0))
+        planner = RestorePlanner(
+            tier, self.FakePersistent(None, None),
+            consensus=lambda step: min(step, 8))
+        restored, plan = planner.restore(template_of(tree8))
+        assert plan.step == 8
+        assert_tree_equal(restored, tree8)
+
+
+# ---------------------------------------------------------------------------
+# peer fetch over the REST wire
+# ---------------------------------------------------------------------------
+
+
+class TestRestPeerWire:
+    def test_steps_manifest_and_shard_roundtrip(self, tmp_path):
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=2.5)
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(4, tree)
+        tier.note_progress(5)
+        srv = PeerShardServer(tier, port=0).start()
+        try:
+            t = RestPeerTransport({0: srv.url}, self_host=1)
+            assert t.steps() == {0: [4]}
+            assert t.progress() == 5
+            man = t.manifest(4, 0)
+            assert man["step"] == 4 and "w" in man["leaves"]
+            key = next(iter(man["leaves"]["w"]["shards"]))
+            arr = t.fetch(4, "w", key, 0)
+            assert arr is not None and arr.dtype == np.float32
+            # misses are honest Nones, not exceptions
+            assert t.manifest(99, 0) is None
+            assert t.fetch(4, "w", "9:9", 0) is None
+            # metav1.Status-shaped 404 body on the raw wire
+            try:
+                urllib.request.urlopen(srv.url + "/v1/ckpt/manifest/99")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+                assert body["kind"] == "Status" and body["code"] == 404
+        finally:
+            srv.stop()
+
+    def test_dead_peer_is_a_miss_not_an_error(self):
+        t = RestPeerTransport({0: "http://127.0.0.1:1"}, self_host=1,
+                              timeout=0.5)
+        assert t.steps() == {}
+        assert t.fetch(1, "w", "0:1", 0) is None
+
+    def test_env_value_parsing(self):
+        t = RestPeerTransport.from_env_value(
+            "0=http://a:9,1=http://b:9,junk", self_host=1)
+        assert t.peers() == [0]  # self excluded, junk dropped
+
+    def test_full_restore_over_rest(self, tmp_path):
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=9.0)
+        donor = LocalTier(str(tmp_path / "donor"), host_id=0, sync=True)
+        donor.save(7, tree)
+        srv = PeerShardServer(donor, port=0).start()
+        try:
+            fresh = LocalTier(str(tmp_path / "fresh"), host_id=1, sync=True)
+            planner = RestorePlanner(
+                fresh, None,
+                transport=RestPeerTransport({0: srv.url}, self_host=1))
+            restored, plan = planner.restore(template_of(tree))
+            assert plan.source == SOURCE_LOCAL_PEER and plan.step == 7
+            assert_tree_equal(restored, tree)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-tier manager + goodput
+# ---------------------------------------------------------------------------
+
+
+class TestMultiTierManager:
+    def test_interval_routing_and_goodput(self, tmp_path):
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path / "local"), local_interval_steps=2,
+        )
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        for s in range(1, 7):
+            tree = make_tree(mesh, scale=float(s))
+            mgr.save(s, tree)
+            mgr.note_step(s)
+        assert mgr.local.committed_steps() == [4, 6]  # keep=2 of 2,4,6
+        g = mgr.goodput()
+        assert g["local_saves"] == 3
+        assert 0.0 <= g["ckpt_overhead_fraction"] <= 1.0
+        # restore picks the newest local step and accounts lost steps
+        # (progress marker says step 6 completed; restored step 6 → 0)
+        restored = mgr.restore(template_of(tree))
+        assert restored is not None
+        g = mgr.goodput()
+        assert g["restores"] == 1
+        assert g["restore_sources"] == {SOURCE_LOCAL: 1}
+        assert g["lost_steps_last"] == 0
+        mgr.close()
+
+    def test_local_save_failure_is_degraded_not_fatal(self, tmp_path):
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        arm_partial_commit(1)
+        mgr.save(1, make_tree(mesh))  # must NOT raise
+        assert mgr.goodput()["local_save_failures"] == 1
+        mgr.save(2, make_tree(mesh, scale=2.0))
+        assert mgr.local.committed_steps() == [2]
+        mgr.close()
+
+    def test_lost_steps_accounting_from_progress(self, tmp_path):
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=2)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        for s in range(1, 8):  # progress 7, last committed local 6
+            mgr.save(s, make_tree(mesh, scale=float(s)))
+            mgr.note_step(s)
+        mgr2 = MultiTierCheckpointManager(policy, host_id=0)
+        restored = mgr2.restore(template_of(make_tree(mesh)))
+        assert restored is not None
+        g = mgr2.goodput()
+        assert g["lost_steps_last"] == 1  # 7 - 6
+        assert g["lost_steps_per_restart"] == 1.0
+        mgr.close()
+        mgr2.close()
+
+    def test_local_only_policy_preemption_falls_back_to_flag(
+            self, tmp_path, monkeypatch):
+        """A local-only policy has no orbax consensus poll; the manager
+        must still honor the launcher's SIGTERM flag (a local flush is
+        collective-free, so per-host flushing is safe) — otherwise
+        maintenance events silently stop flushing for local-only jobs."""
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=2)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        monkeypatch.delenv("KTPU_PREEMPT_REQUESTED", raising=False)
+        assert mgr.reached_preemption(3) is False
+        monkeypatch.setenv("KTPU_PREEMPT_REQUESTED", "1")
+        assert mgr.reached_preemption(4) is True
+        mgr.close()
+
+    def test_from_env_and_policy_roundtrip(self, tmp_path, monkeypatch):
+        from k8s_tpu.spec import CheckpointPolicySpec
+
+        spec = CheckpointPolicySpec(
+            local_dir=str(tmp_path / "l"), local_interval_steps=3,
+            local_max_to_keep=4, persistent_dir=str(tmp_path / "p"),
+            persistent_interval_steps=30, peer_fetch=False, peer_port=7777,
+        )
+        spec.validate()
+        env = spec.to_env()
+        policy = CheckpointPolicy.from_env(env)
+        assert policy.local_dir == str(tmp_path / "l")
+        assert policy.local_interval_steps == 3
+        assert policy.local_max_to_keep == 4
+        assert policy.persistent_dir == str(tmp_path / "p")
+        assert policy.persistent_interval_steps == 30
+        assert policy.peer_fetch is False
+        assert env["KTPU_CKPT_PEER_PORT"] == "7777"
+
+    def test_explicit_checkpoint_dir_overrides_policy_env(
+            self, tmp_path, monkeypatch):
+        """Program args win over the spec's persistent tier: an explicit
+        --checkpoint_dir (≠ the operator-injected KTPU_CKPT_DIR) must be
+        the persistent dir the manager actually uses."""
+        from k8s_tpu.programs.common import RunConfig, build_checkpoint_manager
+
+        monkeypatch.setenv("KTPU_CKPT_LOCAL_DIR", str(tmp_path / "l"))
+        monkeypatch.setenv("KTPU_CKPT_LOCAL_EVERY", "2")
+        monkeypatch.setenv("KTPU_CKPT_DIR", str(tmp_path / "spec-dir"))
+        monkeypatch.setenv("KTPU_CKPT_PERSIST_EVERY", "50")
+
+        class Rdzv:
+            process_id = 0
+            num_processes = 1
+
+        # explicit arg differs from the env → it wins
+        cfg = RunConfig(checkpoint_dir=str(tmp_path / "override"),
+                        checkpoint_every=7)
+        mgr, server = build_checkpoint_manager(cfg, Rdzv())
+        assert server is None
+        assert mgr.policy.persistent_dir == str(tmp_path / "override")
+        assert mgr.policy.persistent_interval_steps == 7
+        mgr.close()
+        # no explicit arg: parse_run_config's fallback equals the env →
+        # the spec's tier (and ITS interval) is used
+        cfg2 = RunConfig(checkpoint_dir=str(tmp_path / "spec-dir"),
+                         checkpoint_every=50)
+        mgr2, _ = build_checkpoint_manager(cfg2, Rdzv())
+        assert mgr2.policy.persistent_dir == str(tmp_path / "spec-dir")
+        assert mgr2.policy.persistent_interval_steps == 50
+        mgr2.close()
+
+    def test_policy_spec_validation(self):
+        from k8s_tpu.spec import CheckpointPolicySpec, ValidationError
+
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(local_dir="/x").validate()  # interval 0
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(local_interval_steps=2).validate()  # no dir
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(
+                local_dir="/x", local_interval_steps=20,
+                persistent_dir="/y", persistent_interval_steps=10,
+            ).validate()  # local must be the FREQUENT tier
+        CheckpointPolicySpec(
+            local_dir="/x", local_interval_steps=2,
+            persistent_dir="/y", persistent_interval_steps=10,
+        ).validate()
+
+
+class TestGoodputExposure:
+    def test_healthz_stats_block_and_metrics_series(self, tmp_path):
+        """Goodput reaches BOTH exposure surfaces: the /healthz stats
+        block (HealthServer stats_provider) and the Prometheus registry
+        (/metrics) — the acceptance criterion's engine.stats analogue."""
+        import urllib.request
+
+        from k8s_tpu.controller import metrics
+        from k8s_tpu.controller.health import HealthServer
+
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        mgr.save(1, make_tree(mesh))
+        mgr.note_step(1)
+        assert mgr.restore(template_of(make_tree(mesh))) is not None
+
+        srv = HealthServer(
+            port=0, host="127.0.0.1",
+            stats_provider=lambda: {"ckpt": mgr.goodput()}).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["ok"] is True
+            assert body["ckpt"]["restores"] == 1
+            assert "lost_steps_per_restart" in body["ckpt"]
+            assert "ckpt_overhead_fraction" in body["ckpt"]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+                exposition = r.read().decode()
+            assert "ktpu_ckpt_restores_total" in exposition
+            assert "ktpu_ckpt_lost_steps_per_restart" in exposition
+            assert "ktpu_ckpt_overhead_fraction" in exposition
+            assert metrics.CKPT_RESTORES.get({"source": SOURCE_LOCAL}) >= 1
+        finally:
+            srv.stop()
+            mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# spec → operator → kubelet env flow
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorEnvFlow:
+    def test_checkpoint_policy_env_reaches_worker_pods(self):
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+        from k8s_tpu import spec as S
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "ckptjob"
+        j.metadata.namespace = "default"
+        j.metadata.uid = "uid-ck"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+        ]
+        j.spec.checkpoint_policy = S.CheckpointPolicySpec(
+            local_dir="/scratch/ckpt", local_interval_steps=5,
+            persistent_dir="gs://b/ckpt", persistent_interval_steps=50,
+            peer_port=8900,
+        )
+        tj = TrainingJob(client, jc, j)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        rid = j.spec.runtime_id
+        w1 = client.jobs.get("default", f"ckptjob-worker-{rid}-1")
+        env = w1.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_CKPT_LOCAL_DIR"] == "/scratch/ckpt"
+        assert env["KTPU_CKPT_LOCAL_EVERY"] == "5"
+        assert env["KTPU_CKPT_DIR"] == "gs://b/ckpt"
+        assert env["KTPU_CKPT_PERSIST_EVERY"] == "50"
+        assert env["KTPU_CKPT_PEER_FETCH"] == "1"
+        assert env["KTPU_CKPT_PEER_PORT"] == "8900"
+        # peers: every worker's per-index Service DNS on the shard port
+        peers = dict(
+            p.split("=", 1) for p in env["KTPU_CKPT_PEERS"].split(","))
+        assert peers == {
+            "0": f"http://ckptjob-worker-{rid}-0:8900",
+            "1": f"http://ckptjob-worker-{rid}-1:8900",
+        }
+        # the launcher parses the same contract
+        from k8s_tpu.launcher.spmd_launcher import Rendezvous
+
+        rdzv = Rendezvous(env={**env, "KTPU_PROCESS_ID": "1"})
+        assert rdzv.ckpt_local_dir == "/scratch/ckpt"
+        assert rdzv.ckpt_peer_port == 8900
+        assert rdzv.ckpt_peers == env["KTPU_CKPT_PEERS"]
+
+    def test_no_policy_no_env(self):
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+        from k8s_tpu import spec as S
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "plain"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=1)
+        ]
+        tj = TrainingJob(client, TpuJobClient(cluster), j)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        rid = j.spec.runtime_id
+        w0 = client.jobs.get("default", f"plain-worker-{rid}-0")
+        env = w0.spec.template.spec.containers[0].env_dict()
+        assert not any(k.startswith("KTPU_CKPT_") for k in env)
+
+
+# ---------------------------------------------------------------------------
+# reached_preemption fallback (ISSUE 4 satellite): the SIGTERM /
+# launcher-flag path of k8s_tpu/train/checkpoint.py:160-183
+# ---------------------------------------------------------------------------
+
+
+class TestReachedPreemptionFallback:
+    def test_broken_poll_returns_false_and_warns_once(self, tmp_path,
+                                                      caplog):
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+        try:
+            def boom(step):
+                raise RuntimeError("no coordination service")
+
+            mgr.manager.reached_preemption = boom
+            import logging
+
+            with caplog.at_level(logging.WARNING,
+                                 logger="k8s_tpu.train.checkpoint"):
+                assert mgr.reached_preemption(1) is False
+                assert mgr.reached_preemption(2) is False
+                assert mgr.reached_preemption(3) is False
+            warns = [r for r in caplog.records
+                     if "preemption poll unavailable" in r.getMessage()]
+            # logged exactly ONCE: a silently-dead poll would hide that
+            # maintenance events no longer flush, but per-step spam
+            # would bury real logs
+            assert len(warns) == 1
+        finally:
+            mgr.close()
+
+    def test_single_process_launcher_flag_flushes_and_exits_143(
+            self, monkeypatch):
+        from k8s_tpu.programs.common import maybe_preempt_exit
+
+        class StubMgr:
+            def __init__(self):
+                self.saved = []
+                self.waited = self.closed = False
+
+            def save(self, step, state, force=False):
+                self.saved.append((step, force))
+                return True
+
+            def wait(self):
+                self.waited = True
+
+            def close(self):
+                self.closed = True
+
+            def reached_preemption(self, step):
+                raise AssertionError(
+                    "single-process must use the launcher flag, not the "
+                    "distributed poll")
+
+        class Rdzv:
+            num_processes = 1
+            process_id = 0
+
+        mgr = StubMgr()
+        # flag not set: no-op
+        monkeypatch.delenv("KTPU_PREEMPT_REQUESTED", raising=False)
+        maybe_preempt_exit(mgr, Rdzv(), 7, state={})
+        assert mgr.saved == []
+        # the launcher's SIGTERM handler set the flag: flush at the
+        # CURRENT step and exit retryable (143)
+        monkeypatch.setenv("KTPU_PREEMPT_REQUESTED", "1")
+        with pytest.raises(SystemExit) as e:
+            maybe_preempt_exit(mgr, Rdzv(), 8, state={})
+        assert e.value.code == 143
+        assert mgr.saved == [(8, True)]
+        assert mgr.waited and mgr.closed
+
+    def test_distributed_uses_gang_consensus_poll(self, monkeypatch):
+        from k8s_tpu.programs.common import maybe_preempt_exit
+
+        polled = []
+
+        class StubMgr:
+            def __init__(self):
+                self.saved = []
+
+            def reached_preemption(self, step):
+                polled.append(step)
+                return step >= 5
+
+            def save(self, step, state, force=False):
+                self.saved.append((step, force))
+
+            def wait(self):
+                pass
+
+            def close(self):
+                pass
+
+        class Rdzv:
+            num_processes = 4
+            process_id = 2
+
+        # env flag must be IGNORED for distributed runs — the gang-wide
+        # consensus poll decides, or one process would flush alone into
+        # its peers' collectives
+        monkeypatch.setenv("KTPU_PREEMPT_REQUESTED", "1")
+        mgr = StubMgr()
+        maybe_preempt_exit(mgr, Rdzv(), 3, state={})
+        assert polled == [3] and mgr.saved == []
+        with pytest.raises(SystemExit) as e:
+            maybe_preempt_exit(mgr, Rdzv(), 5, state={})
+        assert e.value.code == 143
+        assert mgr.saved == [(5, True)]

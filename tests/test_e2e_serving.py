@@ -222,3 +222,98 @@ def test_serving_restores_trained_checkpoint(tmp_path):
     finally:
         controller.stop()
         kubelet.stop()
+
+
+@pytest.mark.integration
+def test_rest_backed_serving_job(tmp_path):
+    """The serving path over the REAL wire (ISSUE 4 satellite): the
+    whole control plane — controller, CRD client, kubelet — talks to a
+    LocalApiServer through RestCluster (HTTP + JSON + metav1.Status +
+    chunked watches) instead of the in-memory backend, materializes a
+    serving TpuJob, the launched server answers a request, and deleting
+    the job over REST cascades into the SIGTERM drain. Previously only
+    InMemoryCluster ever exercised this path end to end."""
+    from k8s_tpu.api.apiserver import LocalApiServer
+    from k8s_tpu.api.restcluster import RestCluster
+
+    api = LocalApiServer().start()
+    controller = kubelet = None
+    try:
+        # operator over the wire; the kubelet is a NODE component and
+        # watches the server-side store directly (the contract-test
+        # topology: REST client on the operator side only)
+        client = KubeClient(RestCluster(api.url))
+        jc = TpuJobClient(RestCluster(api.url))
+        node_client = KubeClient(api.cluster)
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.1)
+        executor = SubprocessExecutor(
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "KTPU_FORCE_PLATFORM": "cpu",
+                "KTPU_NUM_CPU_DEVICES": "1",
+                "KTPU_PROGRAM": "k8s_tpu.programs.serving:main",
+                "KTPU_PROGRAM_ARGS": (
+                    "--model=tiny --max_seq_len=64 --max_slots=2 "
+                    "--decode_chunk=4 --prompt_buckets=4,8,16"
+                ),
+            },
+        )
+        kubelet = LocalKubelet(node_client, executor)
+        kubelet.start()
+        controller.start()
+
+        j = S.TpuJob()
+        j.metadata.name = "serve-rest"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=1)
+        ]
+        jc.create(j)
+        # the CRD round-tripped through the apiserver: read it BACK over
+        # REST and check the wire identity
+        got = jc.get("default", "serve-rest")
+        assert got.metadata.name == "serve-rest"
+
+        deadline = time.monotonic() + 240
+        port = None
+        while time.monotonic() < deadline:
+            log = _worker_log(tmp_path, "serve-rest")
+            m = re.search(r'\{"event": "serving_ready".*\}', log)
+            if m:
+                port = json.loads(m.group(0))["port"]
+                break
+            time.sleep(0.2)
+        assert port, "server never became ready:\n" + _worker_log(
+            tmp_path, "serve-rest")
+
+        payload = {"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 6}
+        code, body = _post(port, payload)
+        assert code == 200 and len(body["tokens"]) == 6, body
+
+        # delete over the REST wire ⇒ cascade ⇒ SIGTERM ⇒ clean drain
+        jc.delete("default", "serve-rest")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            log = _worker_log(tmp_path, "serve-rest")
+            if '"event": "serving_drained"' in log:
+                break
+            time.sleep(0.2)
+        log = _worker_log(tmp_path, "serve-rest")
+        assert '"event": "serving_drained"' in log, log
+        drained = [json.loads(l) for l in log.splitlines()
+                   if '"event": "serving_drained"' in l]
+        assert drained[-1]["served"] >= 1, drained
+        # GC over REST: the job's compute is gone from the server store
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not client.jobs.list("default"):
+                break
+            time.sleep(0.2)
+        assert client.jobs.list("default") == []
+    finally:
+        if controller is not None:
+            controller.stop()
+        if kubelet is not None:
+            kubelet.stop()
+        api.stop()
