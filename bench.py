@@ -87,11 +87,13 @@ def bench_startup() -> int:
     return 0
 
 
-def bench_llama() -> dict:
+def bench_llama(argv=None) -> dict:
     """705M Llama train tokens/sec/chip (the production LLM path:
     scan+remat flash blocks, fused-CE head, AdamW) via
     benches/llama_bench.measure — recorded alongside resnet so the
-    driver's BENCH_r*.json tracks the LLM data plane too."""
+    driver's BENCH_r*.json tracks the LLM data plane too. ``argv``
+    selects non-default rows (e.g. ["--zero1"] for the sharded-weight-
+    update A/B)."""
     import os
 
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -100,7 +102,8 @@ def bench_llama() -> dict:
 
     # the bench's own parser defaults — new llama_bench flags inherit
     # automatically instead of drifting against a hand-built Namespace
-    return llama_bench.measure(llama_bench.build_parser().parse_args([]))
+    return llama_bench.measure(
+        llama_bench.build_parser().parse_args(argv or []))
 
 
 def main() -> int:
@@ -209,11 +212,29 @@ def main() -> int:
             "llama_train_tokens_per_sec_per_chip": res["value"],
             "llama_mfu": res.get("mfu"),
             "llama_step_time_ms": res.get("step_time_ms"),
+            "llama_hbm_bytes_per_device": res.get("hbm_bytes_per_device"),
             "llama_collective_budget": res.get("collective_budget"),
         }
         spmd_remat += int(res.get("spmd_involuntary_remat") or 0)
     except Exception as e:  # noqa: BLE001
         llama = {"llama_error": f"{type(e).__name__}: {e}"}
+    # ZeRO-1 A/B of the same config (ISSUE 6): opt-state bytes/device,
+    # step time, and the collective budget under the sharded weight
+    # update, so BENCH_r*.json tracks the HBM and MFU delta against the
+    # replicated row above. Same failure isolation as the base row.
+    try:
+        res = bench_llama(["--zero1"])
+        llama.update({
+            "llama_zero1_tokens_per_sec_per_chip": res["value"],
+            "llama_zero1_mfu": res.get("mfu"),
+            "llama_zero1_step_time_ms": res.get("step_time_ms"),
+            "llama_zero1_hbm_bytes_per_device":
+                res.get("hbm_bytes_per_device"),
+            "llama_zero1_collective_budget": res.get("collective_budget"),
+        })
+        spmd_remat += int(res.get("spmd_involuntary_remat") or 0)
+    except Exception as e:  # noqa: BLE001
+        llama["llama_zero1_error"] = f"{type(e).__name__}: {e}"
 
     # the driver parses the LAST stdout line: flush stderr first so no
     # late warning text can interleave into it

@@ -65,7 +65,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--latency-hiding", action="store_true",
                    help="compile the step with XLA's latency-hiding "
                         "scheduler (async collectives; docs/PERF.md)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 sharded weight update: optimizer state "
+                        "+ grad sync sharded over the data axis, params "
+                        "all-gathered in-step (docs/PERF.md)")
     return p
+
+
+def shard_bytes_per_device(tree) -> int:
+    """Per-device HBM bytes of a sharded pytree from abstract shard
+    sizes (sharding.shard_shape) — backend-independent, exact for the
+    steady-state residents (params / opt state / grad buffers), which
+    is what the ZeRO-1 memory win is measured on. Leaves without a
+    sharding (host scalars) count their full size."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        shape = tuple(getattr(x, "shape", ()))
+        dtype = getattr(x, "dtype", None)
+        if dtype is None:
+            continue
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and shape:
+            shape = sharding.shard_shape(shape)
+        n = 1
+        for d in shape:
+            n *= d
+        total += n * jnp.dtype(dtype).itemsize
+    return int(total)
 
 
 def main(argv=None) -> int:
@@ -107,13 +133,24 @@ def measure(args) -> dict:
     mesh = build_mesh(MeshConfig(data=n))
     rules = LogicalRules(LogicalRules.DP)
     model = LlamaForCausalLM(cfg)
+    zero1 = bool(getattr(args, "zero1", False))
 
     ids = jnp.zeros((batch, seq), jnp.int32)
     state = create_sharded_state(
         model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules,
-        jax.random.PRNGKey(0), ids,
+        jax.random.PRNGKey(0), ids, zero1=zero1,
     )
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    # steady-state per-device residents from abstract shard sizes: the
+    # tracked ZeRO-1 memory metric (opt_state drops ~1/DP under
+    # --zero1; grads are reported in the layout the backward
+    # materializes them in — the params')
+    hbm = {
+        "params": shard_bytes_per_device(state.params),
+        "grads": shard_bytes_per_device(state.params),
+        "opt_state": shard_bytes_per_device(state.opt_state),
+        "source": "abstract_shard_sizes",
+    }
 
     from k8s_tpu.train import sum_sown_losses
 
@@ -139,7 +176,7 @@ def measure(args) -> dict:
             return ce + sum_sown_losses(mut.get("intermediates", {})), {}
 
     step = make_train_step(
-        loss_fn, mesh, rules,
+        loss_fn, mesh, rules, zero1=zero1,
         latency_hiding=getattr(args, "latency_hiding", False),
     )
     rng = jax.random.PRNGKey(1)
@@ -218,6 +255,8 @@ def measure(args) -> dict:
         "step_time_ms": round(elapsed / iters * 1000, 2),
         "spmd_involuntary_remat": spmd_remat,
         "latency_hiding": bool(getattr(args, "latency_hiding", False)),
+        "zero1": zero1,
+        "hbm_bytes_per_device": hbm,
         "collective_budget": budget,
         **({"mode": "smoke"} if smoke else {}),
     }

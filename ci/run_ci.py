@@ -139,8 +139,14 @@ def main(argv=None) -> int:
         # golden budget manifests (ci/hlo_budgets/) — a sharding
         # regression that sneaks a new all-gather into the backward pass
         # or reintroduces involuntary-resharding fallbacks fails HERE,
-        # in ~a minute, not as warning spew in a dryrun log. The full
-        # north-star configs get the same check via `aot-northstar
+        # in ~a minute, not as warning spew in a dryrun log. `--check`
+        # runs EVERY registered stand-in, which since ISSUE 6 includes
+        # the ZeRO-1 configs (standin-zero1-{dp,fsdp}-cpu8): their
+        # goldens pin the sharded-weight-update schedule — grad sync +
+        # per-leaf param all-gathers AFTER the optimizer, zero backward
+        # all-gathers — so a sharded update that leaks an extra gather
+        # into the backward pass fails with a readable count diff. The
+        # full north-star configs get the same check via `aot-northstar
         # --lint` below when the deviceless TPU compiler is available.
         ok = ok and stage(
             "hlo-budget",

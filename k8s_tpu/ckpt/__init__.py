@@ -10,8 +10,11 @@ paying a full persistent-store restore.
 from k8s_tpu.ckpt.local import (  # noqa: F401
     LocalTier,
     arm_partial_commit,
+    compose_shard,
+    covering_plan,
     index_key,
     parse_index_key,
+    union_covering_plan,
 )
 from k8s_tpu.ckpt.peer import (  # noqa: F401
     FilesystemPeerTransport,

@@ -102,6 +102,160 @@ def parse_index_key(key: str) -> Optional[Tuple[slice, ...]]:
     return tuple(out)
 
 
+# ---------------------------------------------------------------------------
+# Shard-index geometry: restoring ACROSS layouts (replicated ↔ ZeRO-1 /
+# resharded opt state) means the exact index a template asks for may not
+# exist in a manifest written under the other layout — but a bigger
+# stored shard may CONTAIN it, or a set of smaller stored shards may
+# tile it exactly. These helpers answer both without loading payloads.
+# ---------------------------------------------------------------------------
+
+
+def _box(key: str) -> Optional[Tuple[Tuple[int, int], ...]]:
+    """Index key → ((start, stop), ...) box; None for the scalar key
+    (or a malformed one). Wraps :func:`parse_index_key` so the covering
+    geometry can never diverge from the save-side key vocabulary."""
+    try:
+        slices = parse_index_key(key)
+    except ValueError:
+        return None
+    if not slices:
+        return None
+    return tuple((s.start, s.stop) for s in slices)
+
+
+def _box_contains(outer, inner) -> bool:
+    return len(outer) == len(inner) and all(
+        o[0] <= i[0] and i[1] <= o[1] for o, i in zip(outer, inner)
+    )
+
+
+def _box_volume(b) -> int:
+    v = 1
+    for lo, hi in b:
+        v *= max(0, hi - lo)
+    return v
+
+
+def _boxes_disjoint(a, b) -> bool:
+    return any(a[i][1] <= b[i][0] or b[i][1] <= a[i][0]
+               for i in range(len(a)))
+
+
+def _tiles_exactly(want, boxes) -> bool:
+    """Do ``boxes`` (each already contained in ``want``) tile it
+    exactly? Equal total volume + pairwise disjoint + all inside want
+    ⇒ gap-free, overlap-free cover. Shared by the single-manifest and
+    union covering plans so the tiling rule cannot diverge."""
+    boxes = list(boxes)
+    if not boxes:
+        return False
+    if sum(_box_volume(b) for b in boxes) != _box_volume(want):
+        return False
+    return all(
+        _boxes_disjoint(boxes[i], boxes[j])
+        for i in range(len(boxes)) for j in range(i + 1, len(boxes))
+    )
+
+
+def covering_plan(
+    want_key: str, have_keys,
+) -> Optional[List[str]]:
+    """Which stored shard keys rebuild ``want_key``: the exact key, ONE
+    containing shard (replicated checkpoint → sharded template), or a
+    set of contained shards that tile it exactly (sharded checkpoint →
+    replicated/coarser template). None when the manifest cannot cover
+    the request. Geometry only — no payload reads."""
+    have = list(have_keys)
+    if want_key in have:
+        return [want_key]
+    want = _box(want_key)
+    if want is None:
+        return None  # scalar: exact key or nothing
+    for k in have:
+        hb = _box(k)
+        if hb is not None and _box_contains(hb, want):
+            return [k]
+    pieces = [(k, _box(k)) for k in have]
+    pieces = [(k, b) for k, b in pieces
+              if b is not None and _box_contains(want, b)]
+    if not _tiles_exactly(want, [b for _, b in pieces]):
+        return None
+    return [k for k, _ in pieces]
+
+
+def union_covering_plan(
+    want_key: str, have_by_source,
+) -> Optional[List[Tuple[str, Any]]]:
+    """:func:`covering_plan` across SEVERAL manifests: rebuild
+    ``want_key`` from shards held by different sources (own disk +
+    peers). ``have_by_source`` is an ordered ``[(source, keys), ...]``
+    — sources earlier in the list are preferred. Returns
+    ``[(key, source), ...]`` or None.
+
+    This is what makes a multi-host ZeRO-1 checkpoint restorable into a
+    replicated/coarser template: each host's manifest holds only its
+    own 1/DP tile, so no SINGLE manifest covers the full leaf — but the
+    union does. Single-source plans win first (no cross-host assembly);
+    otherwise contained pieces are pooled across sources (first source
+    holding a key claims it) and must tile ``want_key`` exactly —
+    pairwise-disjoint, gap-free — or the union is no cover either."""
+    for src, keys in have_by_source:
+        plan = covering_plan(want_key, keys)
+        if plan is not None:
+            return [(k, src) for k in plan]
+    want = _box(want_key)
+    if want is None:
+        return None  # scalar: exact key or nothing, per source
+    pieces: Dict[str, Tuple[Any, Tuple]] = {}
+    for src, keys in have_by_source:
+        for k in keys:
+            if k in pieces:
+                continue
+            b = _box(k)
+            if b is not None and _box_contains(want, b):
+                pieces[k] = (src, b)
+    if not _tiles_exactly(want, [b for _, b in pieces.values()]):
+        return None
+    return [(k, src) for k, (src, _) in pieces.items()]
+
+
+def compose_shard(
+    want_key: str, plan: List[str], load,
+) -> Optional[np.ndarray]:
+    """Assemble the ``want_key`` slice from the shards named by a
+    :func:`covering_plan`. ``load(key) -> ndarray | None`` reads one
+    stored shard (crc-verified by the caller's loader); any failed load
+    fails the composition (caller falls back to a peer / the persistent
+    tier)."""
+    want = _box(want_key)
+    if plan == [want_key] or want is None:
+        return load(want_key)
+    if len(plan) == 1:  # one containing shard: cut our slice out of it
+        outer = _box(plan[0])
+        arr = load(plan[0])
+        if arr is None:
+            return None
+        rel = tuple(
+            slice(w[0] - o[0], w[1] - o[0]) for w, o in zip(want, outer)
+        )
+        return np.ascontiguousarray(arr[rel])
+    out = None
+    for k in plan:
+        arr = load(k)
+        if arr is None:
+            return None
+        if out is None:
+            out = np.empty(
+                tuple(hi - lo for lo, hi in want), dtype=arr.dtype)
+        kb = _box(k)
+        rel = tuple(
+            slice(b[0] - w[0], b[1] - w[0]) for b, w in zip(kb, want)
+        )
+        out[rel] = arr
+    return out
+
+
 def _leaf_paths(tree) -> List[Tuple[str, Any]]:
     """Stable ``(path-string, leaf)`` pairs: '/'-joined key path of each
     leaf — the manifest vocabulary both save and restore agree on."""
@@ -417,35 +571,50 @@ class LocalTier:
         self, step: int, leaf_path: str, key: str, host_id: Optional[int] = None
     ) -> Optional[np.ndarray]:
         """Load + crc-verify one shard; None when missing or corrupt
-        (the caller falls back to a peer / the persistent tier)."""
+        (the caller falls back to a peer / the persistent tier).
+
+        The requested index does not have to match a stored index: a
+        checkpoint written under a different layout (replicated opt
+        state restored into a ``zero1=True`` run, or the reverse) is
+        RESHARDED on read — the slice is cut out of one containing
+        stored shard, or assembled from stored shards that tile it
+        (:func:`covering_plan`). Both peer transports route through
+        here (the REST wire server-side), so peers serve resharded
+        reads too."""
         man = self.manifest(step, host_id=host_id)
         if man is None:
             return None
         entry = (man.get("leaves") or {}).get(leaf_path)
         if entry is None:
             return None
-        shard = (entry.get("shards") or {}).get(key)
-        if shard is None:
+        shards = entry.get("shards") or {}
+        plan = covering_plan(key, shards.keys())
+        if plan is None:
             return None
         hdir = (
             self.host_dir
             if host_id is None
             else os.path.join(self.root, f"host-{host_id}")
         )
-        fpath = os.path.join(hdir, f"step-{step}", shard["file"])
-        try:
-            arr = np.load(fpath)
-        except (OSError, ValueError):
-            return None
-        if (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF) != shard["crc"]:
-            log.warning(
-                "local tier: crc mismatch for %s[%s] step %d host %s — "
-                "treating shard as lost",
-                leaf_path, key, step, host_id if host_id is not None
-                else self.host_id,
-            )
-            return None
-        return arr
+
+        def load(stored_key: str) -> Optional[np.ndarray]:
+            shard = shards[stored_key]
+            fpath = os.path.join(hdir, f"step-{step}", shard["file"])
+            try:
+                arr = np.load(fpath)
+            except (OSError, ValueError):
+                return None
+            if (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF) != shard["crc"]:
+                log.warning(
+                    "local tier: crc mismatch for %s[%s] step %d host %s — "
+                    "treating shard as lost",
+                    leaf_path, stored_key, step,
+                    host_id if host_id is not None else self.host_id,
+                )
+                return None
+            return arr
+
+        return compose_shard(key, plan, load)
 
     # ------------------------------------------------------------ chaos
     # helpers operating on a whole local root (any host) — used by the

@@ -53,8 +53,11 @@ import numpy as np
 from k8s_tpu.ckpt.local import (
     LocalTier,
     _leaf_paths,
+    compose_shard,
+    covering_plan,
     parse_index_key,
     required_indices,
+    union_covering_plan,
 )
 
 log = logging.getLogger(__name__)
@@ -93,6 +96,12 @@ class RestorePlan:
     source: str
     # leaf path -> {index_key: host_id} for shards sourced from peers
     peer_shards: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # leaf path -> {index_key: [(stored_key, host_id|None), ...]} for
+    # shards no single manifest covers — assembled from pieces spread
+    # across own disk (host None) and peers (a multi-host ZeRO-1
+    # checkpoint restored into a replicated/coarser template)
+    tiled: Dict[str, Dict[str, List[Tuple[str, Optional[int]]]]] = field(
+        default_factory=dict)
     peer_fetches: int = 0
 
 
@@ -176,30 +185,31 @@ class RestorePlanner:
         # every per-step achievability check (a dead peer costs one
         # timeout, not one per retained step)
         peer_steps = self._peer_steps()
-        best_local: Optional[Tuple[int, Dict[str, Dict[str, int]], int]] = None
+        best_local = None
         for step in self._candidate_steps(peer_steps):
             if persistent_step is not None and step <= persistent_step:
                 break  # older than the durable tier — no point
-            achievable, peer_shards, fetches = self._achievable(
+            achievable, peer_shards, tiled, fetches = self._achievable(
                 step, needed, coverage, peer_steps)
             if achievable:
-                best_local = (step, peer_shards, fetches)
+                best_local = (step, peer_shards, tiled, fetches)
                 break
         if best_local is not None:
             step = self.consensus(best_local[0])
             if step != best_local[0]:
                 # the gang agreed on an older step (some peer couldn't
                 # source ours) — re-plan shard sources for THAT step
-                achievable, peer_shards, fetches = self._achievable(
+                achievable, peer_shards, tiled, fetches = self._achievable(
                     step, needed, coverage, peer_steps)
                 if not achievable:
                     return self._persistent_plan(persistent_step)
-                best_local = (step, peer_shards, fetches)
-            step, peer_shards, fetches = best_local
+                best_local = (step, peer_shards, tiled, fetches)
+            step, peer_shards, tiled, fetches = best_local
             return RestorePlan(
                 step=step,
                 source=SOURCE_LOCAL_PEER if fetches else SOURCE_LOCAL,
                 peer_shards=peer_shards,
+                tiled=tiled,
                 peer_fetches=fetches,
             )
         return self._persistent_plan(persistent_step)
@@ -213,14 +223,24 @@ class RestorePlanner:
         self, step: int, needed: Dict[str, List[str]],
         coverage: Optional[Dict[str, List[str]]] = None,
         peer_steps: Optional[Dict[int, List[int]]] = None,
-    ) -> Tuple[bool, Dict[str, Dict[str, int]], int]:
+    ) -> Tuple[bool, Dict[str, Dict[str, int]],
+               Dict[str, Dict[str, List[Tuple[str, Optional[int]]]]], int]:
         """Can this host source every required shard at ``step``?
         Checks manifests only (no payload reads): own manifest first,
         then peers'. crc validation happens at fetch time; a corrupt
-        own-shard is re-sourced from a peer then. ``coverage`` (gang
-        mode) additionally requires the union of visible manifests to
-        hold EVERY listed index — proving every peer could restore this
-        step too."""
+        own-shard is re-sourced from a peer then. A required index
+        counts as held when a manifest's stored shards COVER it
+        (covering_plan): a checkpoint saved under a different layout —
+        replicated opt state vs a ``zero1=True`` template, or the
+        reverse — is resharded on read instead of forcing the restore
+        down to the persistent tier (or silently to a fresh start).
+        When no SINGLE manifest covers an index (a multi-host ZeRO-1
+        checkpoint: each host stores only its own 1/DP opt tile), the
+        UNION of own + peer manifests may still tile it —
+        union_covering_plan records the per-piece sources in ``tiled``.
+        ``coverage`` (gang mode) additionally requires the union of
+        visible manifests to hold EVERY listed index — proving every
+        peer could restore this step too."""
         own = self.local.manifest(step) if self.local else None
         peer_manifests: Dict[int, dict] = {}
         peer_hosts = []
@@ -231,32 +251,59 @@ class RestorePlanner:
                 if step in steps:
                     peer_hosts.append(h)
         peer_shards: Dict[str, Dict[str, int]] = {}
+        tiled: Dict[str, Dict[str, List[Tuple[str, Optional[int]]]]] = {}
         fetches = 0
         for path, keys in needed.items():
             own_entry = ((own or {}).get("leaves") or {}).get(path, {})
             own_keys = set((own_entry.get("shards") or {}))
             for key in keys:
-                if key in own_keys:
+                if covering_plan(key, own_keys) is not None:
                     continue
                 host = self._peer_with(step, path, key, peer_hosts,
                                        peer_manifests)
-                if host is None:
-                    return False, {}, 0
-                peer_shards.setdefault(path, {})[key] = host
-                fetches += 1
+                if host is not None:
+                    peer_shards.setdefault(path, {})[key] = host
+                    fetches += 1
+                    continue
+                union = union_covering_plan(
+                    key, self._sources(path, own_keys, peer_hosts,
+                                       peer_manifests))
+                if union is None:
+                    return False, {}, {}, 0
+                tiled.setdefault(path, {})[key] = union
+                fetches += sum(1 for _, src in union if src is not None)
         if coverage is not None:
             for path, keys in coverage.items():
                 own_entry = ((own or {}).get("leaves") or {}).get(path, {})
                 own_keys = set((own_entry.get("shards") or {}))
                 for key in keys:
-                    if key in own_keys:
+                    if covering_plan(key, own_keys) is not None:
                         continue
                     if self._peer_with(step, path, key, peer_hosts,
-                                       peer_manifests) is None:
-                        return False, {}, 0
-        return True, peer_shards, fetches
+                                       peer_manifests) is not None:
+                        continue
+                    if union_covering_plan(
+                            key, self._sources(path, own_keys, peer_hosts,
+                                               peer_manifests)) is None:
+                        return False, {}, {}, 0
+        return True, peer_shards, tiled, fetches
+
+    def _sources(self, path, own_keys, peer_hosts, peer_manifests):
+        """Ordered ``[(source, stored keys), ...]`` for one leaf across
+        every visible manifest — own disk first (source None), then
+        peers. Peer manifests are already cached by the _peer_with pass
+        that ran (and missed) before any union plan is attempted."""
+        out = [(None, own_keys)]
+        for h in peer_hosts:
+            entry = ((peer_manifests.get(h) or {}).get("leaves")
+                     or {}).get(path, {})
+            out.append((h, set(entry.get("shards") or {})))
+        return out
 
     def _peer_with(self, step, path, key, peer_hosts, peer_manifests):
+        """First peer whose manifest can source ``key`` — exactly or by
+        resharding from its stored shards (the transports' fetch routes
+        through LocalTier.read_shard, which composes the same plan)."""
         for h in peer_hosts:
             man = peer_manifests.get(h)
             if man is None:
@@ -266,7 +313,8 @@ class RestorePlanner:
                     man = {}
                 peer_manifests[h] = man
             entry = (man.get("leaves") or {}).get(path, {})
-            if key in (entry.get("shards") or {}):
+            if covering_plan(key, (entry.get("shards") or {}).keys()) \
+                    is not None:
                 return h
         return None
 
@@ -317,6 +365,26 @@ class RestorePlanner:
             shard_data: Dict[str, np.ndarray] = {}
             for key in required_indices(leaf):
                 arr = None
+                pieces = plan.tiled.get(path, {}).get(key)
+                if pieces is not None:
+                    # assembled from shards no single manifest covers:
+                    # own tiles read locally, peer tiles fetched by
+                    # their EXACT stored key (read_shard serves exact
+                    # keys trivially), composed into the template slice
+                    src_of = dict(pieces)
+
+                    def load(k, _src=src_of, _step=step, _path=path):
+                        h = _src[k]
+                        if h is None:
+                            return (self.local.read_shard(_step, _path, k)
+                                    if self.local is not None else None)
+                        return self.transport.fetch(_step, _path, k, h)
+
+                    arr = compose_shard(key, [k for k, _ in pieces], load)
+                    if arr is None:
+                        return None
+                    shard_data[key] = arr
+                    continue
                 peer = plan.peer_shards.get(path, {}).get(key)
                 if peer is None and self.local is not None:
                     arr = self.local.read_shard(step, path, key)

@@ -65,6 +65,14 @@ class Rendezvous:
             self.ckpt_peer_port = int(env.get("KTPU_CKPT_PEER_PORT", "0"))
         except ValueError:
             self.ckpt_peer_port = 0
+        # trainer-mode contract (spec.training → operator env): ZeRO-1
+        # sharded weight update (consumed by the training programs) and
+        # the latency-hiding scheduler (ALSO consumed pre-init by
+        # configure_platform — parsed here so it is visible at the
+        # launch boundary like the checkpoint contract above)
+        self.zero1 = env.get("KTPU_ZERO1", "") in ("1", "true")
+        self.latency_hiding = env.get(
+            "KTPU_LATENCY_HIDING", "") in ("1", "true")
 
     @property
     def is_distributed(self):

@@ -23,4 +23,7 @@ from k8s_tpu.parallel.sharding import (  # noqa: F401
     resolve_logical_axes,
     shard_init,
     with_sharding,
+    zero1_partition_spec,
+    zero1_sharding,
+    zero1_shardings,
 )

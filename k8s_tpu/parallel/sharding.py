@@ -216,6 +216,89 @@ def sharded_embedding_lookup(table, ids, mesh: Optional[Mesh],
     return logical_constraint(x, ("batch", "length", "embed"), mesh)
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1: optimizer-state / weight-update sharding across the DP axis
+# ---------------------------------------------------------------------------
+
+ZERO1_AXIS = "data"
+
+
+def zero1_partition_spec(
+    spec: P, shape: Sequence[int], mesh: Mesh, axis: str = ZERO1_AXIS
+) -> Optional[P]:
+    """The leaf's PartitionSpec with the data-parallel mesh axis added
+    to the first dimension whose per-shard size it divides — the ZeRO-1
+    layout ("Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training", PAPERS.md): optimizer moments, the f32
+    accum-grad carry, and the gradient reduce-scatter output all live
+    1/DP per replica instead of fully replicated.
+
+    Existing axes are preserved (FSDP params keep their ``fsdp`` dims —
+    ZeRO-1 composes with them by appending ``data`` to the same dim or
+    claiming a later one). Returns None when nothing can be sharded:
+    ``axis`` missing or size 1 on this mesh, already consumed by the
+    spec, a sub-matrix leaf, or no dimension divisible by the DP degree
+    (odd-shaped leaves simply stay in their existing layout — ZeRO is
+    best-effort per leaf, never a constraint violation).
+
+    Only rank >= 2 leaves shard: norm scales and biases are a rounding
+    error of the moment bytes, and constraining their gradients
+    propagates the 1-D data sharding backward through the broadcasts
+    that consume them — GSPMD then involuntarily rematerializes the
+    [B, S, E] activations (observed: 5 remat fallbacks on the llama
+    stand-in) and the resharded reductions even perturb bf16 numerics.
+    """
+    dp = int(dict(mesh.shape).get(axis, 1))
+    if dp <= 1 or len(shape) < 2:
+        return None
+    axes = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for entry in axes:
+        if isinstance(entry, str):
+            used.add(entry)
+        elif isinstance(entry, tuple):
+            used.update(entry)
+    if axis in used:
+        return None
+    sizes = dict(mesh.shape)
+    for i, dim in enumerate(shape):
+        entry = axes[i]
+        names = (
+            () if entry is None
+            else (entry,) if isinstance(entry, str) else tuple(entry)
+        )
+        shard = dim
+        for n in names:
+            shard //= max(1, int(sizes.get(n, 1)))
+        if shard and shard % dp == 0:
+            axes[i] = (*names, axis) if names else axis
+            return P(*axes)
+    return None
+
+
+def zero1_sharding(leaf, mesh: Mesh, axis: str = ZERO1_AXIS) -> NamedSharding:
+    """The ZeRO-1 NamedSharding of one params-shaped leaf (a concrete
+    array or ShapeDtypeStruct carrying ``.sharding``), falling back to
+    the leaf's own layout when no dim divides the DP degree."""
+    own = getattr(leaf, "sharding", None)
+    own_spec = own.spec if isinstance(own, NamedSharding) else P()
+    zspec = zero1_partition_spec(
+        own_spec, tuple(getattr(leaf, "shape", ())), mesh, axis=axis
+    )
+    if zspec is None:
+        return own if isinstance(own, NamedSharding) else NamedSharding(mesh, P())
+    return NamedSharding(mesh, zspec)
+
+
+def zero1_shardings(params, mesh: Mesh, axis: str = ZERO1_AXIS):
+    """Params-shaped tree of ZeRO-1 NamedShardings — the layout the
+    trainer pins gradients, optimizer state, and the accum-grad carry
+    to when ``zero1=True`` (trainer_lib.make_train_step)."""
+    return jax.tree_util.tree_map(
+        lambda x: zero1_sharding(x, mesh, axis=axis), params
+    )
+
+
 def shard_init(mesh: Mesh, rules: LogicalRules, init_fn, annotations):
     """Eval-shape ``init_fn`` and produce NamedShardings for its pytree.
 

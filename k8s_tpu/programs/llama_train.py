@@ -80,6 +80,17 @@ def _mesh_for(strategy: str, n: int, num_slices: int, stages: int = 2):
     raise ValueError(f"unknown strategy {strategy}")
 
 
+def _rdzv_flag(rdzv, attr: str, env: str) -> bool:
+    """A trainer-mode flag from the launcher contract: the Rendezvous
+    already parsed the operator-injected env (spec.training → to_env),
+    so production has exactly one parser. Bare rdzv stubs (tests,
+    notebooks) fall back to reading the env var directly."""
+    val = getattr(rdzv, attr, None)
+    if val is not None:
+        return bool(val)
+    return os.environ.get(env, "0") in ("1", "true")
+
+
 def main(rdzv) -> None:
     cfg = parse_run_config(rdzv, {"steps": 30, "batch_size": 16})
     extra = cfg.extra or {}
@@ -92,11 +103,23 @@ def main(rdzv) -> None:
     pp = strategy.startswith("pp")
     stages = int(extra.get("stages", "2"))
     mesh = _mesh_for(strategy, n, num_slices, stages=stages)
+    # --zero1=1 (or spec.training.zero1 → KTPU_ZERO1 in the pod env):
+    # ZeRO-1 sharded weight update — optimizer state and the grad sync
+    # sharded over the `data` mesh axis, updated params all-gathered
+    # in-step (docs/PERF.md, "sharded weight update"). The launcher
+    # already parsed the env contract (Rendezvous.zero1) — consume it
+    # so there is ONE production parser; bare-stub rdzvs (tests) fall
+    # back to the env directly.
+    zero1 = extra.get(
+        "zero1",
+        "1" if _rdzv_flag(rdzv, "zero1", "KTPU_ZERO1") else "0",
+    ) in ("1", "true")
     if rdzv.process_id <= 0:
         # machine-readable proof the MEGASCALE env shaped the mesh
         # (multi-slice e2e asserts data axis == num_slices)
         print(json.dumps({"event": "mesh", "num_slices": num_slices,
-                          "shape": dict(mesh.shape)}), flush=True)
+                          "shape": dict(mesh.shape), "zero1": zero1}),
+              flush=True)
     rules = LogicalRules(getattr(LogicalRules, STRATEGIES[strategy]))
     attention = "ring" if mesh.shape["seq"] > 1 else "flash"
     if model_name == "llama3-8b":
@@ -128,6 +151,7 @@ def main(rdzv) -> None:
     state = create_sharded_state(
         model, optax.adamw(lr, weight_decay=0.1), mesh, rules,
         jax.random.PRNGKey(0), jnp.asarray(next(data)["input_ids"]),
+        zero1=zero1,
     )
 
     # multi-tier when the job's checkpointPolicy enables the local tier
@@ -203,11 +227,13 @@ def main(rdzv) -> None:
     # parallel.mesh.enable_latency_hiding — this per-compile route
     # covers the already-initialized case.
     lhs = extra.get(
-        "latency_hiding", os.environ.get("KTPU_LATENCY_HIDING", "0")
+        "latency_hiding",
+        "1" if _rdzv_flag(rdzv, "latency_hiding",
+                          "KTPU_LATENCY_HIDING") else "0",
     ) in ("1", "true")
     step_fn = make_train_step(loss_fn, mesh, rules,
                               accum_steps=cfg.accum_steps,
-                              latency_hiding=lhs)
+                              zero1=zero1, latency_hiding=lhs)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)
     # pacing knob for chaos/e2e tests: widens the mid-training window a

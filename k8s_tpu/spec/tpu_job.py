@@ -259,6 +259,42 @@ class CheckpointPolicySpec(K8sObject):
 
 @register_type
 @dataclass
+class TrainingSpec(K8sObject):
+    """Trainer-mode knobs (docs/PERF.md) the operator turns into env
+    the launcher and training programs consume — the same spec→env→
+    program contract as ``checkpointPolicy``.
+
+    ``zero1`` shards the weight update + optimizer state across the
+    data-parallel mesh axis (ZeRO-1: reduce-scatter grads, update the
+    local shard, all-gather params — 1/DP optimizer HBM per device).
+    ``latencyHiding`` compiles train steps with XLA's latency-hiding
+    scheduler so the ZeRO gather/scatter (and every other collective)
+    overlaps with compute; the env lands before backend init via the
+    launcher pre-init hook."""
+
+    zero1: bool = False
+    latency_hiding: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        for name in ("zero1", "latency_hiding"):
+            if not isinstance(getattr(self, name), bool):
+                raise ValidationError(f"training: {name} must be a boolean")
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher/program contract (``KTPU_ZERO1`` read by
+        ``programs.llama_train``; ``KTPU_LATENCY_HIDING`` by the
+        launcher's ``configure_platform`` pre-init hook)."""
+        env: Dict[str, str] = {}
+        if self.zero1:
+            env["KTPU_ZERO1"] = "1"
+        if self.latency_hiding:
+            env["KTPU_LATENCY_HIDING"] = "1"
+        return env
+
+
+@register_type
+@dataclass
 class TpuJobSpec(K8sObject):
     runtime_id: str = field(default="", metadata={"json": "RuntimeId"})
     tensorboard: Optional[TensorBoardSpec] = None
@@ -280,6 +316,9 @@ class TpuJobSpec(K8sObject):
     # snapshots + demoted durable saves + peer-shard restore. None →
     # the job checkpoints however its program args say (or not at all).
     checkpoint_policy: Optional[CheckpointPolicySpec] = None
+    # Trainer-mode knobs (docs/PERF.md): ZeRO-1 sharded weight update,
+    # latency-hiding scheduler. None → program defaults.
+    training: Optional[TrainingSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -328,6 +367,8 @@ class TpuJobSpec(K8sObject):
             self.restart_backoff.validate()
         if self.checkpoint_policy is not None:
             self.checkpoint_policy.validate()
+        if self.training is not None:
+            self.training.validate()
         if self.tpu is not None and self.tpu.accelerator:
             t = self.tpu.topology()
             if t is None:

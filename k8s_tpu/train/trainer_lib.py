@@ -71,12 +71,19 @@ def create_sharded_state(
     rng: jax.Array,
     example_batch: Any,
     init_kwargs: Optional[dict] = None,
+    zero1: bool = False,
 ) -> TrainState:
     """Initialize a TrainState with every leaf placed per the rules.
 
     Params are initialized under jit with explicit out_shardings (no
     host-side full materialization); optimizer state inherits the
     params' layout through GSPMD propagation.
+
+    ``zero1=True`` lays the optimizer state out in the ZeRO-1 layout
+    instead: every params-shaped moment leaf additionally sharded over
+    the ``data`` mesh axis (parallel.sharding.zero1_shardings), 1/DP
+    bytes per device. Pair with ``make_train_step(zero1=True)`` — the
+    step keeps the layout through the update (docs/PERF.md).
     """
     init_kwargs = init_kwargs or {}
 
@@ -89,6 +96,11 @@ def create_sharded_state(
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     param_shardings = unboxed_shardings["params"]
+    opt_shardings = param_shardings
+    if zero1:
+        from k8s_tpu.parallel.sharding import zero1_shardings
+
+        opt_shardings = zero1_shardings(params, mesh)
 
     def build(params, batch_stats):
         state = TrainState.create(
@@ -97,14 +109,22 @@ def create_sharded_state(
             tx=optimizer,
             batch_stats=batch_stats,
         )
-        # ZeRO invariant: optimizer moments live with their params —
-        # constrain every params-shaped subtree of the opt state.
+        # ZeRO invariant: optimizer moments live with their params
+        # (zero1: with their param SHARD) — constrain every
+        # params-shaped subtree of the opt state.
         opt_state = _constrain_params_like(
-            state.opt_state, params, param_shardings
+            state.opt_state, params, opt_shardings
         )
         return state.replace(opt_state=opt_state)
 
     return jax.jit(build)(params, batch_stats)
+
+
+def _pin(x, s):
+    """None-tolerant sharding pin: every constraint site in this module
+    goes through here so a tree carrying None entries (a zero1 layout
+    that left some leaves in place) never diverges between sites."""
+    return jax.lax.with_sharding_constraint(x, s) if s is not None else x
 
 
 def _constrain_params_like(tree, params, param_shardings):
@@ -123,11 +143,7 @@ def _constrain_params_like(tree, params, param_shardings):
     def constrain(sub):
         if not is_params_like(sub):
             return sub
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.lax.with_sharding_constraint(x, s),
-            sub,
-            param_shardings,
-        )
+        return jax.tree_util.tree_map(_pin, sub, param_shardings)
 
     return jax.tree_util.tree_map(constrain, tree, is_leaf=is_params_like)
 
@@ -225,6 +241,7 @@ def make_train_step(
     rules: LogicalRules,
     donate: bool = True,
     accum_steps: int = 1,
+    zero1: bool = False,
     latency_hiding: bool = False,
     compiler_options: Optional[Dict[str, str]] = None,
 ) -> TrainStepFn:
@@ -250,6 +267,24 @@ def make_train_step(
     counts roughly balanced (e.g. pack sequences) when using
     ``accum_steps`` with masks. Aux outputs (metrics, ``batch_stats``)
     are averaged over microbatches.
+
+    ``zero1=True`` shards the weight update across the ``data`` mesh
+    axis (ZeRO-1, ROADMAP item 3): gradients are pinned to the ZeRO-1
+    layout (``parallel.sharding.zero1_shardings``) so the cross-replica
+    gradient sum becomes a reduce-scatter over ``data`` (on backends
+    with the reduce-scatter rewrite pass; the CPU stand-in renders it
+    as all-reduce + partition slice), the optimizer applies to the
+    local 1/DP shard only — next to optimizer state created sharded by
+    ``create_sharded_state(zero1=True)`` — and the updated params are
+    re-pinned to their replicated layout, which the partitioner
+    implements as one all-gather over ``data`` per leaf. The f32
+    accum-grad carry (``accum_steps > 1``) is pinned to the same 1/DP
+    layout. Losses match the replicated schedule bit-for-bit on CPU
+    meshes (asserted by tests/test_zero1.py); on TPU the reduce-scatter
+    reduction order may differ from the all-reduce's at float rounding
+    level. Combine with ``latency_hiding=True`` to overlap the new
+    gather/scatter with compute (docs/PERF.md, "sharded weight
+    update").
 
     ``latency_hiding=True`` compiles the step with XLA's latency-hiding
     scheduler (async collectives overlapped with compute — see
@@ -279,7 +314,21 @@ def make_train_step(
 
         return jax.value_and_grad(compute, has_aux=True)(state.params)
 
-    def make_step(flat_grad_shardings):
+    def make_step(flat_grad_shardings, flat_param_shardings=None):
+        # flat_param_shardings is only non-None under zero1: the
+        # params' ORIGINAL layout, re-pinned after the sharded update
+        # (the all-gather), while flat_grad_shardings carries the
+        # ZeRO-1 layout the grads/carry/opt-state are pinned to. The
+        # grad pin is TWO-step there — param layout first, zero1 layout
+        # second: a bare zero1 constraint on the gradients propagates
+        # backward through the grad-producing dots into the forward
+        # activations (observed: embed-dim shardings rematerializing
+        # the [B,S,E] tree), while the param-layout pin reproduces the
+        # baseline sync bit-for-bit and STOPS that propagation; the
+        # param→zero1 transition then sits at the optimizer boundary,
+        # where the TPU backend's reduce-scatter creator folds the
+        # all-reduce + per-partition slice into one reduce-scatter at
+        # 1/DP the bytes (CPU stand-ins keep the two-op rendering).
         def constrain_grads(grads):
             # Pin the gradient tree to the params' layout. Without this
             # GSPMD keeps ZeRO gradients replicated through the optimizer
@@ -294,15 +343,32 @@ def make_train_step(
             if flat_grad_shardings is None:
                 return grads
             flat, treedef = jax.tree_util.tree_flatten(grads)
-            flat = [
-                jax.lax.with_sharding_constraint(g, s) if s is not None else g
-                for g, s in zip(flat, flat_grad_shardings)
-            ]
+            if flat_param_shardings is not None:
+                flat = [_pin(g, s)
+                        for g, s in zip(flat, flat_param_shardings)]
+            flat = [_pin(g, s) for g, s in zip(flat, flat_grad_shardings)]
+            return jax.tree_util.tree_unflatten(treedef, flat)
+
+        def constrain_carry(grads):
+            # Final pin for a tree ALREADY in the zero1 layout (the f32
+            # accum carry after the scan): re-assert only the zero1
+            # shardings — a placement no-op. Re-running the TWO-step
+            # pin here would gather the carry back to the param layout
+            # and immediately re-slice it: one wasted full-size f32
+            # all-gather per shardable leaf at the optimizer boundary,
+            # exactly the cross-replica traffic ZeRO-1 removes
+            # (observed in compiled HLO; tests/test_zero1.py pins the
+            # accum gather count to the accum=1 count).
+            if flat_grad_shardings is None:
+                return grads
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            flat = [_pin(g, s) for g, s in zip(flat, flat_grad_shardings)]
             return jax.tree_util.tree_unflatten(treedef, flat)
 
         def step(state: TrainState, batch, rng):
             if accum_steps == 1:
                 (loss, aux), grads = grad_of(state, batch, rng)
+                grads = constrain_grads(grads)
             else:
                 def split(x):
                     if getattr(x, "ndim", 0) < 1:
@@ -343,6 +409,13 @@ def make_train_step(
                     (l, aux_i), g = grad_of(
                         state, mb, jax.random.fold_in(rng, i)
                     )
+                    # pin the microbatch grads like the carry: left
+                    # unconstrained they ADOPT the zero1-sharded
+                    # carry's layout through the add and propagate it
+                    # into the scan body's backward graph (involuntary
+                    # remat of the activation tree — same mechanism as
+                    # the two-step note in make_step)
+                    g = constrain_grads(g)
                     g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
                     aux_acc = jax.tree_util.tree_map(
                         lambda a, b: a + b.astype(jnp.float32), aux_acc, aux_i
@@ -370,8 +443,29 @@ def make_train_step(
                     g_sum, g_first,
                 )
                 loss = l_sum / accum_steps
-            grads = constrain_grads(grads)
+                grads = constrain_carry(grads)
             new_state = state.apply_gradients(grads=grads)
+            if flat_param_shardings is not None:
+                # ZeRO-1 epilogue: the optimizer ran on 1/DP shards
+                # (grads + opt state pinned to the zero1 layout above /
+                # at state creation); re-pin the updated params to
+                # their original layout — GSPMD renders the transition
+                # as ONE all-gather over `data` per leaf — and pin the
+                # new moments to the zero1 layout so the donated state
+                # round-trips with identical placement (a drifting
+                # opt-state layout would recompile every step).
+                treedef = jax.tree_util.tree_structure(state.params)
+                param_sh = jax.tree_util.tree_unflatten(
+                    treedef, list(flat_param_shardings))
+                zero1_sh = jax.tree_util.tree_unflatten(
+                    treedef, list(flat_grad_shardings))
+                new_params = jax.tree_util.tree_map(
+                    _pin, new_state.params, param_sh)
+                new_state = new_state.replace(
+                    params=new_params,
+                    opt_state=_constrain_params_like(
+                        new_state.opt_state, new_params, zero1_sh),
+                )
             if aux and "batch_stats" in aux:
                 new_state = new_state.replace(batch_stats=aux.pop("batch_stats"))
             metrics = {"loss": loss, **{k: v for k, v in (aux or {}).items()}}
@@ -425,7 +519,19 @@ def make_train_step(
     def jitted_for(state):
         key = _flat_param_shardings(state)
         if key not in jit_cache:
-            jit_cache[key] = make_step(None if not any(key) else key)
+            if not any(key):
+                jit_cache[key] = make_step(None)
+            elif zero1:
+                from k8s_tpu.parallel.sharding import zero1_sharding
+
+                z1 = tuple(
+                    zero1_sharding(x, mesh) if s is not None else None
+                    for x, s in zip(
+                        jax.tree_util.tree_leaves(state.params), key)
+                )
+                jit_cache[key] = make_step(z1, flat_param_shardings=key)
+            else:
+                jit_cache[key] = make_step(key)
         return jit_cache[key]
 
     def run(state, batch, rng):

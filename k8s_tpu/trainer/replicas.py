@@ -96,6 +96,10 @@ class RendezvousSpec:
     # KTPU_CKPT_* from spec.checkpointPolicy (+ KTPU_CKPT_PEERS: per-
     # index peer shard endpoints) — the multi-tier checkpoint contract
     checkpoint_env: Optional[Dict[str, str]] = None
+    # KTPU_ZERO1 / KTPU_LATENCY_HIDING from spec.training — the
+    # trainer-mode contract (ZeRO-1 sharded weight update + the
+    # latency-hiding pre-init hook, docs/PERF.md)
+    training_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -118,6 +122,8 @@ class RendezvousSpec:
             env["KTPU_TB_LOGDIR"] = self.tb_log_dir
         if self.checkpoint_env:
             env.update(self.checkpoint_env)
+        if self.training_env:
+            env.update(self.training_env)
         return env
 
 
@@ -380,6 +386,10 @@ class TpuReplicaSet:
                 if self.job.job.spec.tensorboard is not None else ""
             ),
             checkpoint_env=self._checkpoint_env(workers),
+            training_env=(
+                job.job.spec.training.to_env()
+                if job.job.spec.training is not None else None
+            ),
         )
 
     def _checkpoint_env(self, workers) -> Optional[Dict[str, str]]:

@@ -129,3 +129,25 @@ class TestBenches:
         # empty of collectives, but the block itself must be attached)
         assert isinstance(out["collective_budget"], dict)
         assert "collectives" in out["collective_budget"]
+        # per-device HBM residents block (ISSUE 6): the tracked ZeRO-1
+        # memory metric rides this shape
+        hbm = out["hbm_bytes_per_device"]
+        for k in ("params", "grads", "opt_state", "source"):
+            assert k in hbm, k
+        assert hbm["source"] == "abstract_shard_sizes"
+        # replicated adamw: mu+nu ≈ 2x param bytes (opt scalars are noise)
+        assert hbm["opt_state"] >= 2 * hbm["params"] * 0.95
+
+    def test_llama_bench_smoke_zero1_shape(self, capsys):
+        """--zero1 --smoke keeps the full JSON line shape (the bench.py
+        A/B row parses the same keys); on the 1-device smoke mesh DP=1
+        so ZeRO-1 is a documented no-op — the flag must still be
+        reported and the run must still produce a valid row."""
+        from benches import llama_bench
+
+        assert llama_bench.main(["--smoke", "--zero1"]) == 0
+        out = _last_json_line(capsys)
+        assert out["value"] > 0 and out["mode"] == "smoke"
+        assert out["zero1"] is True
+        hbm = out["hbm_bytes_per_device"]
+        assert hbm["params"] > 0 and hbm["opt_state"] > 0

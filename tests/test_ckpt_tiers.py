@@ -331,6 +331,164 @@ class TestRestorePlanner:
 # ---------------------------------------------------------------------------
 
 
+class TestLayoutReshard:
+    """Restoring ACROSS optimizer layouts (ISSUE 6 satellite): a
+    checkpoint whose opt_state leaves were saved replicated restored
+    into a ``zero1=True`` run (sharded template) and the reverse must
+    reshard cleanly — the restored leaves land in the TEMPLATE's
+    placement, so the next jit sees exactly the layout it compiled for
+    instead of a poisoned mixed tree."""
+
+    class FakePersistent:
+        def latest_step(self):
+            return None
+
+        def restore(self, template, step=None):
+            return None
+
+    # ---------------------------------------------------- geometry units
+
+    def test_covering_plan_exact_containing_tiling(self):
+        from k8s_tpu.ckpt import covering_plan
+
+        full = "0:8,0:2"
+        tiles = ["0:4,0:2", "4:8,0:2"]
+        # exact key wins untouched
+        assert covering_plan(full, [full]) == [full]
+        # sharded template vs replicated checkpoint: ONE containing shard
+        assert covering_plan("0:4,0:2", [full]) == [full]
+        # replicated template vs sharded checkpoint: tiles assemble
+        assert sorted(covering_plan(full, tiles)) == tiles
+        # gaps / overlaps are NOT a cover
+        assert covering_plan(full, ["0:4,0:2"]) is None
+        assert covering_plan(full, ["0:6,0:2", "2:8,0:2"]) is None
+        # scalar key: exact or nothing
+        assert covering_plan("-", ["-"]) == ["-"]
+        assert covering_plan("-", ["0:4,0:2"]) is None
+
+    def test_compose_shard_cut_and_assemble(self):
+        from k8s_tpu.ckpt import compose_shard
+
+        full = np.arange(16, dtype=np.float32).reshape(8, 2)
+        store = {"0:8,0:2": full,
+                 "0:4,0:2": full[:4], "4:8,0:2": full[4:]}
+        # cut a slice out of one containing shard
+        got = compose_shard("4:8,0:2", ["0:8,0:2"], store.get)
+        assert np.array_equal(got, full[4:])
+        # assemble the full box from tiles
+        got = compose_shard("0:8,0:2", ["4:8,0:2", "0:4,0:2"], store.get)
+        assert np.array_equal(got, full)
+        # any failed load fails the composition (caller falls back)
+        assert compose_shard(
+            "0:8,0:2", ["4:8,0:2", "0:4,0:2"],
+            lambda k: None if k == "0:4,0:2" else store[k]) is None
+
+    # ------------------------------------------------- restore directions
+
+    def _trees(self, mesh):
+        mu = (jnp.arange(16, dtype=jnp.float32) * 3.0).reshape(8, 2)
+        replicated = {"mu": jax.device_put(
+            mu, NamedSharding(mesh, P()))}
+        z1 = {"mu": jax.device_put(
+            mu, NamedSharding(mesh, P("data", None)))}
+        return replicated, z1
+
+    def test_replicated_ckpt_into_zero1_template(self, tmp_path):
+        mesh = small_mesh()
+        replicated, z1 = self._trees(mesh)
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(5, replicated)
+        planner = RestorePlanner(tier, self.FakePersistent())
+        restored, plan = planner.restore(template_of(z1))
+        assert plan.source == SOURCE_LOCAL and plan.step == 5
+        assert_tree_equal(restored, replicated)
+        assert restored["mu"].sharding == z1["mu"].sharding
+
+    def test_zero1_ckpt_into_replicated_template(self, tmp_path):
+        mesh = small_mesh()
+        replicated, z1 = self._trees(mesh)
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(7, z1)
+        planner = RestorePlanner(tier, self.FakePersistent())
+        restored, plan = planner.restore(template_of(replicated))
+        assert plan.source == SOURCE_LOCAL and plan.step == 7
+        assert_tree_equal(restored, replicated)
+        assert restored["mu"].sharding == replicated["mu"].sharding
+
+    def test_union_covering_plan_units(self):
+        from k8s_tpu.ckpt import union_covering_plan
+
+        full = "0:8,0:2"
+        # single source covering wins first, attributed to that source
+        assert union_covering_plan(full, [(None, {full})]) == [(full, None)]
+        assert union_covering_plan(
+            full, [(None, set()), (1, {"0:4,0:2", "4:8,0:2"})]
+        ) == [("0:4,0:2", 1), ("4:8,0:2", 1)] or union_covering_plan(
+            full, [(None, set()), (1, {"0:4,0:2", "4:8,0:2"})]
+        ) == [("4:8,0:2", 1), ("0:4,0:2", 1)]
+        # the multi-host ZeRO-1 case: tiles spread ACROSS sources
+        got = union_covering_plan(
+            full, [(None, {"0:4,0:2"}), (1, {"4:8,0:2"})])
+        assert got is not None and sorted(got) == [
+            ("0:4,0:2", None), ("4:8,0:2", 1)]
+        # a SINGLE source that covers alone wins before pooling, even
+        # when an earlier source holds a duplicate tile (one-manifest
+        # plans need no cross-host seam)
+        got = union_covering_plan(
+            full, [(None, {"0:4,0:2"}), (1, {"0:4,0:2", "4:8,0:2"})])
+        assert got is not None and all(src == 1 for _, src in got)
+        # gaps / overlaps across sources are still no cover
+        assert union_covering_plan(
+            full, [(None, {"0:4,0:2"}), (1, {"2:8,0:2"})]) is None
+        assert union_covering_plan(
+            full, [(None, {"0:4,0:2"}), (1, set())]) is None
+
+    def test_multihost_zero1_ckpt_into_replicated_template(self, tmp_path):
+        """The cross-MANIFEST reshard: a DP>1 zero1 run checkpoints
+        each opt tile on a DIFFERENT host, so no single manifest covers
+        the replicated template's full leaf — the union does, and the
+        restore assembles own tile + peer tile (plan.tiled) instead of
+        silently falling to the persistent tier."""
+        mesh = small_mesh()
+        replicated, z1 = self._trees(mesh)
+        devs = list(mesh.devices.flat)
+        # virtual hosts along the data axis: host 0 owns tile 0:4,
+        # host 1 owns tile 4:8 of the P("data", None) 8x2 leaf
+        LocalTier(str(tmp_path), host_id=0, sync=True,
+                  devices=devs[:2]).save(11, z1)
+        LocalTier(str(tmp_path), host_id=1, sync=True,
+                  devices=devs[2:]).save(11, z1)
+        planner = RestorePlanner(
+            LocalTier(str(tmp_path), host_id=0, sync=True),
+            self.FakePersistent(),
+            transport=FilesystemPeerTransport(str(tmp_path), self_host=0))
+        restored, plan = planner.restore(template_of(replicated))
+        assert plan.source == SOURCE_LOCAL_PEER and plan.step == 11
+        assert plan.tiled, "full leaf must be tiled across manifests"
+        assert plan.peer_fetches > 0
+        assert_tree_equal(restored, replicated)
+        assert restored["mu"].sharding == replicated["mu"].sharding
+
+    def test_peer_serves_resharded_opt_shards(self, tmp_path):
+        """A replaced pod whose run is ``zero1=True`` fetches its
+        SMALLER per-host opt shards from a peer that checkpointed the
+        replicated layout — the transports route through read_shard,
+        which cuts the requested slice out of the stored full shard."""
+        mesh = small_mesh()
+        replicated, z1 = self._trees(mesh)
+        donor = LocalTier(str(tmp_path), host_id=1, sync=True)
+        donor.save(9, replicated)
+        fresh = LocalTier(str(tmp_path), host_id=0, sync=True)
+        planner = RestorePlanner(
+            fresh, self.FakePersistent(),
+            transport=FilesystemPeerTransport(str(tmp_path), self_host=0))
+        restored, plan = planner.restore(template_of(z1))
+        assert plan.source == SOURCE_LOCAL_PEER and plan.step == 9
+        assert plan.peer_fetches > 0
+        assert_tree_equal(restored, replicated)
+        assert restored["mu"].sharding == z1["mu"].sharding
+
+
 class TestRestPeerWire:
     def test_steps_manifest_and_shard_roundtrip(self, tmp_path):
         mesh = small_mesh()

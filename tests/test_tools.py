@@ -575,6 +575,15 @@ class TestPrograms:
         llama_train.main(r)
         assert "llama-tiny-pp_fsdp" in capsys.readouterr().out
 
+    @pytest.mark.skipif(
+        tuple(int(x) for x in __import__("jax").__version__.split(".")[:2])
+        < (0, 5),
+        reason="in-process orbax restore-then-train aborts in glibc on "
+               "jax 0.4.x CPU (the restored-worker heap bug "
+               "test_e2e_distributed._xfail_if_glibc_heap_bug guards in "
+               "subprocess e2es) — here the segfault would kill the "
+               "whole tier-1 pytest process, not one test",
+    )
     def test_llama_checkpoint_resume(self, tmp_path, capsys):
         from k8s_tpu.programs import llama_train
 
