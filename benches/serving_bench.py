@@ -47,6 +47,20 @@ timer thread and served in real wall-clock time.
 
 ``--smoke`` shrinks everything to a seconds-scale CPU run that still
 emits the full JSON line shape (CI's `serving-sched` stage tracks it).
+
+**Fleet mode** (``--fleet N``): spin N replicas behind the prefix-aware
+router (`k8s_tpu/router`) and report aggregate throughput + TTFT/ITL
+percentiles vs the SAME workload through a single replica, plus an
+affinity phase (repeated-system-prompt traffic through REAL engines)
+reporting the router's affinity hit rate and the engines' measured
+prefix-reuse savings. The throughput phase uses real engines on an
+accelerator; on CPU (and always with ``--smoke``) it uses PACED
+stand-in replicas (`StandinEngine`): a single REAL engine saturates a
+shared-CPU host, so only a per-replica roofline made explicit
+(``--fleet-round-wall``) honestly models N chip-bound replicas — the
+same modeled-baseline methodology as the static-server walls above.
+What the phase measures is the ROUTER: that fan-out over N replica
+ceilings yields ~N× aggregate with real HTTP forwarding in the path.
 """
 
 from __future__ import annotations
@@ -109,6 +123,163 @@ def _round_up(n, g):
     return -(-n // g) * g
 
 
+def _tiny_real_engines(n, *, prefix_cache_tokens=0, max_slots=2,
+                       decode_chunk=4):
+    """N real tiny continuous-batching engines (CPU-friendly) sharing
+    one params tree — the affinity phase's measured engines and the
+    in-process real-fleet option."""
+    import dataclasses as dc
+
+    import flax.linen as nn
+
+    from k8s_tpu.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig.tiny(decode=True, max_seq_len=64, scan_layers=False)
+    params = nn.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    model = LlamaForCausalLM(dc.replace(cfg, ragged_decode=True))
+    return [
+        ContinuousBatchingEngine(
+            model, params, max_slots=max_slots, decode_chunk=decode_chunk,
+            prompt_buckets=(4, 8, 16), prefill_chunk=4,
+            prefix_cache_tokens=prefix_cache_tokens)
+        for _ in range(n)
+    ], cfg.vocab_size
+
+
+def _run_fleet(args, on_accel: bool) -> int:
+    """``--fleet N``: aggregate throughput through the router over N
+    replicas vs the identical workload through 1, plus the affinity /
+    prefix-reuse phase on real engines. See module docstring for why
+    the CPU/smoke throughput phase paces stand-in replicas."""
+    import threading as th
+
+    from k8s_tpu.router import LocalFleet, StandinEngine
+
+    engine_kind = args.fleet_engine
+    if engine_kind == "auto":
+        engine_kind = "real" if (on_accel and not args.smoke) else "standin"
+
+    rng = np.random.RandomState(0)
+    n_req = args.requests
+    vocab = 4093
+    # standard mix, DISTINCT prompts (distinct prefixes): affinity does
+    # not pin them, so least-load scoring spreads the fleet
+    plens = rng.randint(2, args.max_prompt + 1, size=n_req)
+    news = rng.randint(max(1, args.max_new // 2), args.max_new + 1,
+                       size=n_req)
+    prompts = [rng.randint(0, vocab, size=n).astype(np.int32)
+               for n in plens]
+    if args.arrival_rate > 0:
+        gaps = rng.exponential(1.0 / args.arrival_rate, size=n_req)
+        arrivals = np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+    else:
+        arrivals = np.zeros(n_req)
+
+    def build_engines(n):
+        if engine_kind == "standin":
+            return [StandinEngine(
+                max_slots=args.slots, decode_chunk=args.decode_chunk,
+                round_wall_s=args.fleet_round_wall,
+                prefill_chunk=args.prefill_chunk, vocab=vocab)
+                for _ in range(n)]
+        engines, _ = _tiny_real_engines(
+            n, max_slots=args.slots, decode_chunk=args.decode_chunk)
+        return engines
+
+    def run_through_router(n_replicas):
+        fleet = LocalFleet(build_engines(n_replicas)).start()
+        results = [None] * n_req
+        t0 = time.perf_counter()
+
+        def one(i):
+            dt = t0 + arrivals[i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            code, body = fleet.generate(prompts[i], int(news[i]))
+            results[i] = (code, body)
+
+        threads = [th.Thread(target=one, args=(i,)) for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        codes = [r[0] for r in results]
+        assert codes == [200] * n_req, codes
+        useful = sum(len(r[1]["tokens"]) for r in results)
+        ttft = [r[1].get("ttft_s") or 0.0 for r in results]
+        itl = [r[1].get("itl_ms") or 0.0 for r in results]
+        health = fleet.router.healthz()
+        fleet.stop()
+        tp50, tp95 = _pcts(ttft)
+        ip50, ip95 = _pcts(itl)
+        return {
+            "_raw_tps": useful / wall,
+            "tokens_per_sec": round(useful / wall, 1),
+            "ttft_p50_s": round(tp50, 3), "ttft_p95_s": round(tp95, 3),
+            "itl_p50_ms": round(ip50, 2), "itl_p95_ms": round(ip95, 2),
+            "routed": health["routed"], "retries": health["retries"],
+            "per_replica": {k: v["routed"]
+                            for k, v in health["replicas"].items()},
+        }
+
+    fleet_m = run_through_router(args.fleet)
+    single_m = run_through_router(1)
+
+    # -- affinity phase: REAL engines, repeated-system-prompt traffic --
+    # sequential requests sharing one system prefix: the router pins
+    # them to one replica (affinity hits) and that replica's engine
+    # reuses the cached prefix KV (measured prefill tokens saved)
+    prefix_tokens = 8
+    engines, vsz = _tiny_real_engines(
+        2, prefix_cache_tokens=prefix_tokens)
+    fleet = LocalFleet(
+        engines,
+        router_kwargs={"prefix_tokens": prefix_tokens}).start()
+    sys_prompt = rng.randint(0, vsz, size=10).astype(np.int32)
+    n_aff = 6
+    for i in range(n_aff):
+        tail = rng.randint(0, vsz, size=3 + i % 3).astype(np.int32)
+        code, body = fleet.generate(
+            np.concatenate([sys_prompt, tail]), 4)
+        assert code == 200, body
+    health = fleet.router.healthz()
+    saved = sum(e.stats["prefix_tokens_saved"] for e in engines)
+    hits = health["affinity"]["hits"]
+    denom = max(1, hits + health["affinity"]["misses"]
+                + health["affinity"]["fallbacks"])
+    fleet.stop()
+
+    result = {
+        "metric": "serving_fleet_tokens_per_sec",
+        "value": fleet_m["tokens_per_sec"],
+        "unit": "useful tokens/sec",
+        "fleet": args.fleet,
+        "fleet_engine": engine_kind,
+        "requests": n_req,
+        "slots": args.slots,
+        "decode_chunk": args.decode_chunk,
+        "arrival_rate": args.arrival_rate,
+        "round_wall_s": (args.fleet_round_wall
+                         if engine_kind == "standin" else 0),
+        "single_tokens_per_sec": single_m["tokens_per_sec"],
+        "fleet_speedup": round(
+            fleet_m["_raw_tps"] / max(1e-9, single_m["_raw_tps"]), 2),
+        "affinity_hit_rate": round(hits / denom, 3),
+        "affinity_hits": hits,
+        "prefix_tokens_saved": int(saved),
+        "retries": fleet_m["retries"],
+        "per_replica_routed": fleet_m["per_replica"],
+    }
+    for k in ("tokens_per_sec", "ttft_p50_s", "ttft_p95_s",
+              "itl_p50_ms", "itl_p95_ms"):
+        result[k] = fleet_m[k]
+        result[f"single_{k}"] = single_m[k]
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serving-bench")
     # None = per-platform default (full 705M workload on accelerator,
@@ -157,6 +328,20 @@ def main(argv=None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="seconds-scale CPU run emitting the full JSON "
                         "shape (CI serving-sched harness tracking)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="N > 0: run N replicas behind the router and "
+                        "report aggregate throughput + TTFT/ITL vs a "
+                        "single replica, plus the affinity phase "
+                        "(docs/SERVING.md Fleet)")
+    p.add_argument("--fleet-engine", default="auto",
+                   choices=["auto", "standin", "real"],
+                   help="fleet throughput-phase replicas: paced "
+                        "stand-ins (router-scaling measurement, the "
+                        "CPU/smoke default) or real engines (chip "
+                        "scaling, the accelerator default)")
+    p.add_argument("--fleet-round-wall", type=float, default=0.02,
+                   help="stand-in replica roofline: wall seconds per "
+                        "engine pump round")
     p.add_argument("--cpu-model", default="tiny", choices=["tiny", "small"],
                    help="CPU-backend model size: 'small' (~30M) makes "
                         "step compute dominate dispatch, the "
@@ -180,6 +365,12 @@ def main(argv=None) -> int:
         platform_defaults = dict(requests=6, slots=2, decode_chunk=2,
                                  max_prompt=8, max_new=6, long_frac=0.25,
                                  prefill_chunk=8)
+        if args.fleet > 0:
+            # the fleet smoke measures router fan-out over paced
+            # replicas: enough requests/tokens that per-replica
+            # service time dominates the fixed HTTP/poll overheads
+            platform_defaults.update(requests=16, decode_chunk=8,
+                                     max_new=24)
     elif on_accel:
         platform_defaults = dict(requests=32, slots=8, decode_chunk=32,
                                  max_prompt=512, max_new=256,
@@ -191,6 +382,9 @@ def main(argv=None) -> int:
     for k, v in platform_defaults.items():
         if getattr(args, k) is None:
             setattr(args, k, v)
+
+    if args.fleet > 0:
+        return _run_fleet(args, on_accel)
 
     if on_accel and not args.smoke:
         buckets = tuple(b for b in (128, 256, 512, 1024, 2048)

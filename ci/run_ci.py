@@ -122,6 +122,21 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_sched.xml"],
             args.artifacts_dir, cases,
         )
+        # serving-fleet gate (ISSUE 7): router scoring/affinity units,
+        # the create → route → kill-one → drain sequence over stand-in
+        # engines, autoscaler hysteresis, and the spec.serving operator
+        # round-trip — plus the fleet bench's --smoke JSON-shape check.
+        # Always on and fast: a router regression (a dropped in-flight
+        # request on replica loss, an affinity flap) fails in seconds.
+        ok = ok and stage(
+            "serving-fleet",
+            [py, "-m", "pytest", "tests/test_router.py",
+             "tests/test_benches.py::TestBenches"
+             "::test_serving_fleet_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_serving_fleet.xml"],
+            args.artifacts_dir, cases,
+        )
         # checkpoint-tier gate (ISSUE 4): commit-marker protocol,
         # restore-planner tier selection, and the peer-fetch unit path
         # (filesystem + REST shard wire) — always on and fast, so a
@@ -158,11 +173,15 @@ def main(argv=None) -> int:
         marker = "not slow and not integration" if args.skip_slow else "not slow"
         pytest_cmd = [py, "-m", "pytest", "tests/", "-x", "-q", "-m", marker,
                       # already ran (and gated) in the serving-sched /
-                      # ckpt-tiers stages above — don't pay for them twice
+                      # serving-fleet / ckpt-tiers stages above — don't
+                      # pay for them twice
                       "--ignore=tests/test_serving_sched.py",
+                      "--ignore=tests/test_router.py",
                       "--ignore=tests/test_ckpt_tiers.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_serving_fleet_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(

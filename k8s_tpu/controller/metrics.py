@@ -179,3 +179,35 @@ CKPT_OVERHEAD_FRACTION = REGISTRY.gauge(
     "ktpu_ckpt_overhead_fraction",
     "Fraction of loop wall-clock spent in checkpoint saves",
 )
+# Serving fleet (k8s_tpu/router, docs/SERVING.md "Fleet"). Registered
+# process-global like the ckpt series: the router program's /metrics
+# and any operator health port expose them without new plumbing.
+ROUTER_REQUESTS = REGISTRY.counter(
+    "ktpu_router_requests_total",
+    "Requests routed (forward attempts), by replica index",
+)
+ROUTER_RETRIES = REGISTRY.counter(
+    "ktpu_router_retries_total",
+    "Forwards retried on a peer after a replica-side failure, by the "
+    "replica that failed",
+)
+ROUTER_AFFINITY_HITS = REGISTRY.counter(
+    "ktpu_router_affinity_hits_total",
+    "Requests routed to their warm prefix-affine replica",
+)
+ROUTER_AFFINITY_FALLBACKS = REGISTRY.counter(
+    "ktpu_router_affinity_fallbacks_total",
+    "Affine replica saturated/dead; fell back to the score winner",
+)
+ROUTER_REPLICAS_READY = REGISTRY.gauge(
+    "ktpu_router_replicas_ready",
+    "Replicas the router currently considers routable",
+)
+SERVING_SCALE_EVENTS = REGISTRY.counter(
+    "ktpu_router_scale_events_total",
+    "SLO-autoscaler replica-count changes, by direction",
+)
+SERVING_REPLICAS = REGISTRY.gauge(
+    "ktpu_router_serving_replicas",
+    "Current desired serving replica count per job",
+)

@@ -1,0 +1,89 @@
+"""Serving-fleet router as an operator workload.
+
+The fleet's front door (``spec.serving``, docs/SERVING.md "Fleet"):
+the operator materializes N engine pods plus ONE pod running this
+program, with ``KTPU_SERVING_PEERS`` naming every engine replica's
+per-index Service endpoint — the same env plumbing the checkpoint
+peer-shard wire uses, so on a real cluster the names are stable DNS
+and under the local kubelet they are rewritten to loopback ports by
+the service resolver. The router needs no devices: it is a pure
+control/data-plane process (stats polling + request forwarding).
+
+Run config (``KTPU_PROGRAM_ARGS``):
+  --port=N              HTTP port; default: the KTPU_ROUTER_ADVERTISE
+                        port (operator fleets), else 0 = ephemeral
+                        (printed in the router_ready event)
+  --host=ADDR           bind address (default 0.0.0.0)
+  --peers=SPEC          "0=http://h:p,1=..." replica endpoints
+                        (default: KTPU_SERVING_PEERS)
+  --poll_interval=F     stats poll cadence in seconds (default 0.5)
+  --prefix_tokens=N     affinity prefix length (default
+                        KTPU_ROUTER_PREFIX_TOKENS or 16)
+  --saturation_depth=F  load score at/over which the affine replica is
+                        bypassed (default 8)
+  --request_timeout=F   per-forward timeout seconds (default 300)
+
+Lifecycle events (machine-readable JSON lines, asserted by the fleet
+e2e): ``router_ready`` (port, peers) once routing; ``router_drained``
+(routed count) after the SIGTERM-triggered drain. Router jobs run
+until deleted, exactly like serving jobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from k8s_tpu.programs.common import (
+    mark_preempt_aware,
+    parse_run_config,
+    preempt_requested,
+)
+from k8s_tpu.router import Router, parse_peers
+
+
+def main(rdzv) -> None:
+    cfg = parse_run_config(rdzv, {"steps": 0, "batch_size": 1})
+    extra = cfg.extra or {}
+    peers = parse_peers(
+        extra.get("peers", os.environ.get("KTPU_SERVING_PEERS", "")))
+    if not peers:
+        raise ValueError(
+            "router has no replica endpoints: set KTPU_SERVING_PEERS "
+            "(spec.serving does this) or pass --peers")
+    advertise = os.environ.get("KTPU_ROUTER_ADVERTISE", "")
+    adv_port = 0
+    if advertise and ":" in advertise:
+        try:
+            adv_port = int(advertise.rsplit(":", 1)[1])
+        except ValueError:
+            adv_port = 0
+    port = int(extra.get("port", str(adv_port)))
+    host = extra.get("host", "0.0.0.0")
+    router = Router(
+        peers,
+        host=host,
+        port=port,
+        poll_interval=float(extra.get("poll_interval", "0.5")),
+        prefix_tokens=int(extra.get(
+            "prefix_tokens",
+            os.environ.get("KTPU_ROUTER_PREFIX_TOKENS", "16"))),
+        saturation_depth=float(extra.get("saturation_depth", "8")),
+        request_timeout=float(extra.get("request_timeout", "300")),
+    ).start()
+    mark_preempt_aware()  # drain in the SIGTERM grace period
+    print(json.dumps({
+        "event": "router_ready", "port": router.port,
+        "pid": os.getpid(),
+        "peers": {str(i): u for i, u in sorted(
+            (r.index, r.url) for r in router.replicas.values())},
+        "prefix_tokens": router.prefix_tokens,
+    }), flush=True)
+    while not preempt_requested():
+        time.sleep(0.1)
+    router.drain()
+    print(json.dumps({
+        "event": "router_drained", "routed": router.routed_total,
+        "retries": router.retries,
+    }), flush=True)

@@ -32,9 +32,23 @@ Run config (``KTPU_PROGRAM_ARGS``):
   --temperature=F           0 = greedy (default)
   --eos_id=N                stop token (default: none)
   --port=N                  HTTP port; 0 (default) binds ephemeral and
-                            prints it in the serving_ready event
+                            prints it in the serving_ready event. When
+                            the operator injected KTPU_SERVING_ADVERTISE
+                            (spec.serving fleets: "<svc-dns>:<port>",
+                            rewritten to a loopback endpoint by the
+                            local kubelet's service resolver), its port
+                            is the default — the replica then listens
+                            exactly where the router's peer env points.
   --host=ADDR               bind address (default 0.0.0.0 — the pod's
                             Service endpoint must reach the listener)
+  --max_queue_depth=N       backpressure: refuse (HTTP 429+Retry-After)
+                            when the engine queue is this deep (default
+                            KTPU_SERVING_MAX_QUEUE or 0 = unbounded)
+  --prefix_cache_tokens=N   shared-prefix KV reuse: cache the working-
+                            cache KV of each distinct N-token prompt
+                            prefix, skipping its re-prefill on repeat
+                            (default KTPU_SERVING_PREFIX_TOKENS or 0)
+  --prefix_cache_max=N      prefix LRU capacity (default 8)
   --quant=int8_serving      weight-only int8
   --kv_quant=int8           int8 KV cache
   --unroll_layers=0|1       unrolled decode layout (default 1)
@@ -50,6 +64,7 @@ kubelet grace period, and exits 0.
 from __future__ import annotations
 
 import json
+import os
 
 import jax
 
@@ -84,7 +99,22 @@ def main(rdzv) -> None:
         if "max_tokens_per_round" in extra else None)
     temperature = float(extra.get("temperature", "0"))
     eos_id = int(extra["eos_id"]) if "eos_id" in extra else None
-    port = int(extra.get("port", "0"))
+    # fleet contract: the operator advertises this replica's endpoint
+    # as "<svc-dns>:<port>" — bind that port unless --port overrides
+    advertise = os.environ.get("KTPU_SERVING_ADVERTISE", "")
+    adv_port = 0
+    if advertise and ":" in advertise:
+        try:
+            adv_port = int(advertise.rsplit(":", 1)[1])
+        except ValueError:
+            adv_port = 0
+    port = int(extra.get("port", str(adv_port)))
+    max_queue_depth = int(extra.get(
+        "max_queue_depth", os.environ.get("KTPU_SERVING_MAX_QUEUE", "0")))
+    prefix_cache_tokens = int(extra.get(
+        "prefix_cache_tokens",
+        os.environ.get("KTPU_SERVING_PREFIX_TOKENS", "0")))
+    prefix_cache_max = int(extra.get("prefix_cache_max", "8"))
     # 0.0.0.0: the pod's Service endpoint must reach the listener —
     # loopback (the library/test default) would make an operator-
     # deployed server unreachable from outside the pod
@@ -124,17 +154,25 @@ def main(rdzv) -> None:
         pipeline_depth=pipeline_depth,
         chunked_prefill=chunked_prefill, prefill_chunk=prefill_chunk,
         max_tokens_per_round=max_tokens_per_round,
+        prefix_cache_tokens=prefix_cache_tokens,
+        prefix_cache_max=prefix_cache_max,
     )
-    frontend = ServingFrontend(engine, host=host, port=port)
+    frontend = ServingFrontend(engine, host=host, port=port,
+                               max_queue_depth=max_queue_depth)
     # use the SIGTERM grace period to drain instead of dying mid-request
     mark_preempt_aware()
+    replica = os.environ.get("KTPU_SERVING_REPLICA", "")
     print(json.dumps({
         "event": "serving_ready", "port": frontend.port,
+        "pid": os.getpid(),
+        "replica": int(replica) if replica else None,
         "model": model_name, "max_slots": max_slots,
         "decode_chunk": decode_chunk, "prompt_buckets": buckets,
         "chunked_prefill": chunked_prefill,
         "prefill_chunk": engine.prefill_chunk,
         "max_tokens_per_round": engine.max_tokens_per_round,
+        "max_queue_depth": max_queue_depth,
+        "prefix_cache_tokens": prefix_cache_tokens,
         "restored": bool(cfg.checkpoint_dir),
     }), flush=True)
     frontend.serve(should_stop=preempt_requested)

@@ -1,0 +1,27 @@
+"""Serving fleet: prefix-aware router + SLO autoscaler (ROADMAP item 1).
+
+The subsystem that composes the pieces the repo already had — per-index
+Services with stable DNS, ``/healthz`` engine stats, chunked-prefill
+working caches — into an autoscaled multi-replica serving fleet:
+
+- :class:`Router` — the HTTP front door: live stats polling, prefix-
+  affinity + least-load scoring, retry-on-peer (``router.py``);
+- :class:`SloAutoscaler` — the reconciler-side scaling decision against
+  TTFT/ITL SLOs, Backoff-damped (``autoscaler.py``);
+- :class:`LocalFleet` / :class:`StandinEngine` — the in-process harness
+  behind ``serving_bench --fleet``, the router tests, and the
+  ``router-*`` chaos faults (``fleet.py``).
+
+Operator wiring lives in ``spec.serving`` (``spec/tpu_job.py``) and
+``trainer/replicas.py``; the deployable entrypoint is
+``programs/router.py``. docs/SERVING.md "Fleet" is the user story.
+"""
+
+from k8s_tpu.router.autoscaler import SloAutoscaler  # noqa: F401
+from k8s_tpu.router.fleet import LocalFleet, StandinEngine  # noqa: F401
+from k8s_tpu.router.router import (  # noqa: F401
+    Replica,
+    Router,
+    parse_peers,
+    prefix_key,
+)

@@ -1,0 +1,145 @@
+"""SLO autoscaler: scales the serving-replica count on router-observed
+TTFT/ITL percentiles.
+
+ParvaGPU (PAPERS.md) is the reference shape — SLO-driven capacity for
+large-scale inference. Here the signal is the router's aggregated
+``slo`` block (``/healthz``), the actuator is the reconciler mutating
+the WORKER replica count within ``[minReplicas, maxReplicas]``
+(``k8s_tpu/trainer/training.py``), and this module is the pure DECISION
+function between them — fully deterministic under an injected clock, so
+tier-1 pins the hysteresis behavior with zero wall-clock sleeps.
+
+Flap damping, two independent mechanisms:
+
+- **Streak hysteresis.** A scale-up needs ``breach_ticks`` CONSECUTIVE
+  observations over the SLO; a scale-down needs ``clear_ticks``
+  consecutive observations under ``scale_down_margin * SLO``. The band
+  between the two thresholds is dead: streaks reset, nothing moves —
+  a p95 oscillating around the SLO boundary cannot flap the fleet.
+- **Backoff hold-off.** Every scale event arms the PR-1 ``Backoff``
+  (the same policy object every retry site uses): further scale events
+  are held until the delay elapses, and consecutive events escalate
+  the hold geometrically. A long stable period (``reset_after``)
+  earns back a fast first reaction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
+
+# deterministic by default (jitter would desync the tier-1 fixtures;
+# one autoscaler per job means there is no thundering herd to break up)
+DEFAULT_HOLD = BackoffPolicy(
+    base=30.0, factor=2.0, cap=600.0, jitter=0.0, reset_after=900.0)
+
+
+class SloAutoscaler:
+    """Decide the desired replica count from one SLO observation.
+
+    Call :meth:`observe` once per reconcile tick with the current
+    replica count and the router's ``slo`` block; it returns
+    ``(desired, reason)`` — ``desired == current`` means hold.
+    """
+
+    def __init__(
+        self,
+        min_replicas: int,
+        max_replicas: int,
+        *,
+        slo_ttft_ms: float = 0.0,
+        slo_itl_ms: float = 0.0,
+        breach_ticks: int = 2,
+        clear_ticks: int = 4,
+        scale_down_margin: float = 0.5,
+        hold_policy: Optional[BackoffPolicy] = None,
+        seed: Optional[int] = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"[{min_replicas}, {max_replicas}]")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.slo_itl_ms = float(slo_itl_ms)
+        self.breach_ticks = max(1, int(breach_ticks))
+        self.clear_ticks = max(1, int(clear_ticks))
+        self.scale_down_margin = float(scale_down_margin)
+        self._hold = Backoff(hold_policy or DEFAULT_HOLD,
+                             seed=seed, clock=clock)
+        self._breach_streak = 0
+        self._clear_streak = 0
+        self.scale_events = 0
+
+    @property
+    def enabled(self) -> bool:
+        """Autoscaling is live iff an SLO is set AND there is a range
+        to move in."""
+        return (self.slo_ttft_ms > 0 or self.slo_itl_ms > 0) \
+            and self.max_replicas > self.min_replicas
+
+    def _classify(self, slo: dict) -> str:
+        """One observation → breach / clear / neutral / no-data."""
+        if not slo or not slo.get("window"):
+            return "no-data"
+        ttft = float(slo.get("ttft_p95_ms") or 0.0)
+        itl = float(slo.get("itl_p95_ms") or 0.0)
+        breach = (self.slo_ttft_ms > 0 and ttft > self.slo_ttft_ms) or \
+                 (self.slo_itl_ms > 0 and itl > self.slo_itl_ms)
+        if breach:
+            return "breach"
+        clear = True
+        if self.slo_ttft_ms > 0 and \
+                ttft > self.slo_ttft_ms * self.scale_down_margin:
+            clear = False
+        if self.slo_itl_ms > 0 and \
+                itl > self.slo_itl_ms * self.scale_down_margin:
+            clear = False
+        return "clear" if clear else "neutral"
+
+    def observe(self, current: int, slo: dict) -> Tuple[int, str]:
+        """One tick: returns ``(desired replicas, reason)``."""
+        if not self.enabled:
+            return current, "autoscale disabled"
+        verdict = self._classify(slo)
+        if verdict == "breach":
+            self._breach_streak += 1
+            self._clear_streak = 0
+        elif verdict == "clear":
+            self._clear_streak += 1
+            self._breach_streak = 0
+        else:
+            # neutral band / no data: both streaks reset — this is the
+            # hysteresis dead zone that kills boundary flap
+            self._breach_streak = 0
+            self._clear_streak = 0
+            return current, verdict
+        hold = self._hold.remaining()
+        if self._breach_streak >= self.breach_ticks:
+            if current >= self.max_replicas:
+                return current, "breach at maxReplicas"
+            if hold > 0:
+                return current, f"breach held {hold:.1f}s by backoff"
+            self._scale_event()
+            return current + 1, (
+                f"p95 over SLO for {self.breach_ticks} ticks")
+        if self._clear_streak >= self.clear_ticks:
+            if current <= self.min_replicas:
+                return current, "clear at minReplicas"
+            if hold > 0:
+                return current, f"scale-down held {hold:.1f}s by backoff"
+            self._scale_event()
+            return current - 1, (
+                f"p95 under {self.scale_down_margin:g}x SLO for "
+                f"{self.clear_ticks} ticks")
+        return current, verdict
+
+    def _scale_event(self) -> None:
+        self.scale_events += 1
+        self._hold.note_failure()  # arms the hold-off for the NEXT event
+        self._breach_streak = 0
+        self._clear_streak = 0

@@ -1,0 +1,321 @@
+"""In-process serving fleet harness: N replicas behind a Router.
+
+Three consumers, one harness:
+
+- ``serving_bench --fleet N`` measures aggregate throughput and
+  TTFT/ITL percentiles through the real router;
+- ``tests/test_router.py`` / the ``serving-fleet`` CI stage drive the
+  create → route → kill-one → drain sequence;
+- the chaos faults ``router-replica-loss`` / ``router-stats-flake``
+  (``k8s_tpu/runtime/chaos.py``) operate on it.
+
+Each replica is a real :class:`~k8s_tpu.serving.server.ServingFrontend`
+(real HTTP, real backpressure, real drain semantics) over either a real
+:class:`~k8s_tpu.serving.engine.ContinuousBatchingEngine` or a
+:class:`StandinEngine`. The stand-in keeps the engine's *scheduling*
+contract — slots, admission queue, chunked decode cadence, stats block,
+deterministic tokens — but replaces device compute with a calibrated
+per-round wall. That is the same modeled-baseline methodology the
+serving bench already uses for its static server: on a shared-CPU CI
+box a single REAL engine saturates the whole machine, so only paced
+stand-ins can honestly show what routing N chip-bound replicas buys
+(each real chip would pace itself; the stand-in's ``round_wall_s`` is
+that pace made explicit).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from k8s_tpu.router.router import Router
+from k8s_tpu.serving.server import ServingFrontend
+
+
+class _Req:
+    """Request bookkeeping mirroring the engine's ``Request`` fields
+    that the front-end reads at resolution time."""
+
+    def __init__(self, rid, prompt, max_new):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.tokens: List[int] = []
+        self.done = False
+        self.submitted_at = time.perf_counter()
+        self.first_token_at = 0.0
+        self.finished_at = 0.0
+        self.prefill_remaining = int(len(prompt))
+        self.token_times: List = []
+
+
+class StandinEngine:
+    """Engine-interface stand-in with a virtual chip roofline.
+
+    One :meth:`step` is one pump round: admit queued requests into free
+    slots, spend ``round_wall_s`` of wall clock (the modeled compute),
+    advance every active slot by up to ``decode_chunk`` tokens — after
+    its prompt's prefill chunks are paid down at ``prefill_chunk``
+    tokens per round. Tokens are a deterministic function of the prompt
+    alone, so a retried request served by a PEER stand-in returns the
+    identical stream (the router retry oracle)."""
+
+    def __init__(self, *, max_slots: int = 2, decode_chunk: int = 8,
+                 round_wall_s: float = 0.01, prefill_chunk: int = 32,
+                 vocab: int = 4093):
+        self.max_slots = int(max_slots)
+        self.decode_chunk = int(decode_chunk)
+        self.round_wall_s = float(round_wall_s)
+        self.prefill_chunk = int(prefill_chunk)
+        self.chunked_prefill = True
+        self.max_tokens_per_round = (
+            self.prefill_chunk + self.max_slots * self.decode_chunk)
+        self.vocab = int(vocab)
+        self._lock = threading.Lock()
+        self._queue: List[_Req] = []
+        self._slots: List[Optional[_Req]] = [None] * self.max_slots
+        self._done: Dict[int, _Req] = {}
+        self._rid = itertools.count()
+        self._closed = False
+        self.stats = {"prefills": 0, "chunks": 0, "decode_steps": 0,
+                      "prefill_chunks": 0, "prefill_tokens": 0,
+                      "queue_depth": 0, "ttft_s_sum": 0.0,
+                      "ttft_count": 0, "prefix_hits": 0,
+                      "prefix_misses": 0, "prefix_captures": 0,
+                      "prefix_tokens_saved": 0}
+
+    # -- engine surface ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            req = _Req(next(self._rid), prompt, max_new_tokens)
+            self._queue.append(req)
+        return req.rid
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def prefill_progress(self) -> dict:
+        out = {}
+        for r in self._slots:
+            if r is not None and r.prefill_remaining > 0:
+                out[r.rid] = {
+                    "done": int(len(r.prompt)) - r.prefill_remaining,
+                    "total": int(len(r.prompt))}
+        return out
+
+    def _token(self, req: _Req, j: int) -> int:
+        return int((int(req.prompt.sum()) * 7919 + 31 * j) % self.vocab)
+
+    def step(self) -> bool:
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        with self._lock:
+            for i in range(self.max_slots):
+                if self._slots[i] is None and self._queue:
+                    self._slots[i] = self._queue.pop(0)
+                    self.stats["prefills"] += 1
+            self.stats["queue_depth"] = len(self._queue)
+            active = [r for r in self._slots if r is not None]
+        if not active:
+            return bool(self._queue)
+        time.sleep(self.round_wall_s)  # the virtual roofline
+        now = time.perf_counter()
+        self.stats["chunks"] += 1
+        with self._lock:
+            for i in range(self.max_slots):
+                req = self._slots[i]
+                if req is None:
+                    continue
+                if req.prefill_remaining > 0:
+                    paid = min(self.prefill_chunk, req.prefill_remaining)
+                    req.prefill_remaining -= paid
+                    self.stats["prefill_chunks"] += 1
+                    self.stats["prefill_tokens"] += paid
+                    continue
+                base = len(req.tokens)
+                k = min(self.decode_chunk, req.max_new - base)
+                req.tokens.extend(
+                    [self._token(req, base + j) for j in range(k)])
+                self.stats["decode_steps"] += k
+                if not req.token_times:
+                    req.first_token_at = now
+                    self.stats["ttft_s_sum"] += now - req.submitted_at
+                    self.stats["ttft_count"] += 1
+                req.token_times.append((now, k))
+                if len(req.tokens) >= req.max_new:
+                    req.done = True
+                    req.finished_at = now
+                    self._done[req.rid] = req
+                    self._slots[i] = None
+            busy = bool(self._queue
+                        or any(r is not None for r in self._slots))
+        return busy
+
+    def pop_finished(self) -> Dict[int, _Req]:
+        with self._lock:
+            done, self._done = self._done, {}
+        return done
+
+    def run(self):
+        while self.step():
+            pass
+        return {rid: np.asarray(r.tokens)
+                for rid, r in self.pop_finished().items()}
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+
+class LocalFleet:
+    """N in-process replicas + router. ``engines`` may be real
+    continuous-batching engines or :class:`StandinEngine`\\ s; each gets
+    its own ``ServingFrontend`` on an ephemeral loopback port and a
+    dedicated pump thread (the engine's single-scheduler contract)."""
+
+    def __init__(self, engines, *, max_queue_depth: int = 0,
+                 router_kwargs: Optional[dict] = None):
+        self.engines = list(engines)
+        self.frontends = [
+            ServingFrontend(e, host="127.0.0.1", port=0,
+                            max_queue_depth=max_queue_depth)
+            for e in self.engines
+        ]
+        self._stops = [threading.Event() for _ in self.engines]
+        self._pumps: List[threading.Thread] = []
+        self._killed: set = set()
+        kwargs = dict(router_kwargs or {})
+        kwargs.setdefault("poll_interval", 0.2)
+        self.router = Router(
+            {i: f"http://127.0.0.1:{fe.port}"
+             for i, fe in enumerate(self.frontends)},
+            **kwargs)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _pump(self, i: int) -> None:
+        fe, stop = self.frontends[i], self._stops[i]
+        try:
+            while not stop.is_set():
+                busy = fe.engine.step()
+                fe._resolve_finished()
+                if not busy:
+                    fe._work.wait(0.02)
+                    fe._work.clear()
+        except Exception:
+            # a killed engine raises out of step(); the kill path has
+            # already released the waiters
+            pass
+
+    def start(self, wait_ready: bool = True) -> "LocalFleet":
+        for i, fe in enumerate(self.frontends):
+            fe._http_thread.start()
+            t = threading.Thread(target=self._pump, args=(i,),
+                                 daemon=True, name=f"fleet-pump-{i}")
+            t.start()
+            self._pumps.append(t)
+        self.router.start()
+        if wait_ready:
+            self.router._poll_once()  # all replicas READY before use
+        return self
+
+    def stop(self) -> None:
+        self.router.drain()
+        for i, fe in enumerate(self.frontends):
+            self._stops[i].set()
+        for t in self._pumps:
+            t.join(timeout=10)
+        for i, fe in enumerate(self.frontends):
+            if i in self._killed:
+                continue
+            try:
+                fe.drain()
+            except Exception:
+                pass
+
+    # -- fault surface (chaos + tests) ------------------------------------
+
+    def alive(self) -> List[int]:
+        return [i for i in range(len(self.frontends))
+                if i not in self._killed]
+
+    def kill_replica(self, i: int) -> None:
+        """Crash replica ``i`` abruptly: stop its pump mid-flight,
+        close its listener, and fail its parked requests — in-flight
+        forwards then see a replica-side error and the router must
+        retry them on a peer."""
+        if i in self._killed:
+            return
+        self._killed.add(i)
+        fe = self.frontends[i]
+        self._stops[i].set()
+        fe._server.shutdown()
+        fe._server.server_close()
+        with fe._lock:
+            fe._draining = True
+            for rid, ev in list(fe._waiters.items()):
+                fe._results[rid] = RuntimeError("chaos: replica killed")
+                ev.set()
+            fe._waiters.clear()
+        try:
+            fe.engine.close()
+        except Exception:
+            pass
+
+    def kill_random_replica(self, rng) -> Optional[int]:
+        """Kill one randomly chosen live replica, always leaving at
+        least one standing (an empty fleet is the separate
+        total-outage scenario)."""
+        alive = self.alive()
+        if len(alive) <= 1:
+            return None
+        victim = alive[rng.randrange(len(alive))]
+        self.kill_replica(victim)
+        return victim
+
+    def flake_stats(self, i: int, n: int = 3) -> None:
+        self.frontends[i].arm_healthz_faults(n)
+
+    def flake_random_stats(self, rng, n: int = 3) -> Optional[int]:
+        alive = self.alive()
+        if not alive:
+            return None
+        victim = alive[rng.randrange(len(alive))]
+        self.flake_stats(victim, n)
+        return victim
+
+    # -- client helper ----------------------------------------------------
+
+    def generate(self, prompt, max_new_tokens: int, timeout: float = 120.0):
+        """POST one request through the router; returns
+        ``(status, payload dict)``."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.router.port}/v1/generate",
+            data=json.dumps({
+                "prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens)}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {"error": str(e)}

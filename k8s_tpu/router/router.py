@@ -1,0 +1,548 @@
+"""Prefix-aware request router over N serving-engine replicas.
+
+The front door of the serving FLEET (ROADMAP item 1, docs/SERVING.md
+"Fleet"): one engine replica tops out at one chip's roofline; this
+process turns N independent replicas — each a
+:class:`~k8s_tpu.serving.server.ServingFrontend` the operator
+materialized behind a per-index Service — into one endpoint.
+
+Design, stdlib-only (this ships in the same ConfigMap-shipped image as
+the launcher):
+
+- **Discovery is env + polling, not registration.** The operator bakes
+  ``KTPU_SERVING_PEERS`` (``"0=http://svc-0:port,1=..."`` — the same
+  per-index Service-DNS plumbing the checkpoint peer wire uses) for the
+  whole ``maxReplicas`` range; a background poller GETs each replica's
+  ``/healthz`` and keeps a live view. A replica that is absent (not yet
+  scaled up), mid-restart (connection refused) or flaking its stats
+  endpoint is marked ``draining``/``down`` and simply not routed to —
+  the poll loop never crashes on an unreachable peer, and scale events
+  need no router restart.
+- **Scoring.** Each request goes to the replica with the lowest load
+  score: ``queue_depth + in_flight + prefill backlog (chunks) +
+  requests routed there since its last poll`` (the last term covers
+  poll staleness). Ties break on the lower replica index, so routing
+  is deterministic for a given stats view.
+- **Prefix affinity.** Requests whose first ``prefix_tokens`` tokens
+  hash equal (the shared-system-prompt case) stick to the replica that
+  served that prefix last — where the engine's shared-prefix KV cache
+  (``prefix_cache_tokens``) holds it warm, so the affinity hit skips
+  re-prefilling the prefix. Affinity YIELDS to health: a saturated,
+  draining or dead affine replica falls back to the score winner (and
+  the prefix re-binds there).
+- **Retry on peer.** A forward that fails for replica reasons —
+  connection refused/reset (crash), 429 (backpressure), 5xx — is
+  retried on the next-best replica, each replica tried at most once.
+  Generation requests are idempotent, so a killed replica's in-flight
+  requests complete on a peer instead of surfacing as client errors;
+  the chaos fault ``router-replica-loss`` pins this. Client errors
+  (4xx) are returned as-is.
+- **SLO aggregation.** Per-request TTFT/ITL samples (returned by the
+  replicas since the fleet change) land in a sliding window; the
+  ``/healthz`` ``slo`` block exposes their percentiles — the signal
+  the reconciler-side :class:`~k8s_tpu.router.autoscaler.SloAutoscaler`
+  scales the replica count on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from k8s_tpu.controller import metrics
+
+# replica health states (the router's view, refreshed by the poller)
+READY = "ready"
+DRAINING = "draining"  # refused/flaked recently, or replica reports draining
+DOWN = "down"          # consecutive poll failures >= down_after
+UNKNOWN = "unknown"    # never successfully polled
+
+# a replica whose poll just failed once may be mid-restart — stop
+# routing immediately (draining), declare it down after this many
+# consecutive failures
+DEFAULT_DOWN_AFTER = 2
+
+
+def parse_peers(raw: str) -> Dict[int, str]:
+    """``"0=http://svc-0:8000,1=http://svc-1:8000"`` → {index: url}
+    (the ``KTPU_SERVING_PEERS`` contract, same shape as the checkpoint
+    wire's ``KTPU_CKPT_PEERS``). Malformed entries are skipped."""
+    out: Dict[int, str] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        idx, _, url = part.partition("=")
+        try:
+            out[int(idx)] = url.rstrip("/")
+        except ValueError:
+            continue
+    return out
+
+
+def prefix_key(prompt, prefix_tokens: int) -> Optional[str]:
+    """Affinity key: hash of the first ``prefix_tokens`` token ids.
+    Prompts shorter than the prefix get no key (a short prompt carries
+    no shared system prefix worth pinning)."""
+    if prefix_tokens <= 0 or len(prompt) < prefix_tokens:
+        return None
+    head = ",".join(str(int(t)) for t in prompt[:prefix_tokens])
+    return hashlib.sha1(head.encode()).hexdigest()
+
+
+def _pct(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return float(s[int(q * (len(s) - 1))])
+
+
+@dataclasses.dataclass
+class Replica:
+    """The router's live view of one engine replica."""
+
+    index: int
+    url: str
+    state: str = UNKNOWN
+    stats: dict = dataclasses.field(default_factory=dict)
+    failures: int = 0            # consecutive poll failures
+    routed: int = 0              # lifetime requests routed here
+    routed_since_poll: int = 0   # staleness compensation (see load())
+    last_error: str = ""
+
+    def load(self) -> float:
+        """Score used for routing: lower is better. Derived from the
+        last successful poll plus the requests this router sent since
+        (the poll view is up to one poll interval stale)."""
+        st = self.stats or {}
+        inner = st.get("stats") or {}
+        # prefer the LIVE top-level queue_depth (reads the queue
+        # itself) over the per-pump-round stats gauge: a burst landing
+        # between the replica's pump rounds is invisible to the gauge,
+        # and routed_since_poll only covers THIS router's own sends
+        q = float(st.get("queue_depth",
+                         inner.get("queue_depth") or 0) or 0)
+        inflight = float(st.get("in_flight") or 0)
+        # prefill backlog in chunk units: a half-prefilled 8k prompt is
+        # real pending work the queue depth doesn't show
+        backlog = 0.0
+        chunk = float(
+            (st.get("scheduler") or {}).get("prefill_chunk") or 256)
+        for p in (st.get("prefill_progress") or {}).values():
+            backlog += max(0.0, float(p.get("total", 0) - p.get("done", 0))
+                           ) / max(1.0, chunk)
+        return q + inflight + backlog + self.routed_since_poll
+
+
+class Router:
+    """HTTP front door + stats poller + scoring/affinity policy.
+
+    ``endpoints`` maps replica index → base URL. Every mutation of the
+    routing view goes through :meth:`note_stats` /
+    :meth:`note_poll_failure`, which the poller drives (and tests may
+    drive directly — scoring is then fully deterministic).
+    """
+
+    def __init__(
+        self,
+        endpoints: Dict[int, str],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        poll_interval: float = 0.5,
+        poll_timeout: float = 2.0,
+        prefix_tokens: int = 16,
+        affinity_max: int = 4096,
+        saturation_depth: float = 8.0,
+        request_timeout: float = 300.0,
+        down_after: int = DEFAULT_DOWN_AFTER,
+        slo_window: int = 256,
+    ):
+        self.replicas: Dict[int, Replica] = {
+            int(i): Replica(index=int(i), url=u.rstrip("/"))
+            for i, u in endpoints.items()
+        }
+        if not self.replicas:
+            raise ValueError("router needs at least one replica endpoint")
+        self.poll_interval = float(poll_interval)
+        self.poll_timeout = float(poll_timeout)
+        self.prefix_tokens = int(prefix_tokens)
+        self.saturation_depth = float(saturation_depth)
+        self.request_timeout = float(request_timeout)
+        self.down_after = max(1, int(down_after))
+        self._affinity: "OrderedDict[str, int]" = OrderedDict()
+        self.affinity_max = int(affinity_max)
+        self._lock = threading.Lock()
+        self._draining = False
+        # lifetime counters (mirrored into ktpu_router_* metrics)
+        self.routed_total = 0
+        self.retries = 0
+        self.rejected = 0       # requests that exhausted every replica
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.affinity_fallbacks = 0
+        self._slo: deque = deque(maxlen=int(slo_window))
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # pod-log hygiene
+                pass
+
+            def _json(self, code: int, payload: dict, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    body = metrics.REGISTRY.expose().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path != "/healthz":
+                    return self._json(404, {"error": "not found"})
+                return self._json(200, router.healthz())
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/generate":
+                    return self._json(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    payload = json.loads(body)
+                    # coerce here so a non-token prompt (a string, a
+                    # list with non-numeric elements) is the CLIENT's
+                    # 400 — not a ValueError out of prefix_key that
+                    # drops the connection with no response
+                    prompt = [int(t) for t in payload["prompt"]]
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                code, out, headers = router.route_and_forward(prompt, body)
+                return self._json(code, out, headers=headers)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # the front door takes the whole fleet's client burst on
+            # one listener: the stock backlog of 5 drops SYNs under
+            # concurrency and each drop costs a 1s TCP retransmit
+            request_queue_size = 128
+
+        self._server = Server((host, port), Handler)
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="router-http")
+
+    # ------------------------------------------------------------ view
+
+    def note_stats(self, index: int, payload: dict) -> None:
+        """Record a successful /healthz poll of replica ``index``."""
+        with self._lock:
+            r = self.replicas.get(index)
+            if r is None:
+                return
+            r.stats = payload or {}
+            r.failures = 0
+            r.routed_since_poll = 0
+            r.last_error = ""
+            r.state = DRAINING if r.stats.get("draining") else READY
+        self._healthy_gauge()
+
+    def note_poll_failure(self, index: int, err: str) -> None:
+        """Record a failed poll: connection refused / timeout / 5xx.
+        A replica mid-restart refuses connections for a few seconds —
+        it is marked ``draining`` (not routed to) on the FIRST failure
+        and ``down`` after ``down_after`` consecutive ones; either way
+        the poll loop carries on. (Fix en route: consumers of
+        ``HealthServer``-style endpoints used to assume the endpoint
+        is always up.)"""
+        with self._lock:
+            r = self.replicas.get(index)
+            if r is None:
+                return
+            r.failures += 1
+            r.last_error = err
+            r.state = DOWN if r.failures >= self.down_after else DRAINING
+        self._healthy_gauge()
+
+    def _healthy_gauge(self) -> None:
+        with self._lock:
+            n = sum(1 for r in self.replicas.values() if r.state == READY)
+        metrics.ROUTER_REPLICAS_READY.set(float(n))
+
+    def _poll_one(self, idx: int, url: str) -> None:
+        try:
+            with urllib.request.urlopen(
+                    url + "/healthz",
+                    timeout=self.poll_timeout) as resp:
+                payload = json.loads(resp.read())
+            self.note_stats(idx, payload)
+        except Exception as e:  # noqa: BLE001 - any failure is a miss
+            self.note_poll_failure(idx, str(e))
+
+    def _poll_once(self) -> None:
+        # one sweep polls every peer CONCURRENTLY: the peer list spans
+        # the whole maxReplicas range, and unscaled/blackholed indices
+        # each cost up to poll_timeout — serially that would stretch a
+        # sweep to replicas*timeout, lagging DOWN detection and load
+        # scores far behind the intended cadence
+        threads = [
+            threading.Thread(target=self._poll_one, args=(idx, r.url),
+                             daemon=True, name=f"router-poll-{idx}")
+            for idx, r in list(self.replicas.items())
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.poll_timeout + 1.0)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:  # the poller must never die
+                pass
+            self._stop.wait(self.poll_interval)
+
+    # ------------------------------------------------------------ policy
+
+    def _routable(self, r: Replica) -> bool:
+        return r.state == READY
+
+    def _saturated(self, r: Replica) -> bool:
+        return r.load() >= self.saturation_depth
+
+    def pick_replica(self, prompt) -> Tuple[Optional[int], str]:
+        """Pure routing decision: (replica index | None, affinity
+        verdict in {"hit", "fallback", "miss", "none"}). Deterministic
+        given the current stats view — the unit-test surface."""
+        key = prefix_key(prompt, self.prefix_tokens)
+        with self._lock:
+            ready = [r for r in self.replicas.values() if self._routable(r)]
+            if not ready:
+                return None, "none"
+            if key is not None:
+                bound = self._affinity.get(key)
+                if bound is not None:
+                    r = self.replicas.get(bound)
+                    if r is not None and self._routable(r) \
+                            and not self._saturated(r):
+                        self._affinity.move_to_end(key)
+                        return bound, "hit"
+                    verdict = "fallback"
+                else:
+                    verdict = "miss"
+            else:
+                verdict = "none"
+            # least-loaded wins; ties break on the LOWER index so the
+            # decision is reproducible for a given stats view
+            best = min(ready, key=lambda r: (r.load(), r.index))
+            if key is not None:
+                self._affinity[key] = best.index
+                self._affinity.move_to_end(key)
+                while len(self._affinity) > self.affinity_max:
+                    self._affinity.popitem(last=False)
+            return best.index, verdict
+
+    def _count_verdict(self, verdict: str) -> None:
+        if verdict == "hit":
+            self.affinity_hits += 1
+            metrics.ROUTER_AFFINITY_HITS.inc()
+        elif verdict == "miss":
+            self.affinity_misses += 1
+        elif verdict == "fallback":
+            self.affinity_fallbacks += 1
+            metrics.ROUTER_AFFINITY_FALLBACKS.inc()
+
+    # ------------------------------------------------------------ data path
+
+    def _forward(self, url: str, body: bytes):
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=self.request_timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def route_and_forward(self, prompt, body: bytes):
+        """Route one request, retrying replica-side failures on peers.
+        Returns ``(http code, payload, extra headers)``."""
+        if self._draining:
+            return 503, {"error": "router draining"}, None
+        tried: set = set()
+        saw_429 = False
+        retry_after = "1"
+        first_verdict: Optional[str] = None
+        while True:
+            idx, verdict = self._pick_excluding(prompt, tried)
+            if first_verdict is None:
+                with self._lock:
+                    self._count_verdict(verdict)
+                first_verdict = verdict
+            if idx is None:
+                break
+            tried.add(idx)
+            r = self.replicas[idx]
+            with self._lock:
+                r.routed += 1
+                r.routed_since_poll += 1
+            metrics.ROUTER_REQUESTS.inc({"replica": str(idx)})
+            try:
+                code, payload = self._forward(r.url, body)
+            except urllib.error.HTTPError as e:
+                try:
+                    err_payload = json.loads(e.read())
+                except Exception:
+                    err_payload = {"error": f"replica {idx}: HTTP {e.code}"}
+                if e.code == 429:
+                    # honest backpressure — try a less loaded peer
+                    saw_429 = True
+                    retry_after = e.headers.get("Retry-After") or retry_after
+                    self._note_retry(idx)
+                    continue
+                if e.code >= 500:
+                    self._note_retry(idx)
+                    continue
+                # 4xx: the CLIENT's error — retrying elsewhere would
+                # just repeat it
+                return e.code, err_payload, None
+            except Exception as e:  # connection refused/reset, timeout
+                # the replica died under the request (or mid-restart):
+                # mark it down and retry the idempotent request on a
+                # peer — this is the killed-replica-loses-nothing path
+                self.note_poll_failure(idx, str(e))
+                self._note_retry(idx)
+                continue
+            with self._lock:
+                self.routed_total += 1
+                if isinstance(payload, dict):
+                    ttft = payload.get("ttft_s")
+                    itl = payload.get("itl_ms")
+                    if ttft is not None:
+                        self._slo.append(
+                            (float(ttft), float(itl or 0.0)))
+            if isinstance(payload, dict):
+                payload = dict(payload)
+                payload["replica"] = idx
+                payload["retries"] = len(tried) - 1
+            return code, payload, None
+        with self._lock:
+            self.rejected += 1
+        if saw_429:
+            return (429, {"error": "all replicas saturated"},
+                    {"Retry-After": retry_after})
+        return 503, {"error": "no routable replica"}, None
+
+    def _pick_excluding(self, prompt, tried: set):
+        if not tried:
+            return self.pick_replica(prompt)
+        with self._lock:
+            ready = [r for r in self.replicas.values()
+                     if self._routable(r) and r.index not in tried]
+            if not ready:
+                return None, "none"
+            best = min(ready, key=lambda r: (r.load(), r.index))
+            return best.index, "none"
+
+    def _note_retry(self, idx: int) -> None:
+        with self._lock:
+            self.retries += 1
+        metrics.ROUTER_RETRIES.inc({"replica": str(idx)})
+
+    # ------------------------------------------------------------ stats
+
+    def slo_snapshot(self) -> dict:
+        with self._lock:
+            samples = list(self._slo)
+        ttft = [s[0] for s in samples]
+        itl = [s[1] for s in samples]
+        return {
+            "window": len(samples),
+            "ttft_p50_ms": round(1e3 * _pct(ttft, 0.5), 3),
+            "ttft_p95_ms": round(1e3 * _pct(ttft, 0.95), 3),
+            "itl_p50_ms": round(_pct(itl, 0.5), 3),
+            "itl_p95_ms": round(_pct(itl, 0.95), 3),
+        }
+
+    def healthz(self) -> dict:
+        with self._lock:
+            replicas = {
+                str(r.index): {
+                    "url": r.url,
+                    "state": r.state,
+                    "load": round(r.load(), 3),
+                    "routed": r.routed,
+                    "failures": r.failures,
+                }
+                for r in self.replicas.values()
+            }
+            ready = sum(1 for r in self.replicas.values()
+                        if r.state == READY)
+            affinity = {
+                "prefix_tokens": self.prefix_tokens,
+                "size": len(self._affinity),
+                "hits": self.affinity_hits,
+                "misses": self.affinity_misses,
+                "fallbacks": self.affinity_fallbacks,
+            }
+            counters = {
+                "routed": self.routed_total,
+                "retries": self.retries,
+                "rejected": self.rejected,
+            }
+            draining = self._draining
+        return {
+            "ok": not draining and ready > 0,
+            "draining": draining,
+            "ready_replicas": ready,
+            "replicas": replicas,
+            "affinity": affinity,
+            "slo": self.slo_snapshot(),
+            **counters,
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Router":
+        self._http_thread.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="router-poller")
+        self._poll_thread.start()
+        return self
+
+    def drain(self) -> None:
+        """Stop intake and the poller; idempotent."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+
+    # alias used by tests/harnesses
+    close = drain

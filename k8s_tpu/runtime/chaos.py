@@ -28,6 +28,11 @@ ckpt-corruption     bytes flipped in a committed local shard → crc
                     detection → peer / persistent-tier fallback
 ckpt-peer-loss      one host's whole local dir deleted (replaced pod)
                     → peer-shard restore for the new pod
+router-replica-loss one serving-fleet engine replica crashed abruptly
+                    → router marks it down, in-flight requests retry
+                    on a peer, zero accepted requests lost
+router-stats-flake  a replica's /healthz errors while it keeps serving
+                    → the router poll loop survives and keeps routing
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -429,6 +434,56 @@ class RestorePeerLossFault(FaultInjector):
         return f"host-{dropped}"
 
 
+class RouterReplicaLossFault(FaultInjector):
+    """Abruptly crash one serving-fleet engine replica (always leaving
+    at least one standing): its listener closes mid-flight, parked
+    requests fail server-side, and the ROUTER must retry them on a
+    peer so no accepted request is lost. ``fleet`` is any object with
+    the :class:`k8s_tpu.router.fleet.LocalFleet` fault surface
+    (``kill_random_replica(rng)``)."""
+
+    name = "router-replica-loss"
+
+    def __init__(self, fleet, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.fleet = fleet
+
+    def fire(self) -> Optional[str]:
+        victim = self.fleet.kill_random_replica(self.rng)
+        if victim is None:
+            return None  # not enough replicas left to kill one safely
+        self.injected += 1
+        log.info("chaos[%s]: killed serving replica %d", self.name, victim)
+        return f"replica-{victim}"
+
+
+class RouterStatsFlakeFault(FaultInjector):
+    """Make one replica's /healthz stats endpoint error for the next
+    few polls while its data plane keeps serving — the router's poll
+    loop must treat the failures as misses (mark the replica
+    draining/down), never crash, and resume routing to the replica
+    once its stats answer again."""
+
+    name = "router-stats-flake"
+
+    def __init__(self, fleet, rate: float = 1.0,
+                 seed: Optional[int] = None, burst: int = 3):
+        super().__init__(rate, seed)
+        self.fleet = fleet
+        self.burst = burst
+
+    def fire(self) -> Optional[str]:
+        n = 1 + self.rng.randrange(self.burst)
+        victim = self.fleet.flake_random_stats(self.rng, n)
+        if victim is None:
+            return None
+        self.injected += 1
+        log.info("chaos[%s]: armed %d stats flakes on replica %d",
+                 self.name, n, victim)
+        return f"replica-{victim}:{n}"
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -517,6 +572,7 @@ class ChaosMonkey:
         faulty: Optional[FaultyCluster] = None,
         lease_namespace: str = "default",
         ckpt_root: Optional[str] = None,
+        fleet=None,
     ) -> "ChaosMonkey":
         """``--chaos-level`` profiles. Levels are cumulative:
 
@@ -527,7 +583,10 @@ class ChaosMonkey:
         - 3+: + checkpoint-save failures, leader-lease loss, and — when
           ``ckpt_root`` names a multi-tier local checkpoint root —
           partial local commits, local shard corruption, and whole-host
-          local-tier loss (the k8s_tpu/ckpt recovery matrix)
+          local-tier loss (the k8s_tpu/ckpt recovery matrix); when
+          ``fleet`` names a serving fleet (the LocalFleet fault
+          surface) — replica crashes and stats flakes (the router
+          recovery matrix)
         """
         rng = random.Random(seed)
 
@@ -552,6 +611,11 @@ class ChaosMonkey:
                     LocalCommitFault(rate=0.3, seed=s(), burst=1),
                     LocalCorruptionFault(ckpt_root, rate=0.3, seed=s()),
                     RestorePeerLossFault(ckpt_root, rate=0.15, seed=s()),
+                ]
+            if fleet is not None:
+                inj += [
+                    RouterReplicaLossFault(fleet, rate=0.15, seed=s()),
+                    RouterStatsFlakeFault(fleet, rate=0.3, seed=s()),
                 ]
         return cls(client, level=level, interval=interval, seed=s(),
                    injectors=inj)

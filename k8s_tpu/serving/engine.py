@@ -398,6 +398,20 @@ class ContinuousBatchingEngine:
         decode load exactly one full chunk still fits per round.
         When nothing is decoding the budget floor is one full chunk,
         so prefill always makes progress.
+    prefix_cache_tokens:
+        Shared-prefix KV reuse (docs/SERVING.md "Fleet"): > 0 caches
+        the working-cache KV of each distinct prompt prefix of this
+        many tokens (rounded DOWN to the chunk-bucket grid), keyed by
+        the exact token bytes. A later prompt sharing that prefix
+        skips re-prefilling it: the snapshot seeds the working cache
+        and chunking resumes at the prefix boundary — the repeated-
+        system-prompt case a prefix-affinity router steers here.
+        Tokens are bit-identical to the uncached path (the snapshot
+        IS what prefilling those tokens produces). 0 = off; requires
+        ``chunked_prefill``.
+    prefix_cache_max:
+        LRU capacity (distinct prefixes held on device). Each entry
+        costs one stage-sized batch-1 KV cache.
     """
 
     def __init__(
@@ -415,6 +429,8 @@ class ContinuousBatchingEngine:
         chunked_prefill: bool = True,
         prefill_chunk: int = 256,
         max_tokens_per_round: Optional[int] = None,
+        prefix_cache_tokens: int = 0,
+        prefix_cache_max: int = 8,
     ):
         cfg = model.config
         if not (cfg.decode and cfg.ragged_decode):
@@ -529,6 +545,22 @@ class ContinuousBatchingEngine:
         self._pstage: Optional[int] = None
         self._prefilling: Optional[Request] = None
         self._prefill_slot: Optional[int] = None
+        # Shared-prefix KV reuse: exact-token-keyed LRU of working-
+        # cache snapshots at the prefix boundary. The boundary is
+        # rounded DOWN to the chunk grid so a snapshot is always a
+        # legal continuation offset; capture forces a chunk boundary
+        # there (see _schedule_prefill). Disabled off the chunked path
+        # (the legacy one-shot prefill has no working cache to reuse).
+        self.prefix_cache_tokens = int(prefix_cache_tokens)
+        self.prefix_cache_max = int(prefix_cache_max)
+        self._prefix_len = 0
+        if self.chunked_prefill and self.prefix_cache_tokens > 0:
+            g0 = self._chunk_buckets[0]
+            self._prefix_len = (self.prefix_cache_tokens // g0) * g0
+        # key (prefix token bytes) -> (stage, snapshot cache tree)
+        self._prefix_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._capture_key: Optional[bytes] = None
 
         # ALL decode state lives on device between chunks; the host
         # holds only a scheduling VIEW refreshed from each chunk's
@@ -589,7 +621,9 @@ class ContinuousBatchingEngine:
                       "wasted_slot_steps": 0, "prefill_s": 0.0,
                       "chunk_s": 0.0, "prefill_chunks": 0,
                       "prefill_tokens": 0, "queue_depth": 0,
-                      "ttft_s_sum": 0.0, "ttft_count": 0}
+                      "ttft_s_sum": 0.0, "ttft_count": 0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_captures": 0, "prefix_tokens_saved": 0}
 
     # -- request intake --------------------------------------------------
 
@@ -636,6 +670,14 @@ class ContinuousBatchingEngine:
             self._reqs[req.rid] = req
             self._queue.append(req)
         return req.rid
+
+    def queue_depth(self) -> int:
+        """LIVE admission-queue depth (requests accepted but not yet
+        scheduled) — unlike ``stats["queue_depth"]``, which is a gauge
+        refreshed once per pump round, this reads the queue itself, so
+        a front-end backpressure check between rounds sees a burst of
+        arrivals immediately. Callable from any thread."""
+        return len(self._queue)
 
     # -- scheduling ------------------------------------------------------
 
@@ -710,6 +752,34 @@ class ContinuousBatchingEngine:
             self._pcaches[stage] = _init_cache(model, self.params, 1)
         return model, self._pcaches[stage]
 
+    def _admit_prefix(self, req: Request) -> None:
+        """Prefix-cache lookup at admission of the next prompt to
+        prefill. On a HIT the snapshot seeds the working cache and the
+        prompt's first ``_prefix_len`` tokens are marked done — the
+        continuation path then appends from the boundary exactly as if
+        those chunks had just run. On a MISS (prompt long enough to
+        capture) the scheduler arms a capture at the boundary."""
+        self._capture_key = None
+        L = self._prefix_len
+        if not L or int(req.prompt.size) <= L:
+            return
+        key = req.prompt[:L].tobytes()
+        hit = self._prefix_cache.get(key)
+        if hit is not None:
+            stage, snap = hit
+            self._prefix_cache.move_to_end(key)
+            self._stage_cache(stage)  # materialize the model view
+            # a COPY seeds the live working cache: subsequent chunks
+            # donate it, and the snapshot must survive for the next hit
+            self._pcaches[stage] = jax.tree_util.tree_map(jnp.copy, snap)
+            self._pstage = stage
+            req.prefill_done = L
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += L
+        else:
+            self.stats["prefix_misses"] += 1
+            self._capture_key = key
+
     def _schedule_prefill(self) -> Dict[int, int]:
         """Token-budget scheduler (chunked_prefill=True): spend this
         round's remaining budget — after decode rows claim
@@ -736,10 +806,24 @@ class ContinuousBatchingEngine:
                     break
                 self._prefilling = self._queue.popleft()
                 self._prefill_slot = slot
+                self._admit_prefix(self._prefilling)
             req, slot = self._prefilling, self._prefill_slot
             plan = _next_chunk(self._chunk_buckets, req.prefill_done,
                                int(req.prompt.size), remaining,
                                self.max_seq)
+            if (plan is not None and self._capture_key is not None
+                    and req.prefill_done < self._prefix_len
+                    and req.prefill_done + plan[0] > self._prefix_len):
+                # force a chunk boundary at the prefix capture point so
+                # the snapshot covers EXACTLY the shared tokens (an
+                # overshooting bucket would bake request-specific rows
+                # into the cached prefix). The boundary is on the chunk
+                # grid, so a full in-budget bucket always exists once
+                # remaining >= g.
+                fit = [b for b in self._chunk_buckets
+                       if req.prefill_done + b <= self._prefix_len
+                       and b <= remaining]
+                plan = (max(fit), max(fit), False) if fit else None
             if plan is None:
                 break
             chunk_b, take, final = plan
@@ -807,6 +891,18 @@ class ContinuousBatchingEngine:
             self.stats["prefill_chunks"] += 1
             self.stats["prefill_tokens"] += chunk_b
             self.stats["prefill_s"] += time.perf_counter() - t0
+            if (self._capture_key is not None
+                    and req.prefill_done == self._prefix_len):
+                # the working cache now holds exactly the shared
+                # prefix: snapshot it (a copy — the live cache is
+                # donated by the next chunk) into the LRU
+                snap = jax.tree_util.tree_map(jnp.copy, pcache)
+                self._prefix_cache[self._capture_key] = (stage, snap)
+                self._prefix_cache.move_to_end(self._capture_key)
+                while len(self._prefix_cache) > self.prefix_cache_max:
+                    self._prefix_cache.popitem(last=False)
+                self.stats["prefix_captures"] += 1
+                self._capture_key = None
             if final:
                 # round the scatter to a chunk multiple: jit keys stay
                 # bounded, and the extra stale rows sit above the

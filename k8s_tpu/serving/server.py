@@ -47,16 +47,45 @@ from typing import Dict, Optional
 import numpy as np
 
 
+class Overloaded(RuntimeError):
+    """Raised by :meth:`ServingFrontend.submit_and_wait` when the
+    engine's live queue depth exceeds ``max_queue_depth`` — mapped to
+    HTTP 429 + ``Retry-After``. A router in front of this replica
+    depends on the rejection being IMMEDIATE and honest: queueing the
+    request unboundedly instead would hide the saturation signal it
+    load-balances on."""
+
+
+class _Result:
+    """One finished request's payload + timing, resolved to a waiter."""
+
+    __slots__ = ("tokens", "ttft_s", "itl_ms")
+
+    def __init__(self, tokens, ttft_s: float, itl_ms: float):
+        self.tokens = tokens
+        self.ttft_s = ttft_s
+        self.itl_ms = itl_ms
+
+
 class ServingFrontend:
     """Bind an HTTP server to ``engine``; :meth:`serve` pumps until
     ``should_stop()`` goes true, then drains. ``port=0`` binds an
     ephemeral port (read :attr:`port` after construction — the program
-    prints it as a machine-readable event for clients/tests)."""
+    prints it as a machine-readable event for clients/tests).
+
+    ``max_queue_depth`` > 0 enables backpressure: a request arriving
+    while ``engine.queue_depth()`` is at/over the threshold is refused
+    with 429 + ``Retry-After: retry_after_s`` instead of queueing
+    unboundedly (the per-replica saturation contract the fleet router
+    routes on)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 request_timeout: float = 300.0):
+                 request_timeout: float = 300.0,
+                 max_queue_depth: int = 0, retry_after_s: float = 1.0):
         self.engine = engine
         self.request_timeout = float(request_timeout)
+        self.max_queue_depth = int(max_queue_depth)
+        self.retry_after_s = float(retry_after_s)
         self._lock = threading.Lock()
         self._waiters: Dict[int, threading.Event] = {}
         self._results: Dict[int, object] = {}
@@ -64,6 +93,8 @@ class ServingFrontend:
         self._draining = False
         self.served = 0                  # results DELIVERED to a waiter, lifetime
         self.abandoned = 0               # finished after the waiter timed out
+        self.rejected = 0                # refused by backpressure (429s)
+        self._healthz_faults = 0         # armed stats-endpoint failures (chaos)
 
         frontend = self
 
@@ -73,17 +104,24 @@ class ServingFrontend:
             def log_message(self, fmt, *args):  # noqa: D102
                 pass
 
-            def _json(self, code: int, payload: dict):
+            def _json(self, code: int, payload: dict, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 - stdlib naming
                 if self.path != "/healthz":
                     return self._json(404, {"error": "not found"})
+                if frontend._consume_healthz_fault():
+                    # chaos router-stats-flake: the stats endpoint
+                    # errors while the data plane keeps serving — a
+                    # poller must treat this as a miss, not a crash
+                    return self._json(500, {"error": "chaos: stats flake"})
                 with frontend._lock:
                     in_flight = len(frontend._waiters)
                 eng = frontend.engine
@@ -99,6 +137,8 @@ class ServingFrontend:
                     "in_flight": in_flight,
                     "served": frontend.served,
                     "abandoned": frontend.abandoned,
+                    "rejected": frontend.rejected,
+                    "queue_depth": frontend._queue_depth(),
                     "prefill_progress": {
                         str(rid): p for rid, p in progress.items()},
                     "scheduler": {
@@ -109,6 +149,9 @@ class ServingFrontend:
                             eng, "prefill_chunk", None),
                         "max_tokens_per_round": getattr(
                             eng, "max_tokens_per_round", None),
+                        "max_queue_depth": frontend.max_queue_depth,
+                        "prefix_cache_tokens": getattr(
+                            eng, "prefix_cache_tokens", None),
                     },
                     "stats": {k: round(v, 4) if isinstance(v, float) else v
                               for k, v in frontend.engine.stats.items()},
@@ -126,7 +169,12 @@ class ServingFrontend:
                     return self._json(400, {"error": f"bad request: {e}"})
                 t0 = time.perf_counter()
                 try:
-                    tokens = frontend.submit_and_wait(prompt, max_new)
+                    result = frontend.submit_and_wait(prompt, max_new)
+                except Overloaded as e:     # backpressure → caller retries
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After":
+                                 f"{frontend.retry_after_s:g}"})
                 except RuntimeError as e:   # draining/closed
                     return self._json(503, {"error": str(e)})
                 except ValueError as e:     # engine validation
@@ -134,12 +182,24 @@ class ServingFrontend:
                 except TimeoutError as e:
                     return self._json(504, {"error": str(e)})
                 return self._json(200, {
-                    "tokens": [int(t) for t in tokens],
+                    "tokens": [int(t) for t in result.tokens],
                     "latency_s": round(time.perf_counter() - t0, 4),
+                    # per-request stream timing: the fleet router
+                    # aggregates these into the TTFT/ITL percentiles
+                    # the SLO autoscaler scales on
+                    "ttft_s": round(result.ttft_s, 4),
+                    "itl_ms": round(result.itl_ms, 3),
                 })
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # stock backlog is 5: a burst of concurrent clients (a
+            # router fanning a fleet's traffic in) overflows it and
+            # the dropped SYNs retransmit after a full second —
+            # measured as 1s request-latency cliffs at 16 clients
+            request_queue_size = 128
+
+        self._server = Server((host, port), Handler)
         self.host = host
         self.port = int(self._server.server_address[1])
         self._http_thread = threading.Thread(
@@ -149,13 +209,41 @@ class ServingFrontend:
 
     # -- handler-thread side ---------------------------------------------
 
-    def submit_and_wait(self, prompt, max_new_tokens: int):
-        """Submit one request and block until its tokens are ready.
+    def _queue_depth(self) -> int:
+        qd = getattr(self.engine, "queue_depth", None)
+        if callable(qd):
+            return int(qd())
+        return int(self.engine.stats.get("queue_depth", 0))
+
+    def _consume_healthz_fault(self) -> bool:
+        with self._lock:
+            if self._healthz_faults > 0:
+                self._healthz_faults -= 1
+                return True
+        return False
+
+    def arm_healthz_faults(self, n: int = 1) -> None:
+        """Chaos hook (``router-stats-flake``): the next ``n`` GET
+        /healthz requests return 500 while generation keeps working."""
+        with self._lock:
+            self._healthz_faults += int(n)
+
+    def submit_and_wait(self, prompt, max_new_tokens: int) -> _Result:
+        """Submit one request and block until its tokens are ready;
+        returns a :class:`_Result` (tokens + TTFT/ITL timing).
         Raises RuntimeError while draining (503 to the client) so the
-        load balancer retries another replica during rollout."""
+        load balancer retries another replica during rollout, and
+        :class:`Overloaded` (429) when backpressure is on and the
+        engine queue is at the threshold."""
         with self._lock:
             if self._draining:
                 raise RuntimeError("draining: not accepting new requests")
+            if self.max_queue_depth > 0 \
+                    and self._queue_depth() >= self.max_queue_depth:
+                self.rejected += 1
+                raise Overloaded(
+                    f"engine queue depth {self._queue_depth()} >= "
+                    f"max_queue_depth {self.max_queue_depth}")
             rid = self.engine.submit(prompt, max_new_tokens)
             ev = threading.Event()
             self._waiters[rid] = ev
@@ -186,7 +274,22 @@ class ServingFrontend:
                 ev = self._waiters.pop(rid, None)
                 if ev is not None:
                     self.served += 1
-                    self._results[rid] = np.asarray(req.tokens, np.int32)
+                    n = len(req.tokens)
+                    # getattr: stub/legacy engines without timing
+                    # fields still resolve (timing reads as 0)
+                    first = getattr(req, "first_token_at", 0.0)
+                    ttft = max(
+                        0.0, first - getattr(req, "submitted_at", 0.0))
+                    # mean stream cadence after the first token — the
+                    # per-request ITL sample the router aggregates
+                    # (percentile-grade ITL needs per-chunk walls,
+                    # which stay bench-side; docs/SERVING.md)
+                    itl_ms = (
+                        1e3 * max(
+                            0.0, getattr(req, "finished_at", 0.0) - first)
+                        / (n - 1) if n > 1 else 0.0)
+                    self._results[rid] = _Result(
+                        np.asarray(req.tokens, np.int32), ttft, itl_ms)
                     ev.set()
                 else:
                     # no waiter ⇒ the client timed out and left: drop

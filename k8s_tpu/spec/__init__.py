@@ -15,6 +15,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     COORDINATOR,
     WORKER,
     TENSORBOARD,
+    ROUTER,
     CONTAINER_NAME,
     DEFAULT_IMAGE,
     DEFAULT_REPLICAS,
@@ -27,6 +28,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     ReplicaState,
     ReplicaStatus,
     RestartBackoffSpec,
+    ServingSpec,
     TensorBoardSpec,
     TerminationPolicySpec,
     TrainingSpec,

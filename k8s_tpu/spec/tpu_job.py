@@ -59,6 +59,10 @@ DEFAULT_REPLICAS = 1
 COORDINATOR = "COORDINATOR"
 WORKER = "WORKER"
 TENSORBOARD = "TENSORBOARD"
+# Serving-fleet front door (spec.serving): one pod running
+# programs/router.py behind its own per-index Service. Only valid on
+# jobs with a serving block — synthesized by set_defaults there.
+ROUTER = "ROUTER"
 _ROLE_ALIASES = {"MASTER": COORDINATOR, "CHIEF": COORDINATOR}
 VALID_REPLICA_TYPES = (COORDINATOR, WORKER)
 
@@ -295,6 +299,75 @@ class TrainingSpec(K8sObject):
 
 @register_type
 @dataclass
+class ServingSpec(K8sObject):
+    """Serving-fleet block (docs/SERVING.md "Fleet"): the operator
+    materializes ``replicas`` INDEPENDENT engine pods (each its own
+    single-process JAX world — serving replicas are not an SPMD gang)
+    plus one router pod, each behind its own per-index Service.
+
+    ``minReplicas``/``maxReplicas`` bound the SLO autoscaler: when a
+    TTFT or ITL SLO is set (> 0 ms), the reconciler scales the engine
+    count against the router's observed p95s within that range
+    (0 = default to ``replicas``, i.e. no movement on that side).
+    Services are created for the WHOLE ``maxReplicas`` range up front
+    so scale events never churn DNS — the router's peer list covers
+    every index and its poller treats absent replicas as down.
+
+    ``prefixTokens`` drives BOTH halves of prefix locality: the router
+    hashes each request's first N tokens for affinity, and the engines
+    get ``KTPU_SERVING_PREFIX_TOKENS`` so an affinity hit lands on a
+    warm shared-prefix KV cache and skips re-prefilling the prefix.
+    ``maxQueueDepth`` > 0 turns on per-replica backpressure (HTTP 429)
+    — the honest saturation signal the router load-balances on."""
+
+    replicas: int = 1
+    min_replicas: int = 0       # 0 → replicas
+    max_replicas: int = 0       # 0 → replicas
+    slo_ttft_ms: float = 0.0    # 0 = no TTFT SLO
+    slo_itl_ms: float = 0.0     # 0 = no ITL SLO
+    engine_port: int = 8000
+    router_port: int = 8080
+    prefix_tokens: int = 16
+    max_queue_depth: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def bounds(self) -> "tuple[int, int]":
+        lo = self.min_replicas or self.replicas
+        hi = self.max_replicas or self.replicas
+        return lo, hi
+
+    def autoscale_enabled(self) -> bool:
+        lo, hi = self.bounds()
+        return hi > lo and (self.slo_ttft_ms > 0 or self.slo_itl_ms > 0)
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise ValidationError("serving: replicas must be >= 1")
+        lo, hi = self.bounds()
+        if not (1 <= lo <= self.replicas <= hi):
+            raise ValidationError(
+                f"serving: need 1 <= minReplicas <= replicas <= "
+                f"maxReplicas, got min={lo} replicas={self.replicas} "
+                f"max={hi}")
+        for name in ("slo_ttft_ms", "slo_itl_ms"):
+            if getattr(self, name) < 0:
+                raise ValidationError(f"serving: {name} must be >= 0")
+        for name in ("engine_port", "router_port"):
+            p = getattr(self, name)
+            if not 1 <= p <= 65535:
+                raise ValidationError(
+                    f"serving: {name} out of range: {p}")
+        if self.engine_port == self.router_port:
+            raise ValidationError(
+                "serving: enginePort and routerPort must differ")
+        if self.prefix_tokens < 0:
+            raise ValidationError("serving: prefixTokens must be >= 0")
+        if self.max_queue_depth < 0:
+            raise ValidationError("serving: maxQueueDepth must be >= 0")
+
+
+@register_type
+@dataclass
 class TpuJobSpec(K8sObject):
     runtime_id: str = field(default="", metadata={"json": "RuntimeId"})
     tensorboard: Optional[TensorBoardSpec] = None
@@ -319,6 +392,10 @@ class TpuJobSpec(K8sObject):
     # Trainer-mode knobs (docs/PERF.md): ZeRO-1 sharded weight update,
     # latency-hiding scheduler. None → program defaults.
     training: Optional[TrainingSpec] = None
+    # Serving fleet (docs/SERVING.md "Fleet"): N independent engine
+    # replicas + a prefix-aware router pod + SLO autoscaling. None →
+    # plain job semantics (a serving WORKER is then a gang of 1).
+    serving: Optional[ServingSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -333,16 +410,23 @@ class TpuJobSpec(K8sObject):
     def validate(self) -> None:
         self._normalize_types()
         for r in self.replica_specs:
-            if r.template is None and r.replica_type != WORKER:
+            if r.template is None and r.replica_type not in (WORKER, ROUTER):
                 raise ValidationError(f"replica {r.replica_type or '<unset>'} is missing a template")
             if r.replica_type == COORDINATOR and r.replicas != 1:
                 raise ValidationError("the COORDINATOR must have replicas = 1")
+            if r.replica_type == ROUTER:
+                if self.serving is None:
+                    raise ValidationError(
+                        "a ROUTER replica requires a spec.serving block")
+                if r.replicas not in (None, 1):
+                    raise ValidationError(
+                        "the ROUTER must have replicas = 1")
             if r.port is None:
                 raise ValidationError("replicaSpec.port can't be None")
-            if r.replica_type not in VALID_REPLICA_TYPES:
+            if r.replica_type not in VALID_REPLICA_TYPES + (ROUTER,):
                 raise ValidationError(
                     f"replicaSpec.replicaType is {r.replica_type!r} but must be one of "
-                    f"{list(VALID_REPLICA_TYPES)}"
+                    f"{list(VALID_REPLICA_TYPES) + [ROUTER]}"
                 )
             if r.template is not None:
                 spec = r.template.spec
@@ -369,6 +453,15 @@ class TpuJobSpec(K8sObject):
             self.checkpoint_policy.validate()
         if self.training is not None:
             self.training.validate()
+        if self.serving is not None:
+            self.serving.validate()
+            w = self.replica_spec(WORKER)
+            if w is not None and w.replicas is not None:
+                lo, hi = self.serving.bounds()
+                if not lo <= w.replicas <= hi:
+                    raise ValidationError(
+                        f"serving: WORKER replicas {w.replicas} outside "
+                        f"[minReplicas, maxReplicas] = [{lo}, {hi}]")
         if self.tpu is not None and self.tpu.accelerator:
             t = self.tpu.topology()
             if t is None:
@@ -377,14 +470,24 @@ class TpuJobSpec(K8sObject):
                 )
             if self.tpu.num_slices < 1:
                 raise ValidationError("tpu.numSlices must be >= 1")
-            expected = t.num_hosts * self.tpu.num_slices
-            for r in self.replica_specs:
-                if r.replica_type == WORKER and r.replicas not in (None, expected):
+            if self.serving is not None:
+                # a serving WORKER is one independent engine, not a
+                # gang member — each replica gets one whole (single-
+                # host) slice; multi-host engines are a future item
+                if t.num_hosts != 1:
                     raise ValidationError(
-                        f"WORKER replicas must equal num_hosts×num_slices = {expected} "
-                        f"for accelerator {self.tpu.accelerator} (a slice is a gang; "
-                        f"got {r.replicas})"
-                    )
+                        f"serving: accelerator {self.tpu.accelerator} "
+                        f"is multi-host ({t.num_hosts} hosts/slice); "
+                        "fleet replicas must be single-host engines")
+            else:
+                expected = t.num_hosts * self.tpu.num_slices
+                for r in self.replica_specs:
+                    if r.replica_type == WORKER and r.replicas not in (None, expected):
+                        raise ValidationError(
+                            f"WORKER replicas must equal num_hosts×num_slices = {expected} "
+                            f"for accelerator {self.tpu.accelerator} (a slice is a gang; "
+                            f"got {r.replicas})"
+                        )
 
     # -- defaulting (reference SetDefaults(), tf_job.go:236-301) ------------
 
@@ -394,22 +497,41 @@ class TpuJobSpec(K8sObject):
         self._normalize_types()
         if self.tpu is not None and self.tpu.num_slices < 1:
             self.tpu.num_slices = 1
+        if self.serving is not None:
+            # normalize the autoscale bounds once, so everything
+            # downstream (validation, operator env, autoscaler) reads
+            # concrete numbers
+            lo, hi = self.serving.bounds()
+            self.serving.min_replicas = lo
+            self.serving.max_replicas = hi
+            # the fleet's front door: synthesize the ROUTER replica if
+            # the manifest didn't declare one (the expected case — a
+            # serving: block alone materializes the whole fleet)
+            if self.replica_spec(ROUTER) is None:
+                self.replica_specs.append(TpuReplicaSpec(
+                    replica_type=ROUTER, replicas=1))
         for r in self.replica_specs:
             if r.port is None:
                 r.port = DEFAULT_PORT
             if not r.replica_type:
                 r.replica_type = COORDINATOR
             if r.replicas is None:
-                if r.replica_type == WORKER and self.tpu is not None and self.tpu.topology():
+                if r.replica_type == WORKER and self.serving is not None:
+                    r.replicas = self.serving.replicas
+                elif r.replica_type == WORKER and self.tpu is not None and self.tpu.topology():
                     r.replicas = self.tpu.topology().num_hosts * self.tpu.num_slices
                 else:
                     r.replicas = DEFAULT_REPLICAS
             # Default SPMD-launcher template for template-less WORKERs —
             # the TPU analogue of the reference's default PS template
             # (tf_job.go:286-301): run the in-repo launcher against the
-            # job-level image.
+            # job-level image. The ROUTER runs the same launcher with
+            # its program pinned to the fleet front door.
             if r.template is None and r.replica_type == WORKER:
                 r.template = _default_launcher_template(self.image)
+                r.is_default_launcher = True
+            if r.template is None and r.replica_type == ROUTER:
+                r.template = _default_router_template(self.image)
                 r.is_default_launcher = True
         if self.termination_policy is None:
             self.termination_policy = TerminationPolicySpec(
@@ -503,6 +625,27 @@ def _host_bounds(t: topo.TpuTopology):
     return (1, cph)
 
 
+def _default_router_template(image: str) -> PodTemplateSpec:
+    """Router pod: the same ConfigMap-shipped launcher, program pinned
+    to the fleet front door (``programs/router.py`` — stdlib-only, no
+    devices). Peer endpoints and the advertise address are injected by
+    the operator at materialization time (trainer/replicas.py)."""
+    return PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    image=image,
+                    name=CONTAINER_NAME,
+                    command=["python", "-m", "k8s_tpu.launcher.spmd_launcher"],
+                    env=[EnvVar(name="KTPU_PROGRAM",
+                                value="k8s_tpu.programs.router:main")],
+                )
+            ],
+            restart_policy="OnFailure",
+        )
+    )
+
+
 def _default_launcher_template(image: str) -> PodTemplateSpec:
     """Default worker runs the in-repo SPMD launcher (analogue of the
     default-PS template, reference tf_job.go:286-301 — but instead of a
@@ -579,6 +722,9 @@ class TpuJobStatus(K8sObject):
     state: str = TpuJobState.UNKNOWN
     replica_statuses: List[ReplicaStatus] = field(default_factory=list)
     gang_restarts: int = 0  # whole-slice restarts performed so far
+    # serving fleets: the CURRENT autoscaled engine-replica count
+    # (0 = not a serving job / not yet reconciled)
+    serving_replicas: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def is_failed(self) -> bool:

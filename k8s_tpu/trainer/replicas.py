@@ -21,6 +21,7 @@ collectives over ICI/DCN are the transport.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -47,11 +48,17 @@ from k8s_tpu.spec import (
     CONTAINER_NAME,
     ReplicaState,
     ReplicaStatus,
+    ROUTER,
     TpuReplicaSpec,
     WORKER,
 )
 from k8s_tpu.trainer import labels as L
 from k8s_tpu.trainer.labels import KubernetesLabels
+
+# fix en route: _retry_transient's on_retry referenced a module logger
+# that was never defined — the first teardown retry that actually fired
+# would have died on the NameError instead of logging
+log = logging.getLogger(__name__)
 
 LAUNCHER_MOUNT_PATH = "/ktpu-launcher"
 LAUNCHER_VOLUME = "launcher-config-volume"
@@ -100,6 +107,11 @@ class RendezvousSpec:
     # trainer-mode contract (ZeRO-1 sharded weight update + the
     # latency-hiding pre-init hook, docs/PERF.md)
     training_env: Optional[Dict[str, str]] = None
+    # serving-fleet contract (spec.serving, docs/SERVING.md "Fleet"):
+    # engines get KTPU_SERVING_REPLICA/_ADVERTISE/_PREFIX_TOKENS/
+    # _MAX_QUEUE; the router gets KTPU_SERVING_PEERS (per-index Service
+    # endpoints over the WHOLE maxReplicas range) + KTPU_ROUTER_*
+    serving_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -124,6 +136,8 @@ class RendezvousSpec:
             env.update(self.checkpoint_env)
         if self.training_env:
             env.update(self.training_env)
+        if self.serving_env:
+            env.update(self.serving_env)
         return env
 
 
@@ -209,24 +223,60 @@ class TpuReplicaSet:
         return l
 
     @property
+    def is_serving(self) -> bool:
+        return self.job.job.spec.serving is not None
+
+    @property
     def is_gang(self) -> bool:
         """In-mesh replicas (the SPMD gang). Control replicas
-        (COORDINATOR/TensorBoard) are not part of the device mesh and
-        keep independent restart semantics."""
-        return self.spec.replica_type == WORKER
+        (COORDINATOR/TensorBoard/ROUTER) are not part of the device
+        mesh and keep independent restart semantics — and so do
+        serving-fleet WORKERs: each engine replica is its own
+        single-process world, so one replica's death must NOT tear
+        down its peers (the router just routes around it while the
+        kubelet restarts the pod)."""
+        return self.spec.replica_type == WORKER and not self.is_serving
+
+    def _service_count(self) -> int:
+        """Serving-fleet WORKERs get a Service for the WHOLE
+        ``maxReplicas`` range up front: stable DNS over the full scale
+        range means the router's baked peer list survives scale events
+        (its poller marks not-yet-scaled indices down and picks them
+        up the moment their pods answer)."""
+        n = self.spec.replicas or 0
+        serving = self.job.job.spec.serving
+        if serving is not None and self.spec.replica_type == WORKER:
+            return max(n, serving.bounds()[1])
+        return n
 
     # ------------------------------------------------------------- create
 
     def create(self, config) -> None:
         if self.spec.is_default_launcher:
             self._create_launcher_config_map(config)
-        for index in range(self.spec.replicas or 0):
+        for index in range(self._service_count()):
             self._create_service(index)
+        for index in range(self.spec.replicas or 0):
             self._create_job(index, config)
 
     def _create_service(self, index: int) -> None:
         if self._cached_exists("Service", self.job_name(index)):
             return
+        ports = [ServicePort(name="ktpu-port", port=self.spec.port)]
+        serving = self.job.job.spec.serving
+        if serving is not None:
+            # a ClusterIP Service forwards only DECLARED ports: the
+            # fleet's data plane (router→engine generate, operator→
+            # router /healthz) runs on the serving ports, which must be
+            # declared here or every forward dies with connection
+            # refused on a real cluster (the local resolver bypasses
+            # Service port declarations, so only production sees it)
+            if self.spec.replica_type == WORKER:
+                ports.append(ServicePort(
+                    name="ktpu-serving", port=serving.engine_port))
+            elif self.spec.replica_type == ROUTER:
+                ports.append(ServicePort(
+                    name="ktpu-router", port=serving.router_port))
         svc = Service(
             metadata=ObjectMeta(
                 name=self.job_name(index),
@@ -236,7 +286,7 @@ class TpuReplicaSet:
             ),
             spec=ServiceSpec(
                 selector=dict(self.task_labels(index)),
-                ports=[ServicePort(name="ktpu-port", port=self.spec.port)],
+                ports=ports,
             ),
         )
         try:
@@ -351,6 +401,8 @@ class TpuReplicaSet:
         successor of ``TfConfig`` build-up at reference
         replicas.go:189-203."""
         job = self.job
+        if self.is_serving and self.spec.replica_type in (WORKER, ROUTER):
+            return self._serving_rendezvous(index)
         cluster = job.cluster_spec()
         workers = cluster.get(WORKER.lower(), [])
         num_processes = max(1, len(workers))
@@ -390,6 +442,58 @@ class TpuReplicaSet:
                 job.job.spec.training.to_env()
                 if job.job.spec.training is not None else None
             ),
+        )
+
+    def _serving_rendezvous(self, index: int) -> RendezvousSpec:
+        """Fleet bootstrap (spec.serving): every engine replica is an
+        INDEPENDENT single-process JAX world (num_processes=1 — there
+        is no SPMD gang to rendezvous, and a multi-replica worker env
+        must never trigger jax.distributed across engines). The router
+        is a device-less control/data process. Both carry the serving
+        env contract instead of gang wiring."""
+        serving = self.job.job.spec.serving
+        own = f"{self.job_name(index)}:{self.spec.port}"
+        env: Dict[str, str] = {}
+        if self.spec.replica_type == WORKER:
+            env["KTPU_SERVING_REPLICA"] = str(index)
+            env["KTPU_SERVING_ADVERTISE"] = \
+                f"{self.job_name(index)}:{serving.engine_port}"
+            if serving.prefix_tokens:
+                env["KTPU_SERVING_PREFIX_TOKENS"] = \
+                    str(serving.prefix_tokens)
+            if serving.max_queue_depth:
+                env["KTPU_SERVING_MAX_QUEUE"] = \
+                    str(serving.max_queue_depth)
+        else:  # ROUTER
+            worker_set = next(
+                (r for r in self.job.replicas
+                 if r.spec.replica_type == WORKER), None)
+            peers = []
+            if worker_set is not None:
+                # the WHOLE autoscale range: indices above the current
+                # count resolve dead until a scale-up materializes them
+                # — the router's poller handles both states
+                for i in range(serving.bounds()[1]):
+                    peers.append(
+                        f"{i}=http://{worker_set.job_name(i)}:"
+                        f"{serving.engine_port}")
+            env["KTPU_SERVING_PEERS"] = ",".join(peers)
+            env["KTPU_ROUTER_ADVERTISE"] = \
+                f"{self.job_name(index)}:{serving.router_port}"
+            if serving.prefix_tokens:
+                env["KTPU_ROUTER_PREFIX_TOKENS"] = \
+                    str(serving.prefix_tokens)
+        return RendezvousSpec(
+            coordinator_address=own,
+            process_id=0,
+            num_processes=1,
+            replica_type=self.spec.replica_type,
+            task_index=index,
+            worker_hostnames=(
+                [self.job_name(index)]
+                if self.spec.replica_type == WORKER else None),
+            cluster=self.job.cluster_spec(),
+            serving_env=env,
         )
 
     def _checkpoint_env(self, workers) -> Optional[Dict[str, str]]:
@@ -549,13 +653,31 @@ class TpuReplicaSet:
                 return True
         return False
 
+    def delete_index(self, index: int) -> None:
+        """Scale-down teardown of ONE replica index (serving fleets):
+        delete its batch Job + Pods but KEEP the per-index Service —
+        the DNS name stays stable for the next scale-up, and the
+        router's poller marks the index down the moment the pod is
+        gone."""
+        sel = dict(self.task_labels(index))
+        jobs = self.client.jobs.list(self.namespace, sel)
+        pods = self.client.pods.list(self.namespace, sel)
+        self._tombstone(jobs)
+        self._tombstone(pods)
+        self._retry_transient(
+            f"scale-down jobs delete [{index}]",
+            lambda: self.client.jobs.delete_collection(self.namespace, sel))
+        self._retry_transient(
+            f"scale-down pods delete [{index}]",
+            lambda: self.client.pods.delete_collection(self.namespace, sel))
+
     def delete(self) -> None:
         """Teardown (reference replicas.go:299-356): bulk-delete Jobs and
         Pods by selector, Services per-name, then the launcher ConfigMap."""
         sel = dict(self.default_labels())
         self.client.jobs.delete_collection(self.namespace, sel)
         self.client.pods.delete_collection(self.namespace, sel)
-        for index in range(self.spec.replicas or 0):
+        for index in range(self._service_count()):
             try:
                 self.client.services.delete(self.namespace, self.job_name(index))
             except errors.NotFoundError:

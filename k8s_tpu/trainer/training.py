@@ -88,6 +88,14 @@ class TrainingJob:
         self._rejected_at = 0.0
         self._restart_backoff: Optional[Backoff] = None
         self._backoff_waiting = False  # dedupe the BackoffRestarting condition
+        # Serving-fleet autoscaling (spec.serving, docs/SERVING.md
+        # "Fleet"): the decision object is built lazily from the spec;
+        # the stats source is pluggable so tier-1 drives the scaling
+        # loop with injected router views (the default fetcher GETs the
+        # router Service's /healthz, best-effort — an unreachable
+        # router must never wedge a reconcile tick)
+        self._serving_autoscaler = None
+        self.router_stats_fetcher: Optional[Callable[[], Optional[dict]]] = None
         # (clock_time, delay_armed_for_the_NEXT_restart) per restart —
         # what the soak asserts spacing from
         self.restart_history: List[Tuple[float, float]] = []
@@ -345,6 +353,107 @@ class TrainingJob:
                     log.error("job %s: gang teardown: %s", self.fullname, e)
         return "restarted"
 
+    # ------------------------------------------------------------ serving
+
+    def _worker_set(self) -> Optional[TpuReplicaSet]:
+        for r in self.replicas:
+            if r.spec.replica_type == WORKER:
+                return r
+        return None
+
+    def _http_router_stats(self) -> Optional[dict]:
+        """Default router-stats source: GET the router Service's
+        /healthz (stable per-index DNS on a real cluster). Any failure
+        is a miss — the autoscaler simply holds."""
+        import json as _json
+        import urllib.request
+
+        serving = self.job.spec.serving
+        router_set = next(
+            (r for r in self.replicas
+             if r.spec.replica_type == "ROUTER"), None)
+        if serving is None or router_set is None:
+            return None
+        url = (f"http://{router_set.job_name(0)}:"
+               f"{serving.router_port}/healthz")
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return _json.loads(r.read())
+        except Exception:
+            return None
+
+    def _maybe_autoscale_serving(self) -> None:
+        """SLO autoscaling tick (spec.serving): compare the router's
+        aggregated TTFT/ITL p95s to the SLOs and move the WORKER
+        replica count within [minReplicas, maxReplicas]. Scale-up just
+        bumps the count — the next reconcile tick's create_resources
+        materializes the new index against its pre-created Service;
+        scale-down tears the top indices' Jobs/Pods down (Services
+        stay — stable DNS for the next scale-up). All damping lives in
+        :class:`k8s_tpu.router.autoscaler.SloAutoscaler` (streak
+        hysteresis + the PR-1 Backoff hold-off)."""
+        from k8s_tpu.controller import metrics
+
+        serving = self.job.spec.serving
+        w = self.job.spec.replica_spec(WORKER)
+        wset = self._worker_set()
+        if serving is None or w is None or wset is None:
+            return
+        current = w.replicas or 0
+        self.status.serving_replicas = current
+        metrics.SERVING_REPLICAS.set(
+            float(current), {"job": self.fullname})
+        if not serving.autoscale_enabled():
+            return
+        if self._serving_autoscaler is None:
+            from k8s_tpu.router.autoscaler import SloAutoscaler
+
+            lo, hi = serving.bounds()
+            self._serving_autoscaler = SloAutoscaler(
+                lo, hi,
+                slo_ttft_ms=serving.slo_ttft_ms,
+                slo_itl_ms=serving.slo_itl_ms,
+                clock=self.clock,
+            )
+        fetch = self.router_stats_fetcher or self._http_router_stats
+        try:
+            stats = fetch()
+        except Exception as e:
+            log.warning("job %s: router stats fetch: %s", self.fullname, e)
+            return
+        if not stats:
+            return
+        desired, reason = self._serving_autoscaler.observe(
+            current, stats.get("slo") or {})
+        if desired == current:
+            return
+        direction = "up" if desired > current else "down"
+        if desired < current:
+            for idx in range(desired, current):
+                try:
+                    wset.delete_index(idx)
+                except Exception as e:
+                    log.error("job %s: scale-down of replica %d: %s",
+                              self.fullname, idx, e)
+        # mutate BOTH views: the job spec (persisted by the next status
+        # write) and the live replica set's spec (create/snapshot read
+        # it, and after a status write self.job is the server's
+        # round-trip object — a different instance than wset.spec)
+        w.replicas = desired
+        wset.spec.replicas = desired
+        self.status.serving_replicas = desired
+        metrics.SERVING_SCALE_EVENTS.inc({"direction": direction})
+        metrics.SERVING_REPLICAS.set(
+            float(desired), {"job": self.fullname})
+        self.status.append_condition(
+            "ServingScaled",
+            reason=f"replicas {current} -> {desired}: {reason}")
+        log.info("job %s: serving scaled %d -> %d (%s)",
+                 self.fullname, current, desired, reason)
+        self._record_event(
+            "ServingScaled",
+            f"serving replicas {current} -> {desired} ({reason})")
+
     def _record_event(self, reason: str, message: str,
                       etype: str = "Normal") -> None:
         """Best-effort event write: a transient apiserver error must
@@ -440,6 +549,14 @@ class TrainingJob:
                     return
                 if gang == "exhausted":
                     state = TpuJobState.FAILED
+            if self.job.spec.serving is not None and state == TpuJobState.RUNNING:
+                try:
+                    self._maybe_autoscale_serving()
+                except Exception as e:
+                    # autoscaling is best-effort — it must never take
+                    # down the reconcile tick that keeps the fleet up
+                    log.error("job %s: serving autoscale: %s",
+                              self.fullname, e)
             self.status.replica_statuses = replica_statuses
             if state == TpuJobState.FAILED:
                 self.status.phase = TpuJobPhase.DONE

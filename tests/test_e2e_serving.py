@@ -317,3 +317,174 @@ def test_rest_backed_serving_job(tmp_path):
         if kubelet is not None:
             kubelet.stop()
         api.stop()
+
+
+@pytest.mark.integration
+def test_fleet_serving_job_rest_backed(tmp_path):
+    """The serving FLEET end to end over the REAL wire (ISSUE 7):
+    ``spec.serving`` makes the operator (talking to a LocalApiServer
+    through RestCluster) materialize N engine pods + one router pod;
+    the local kubelet's service resolver rewrites the fleet's
+    Service-DNS env (KTPU_SERVING_ADVERTISE / KTPU_SERVING_PEERS) to
+    loopback ports, so the subprocess router genuinely discovers the
+    subprocess engines the way a cluster router resolves per-index
+    Services. Traffic through the router spreads over both replicas;
+    SIGKILLing one engine mid-flight loses ZERO accepted requests
+    (retried on the peer); prefix affinity + shared-prefix KV reuse
+    show up in the replica's measured stats; deleting the job drains
+    the fleet."""
+    import os
+    import signal
+    import threading
+
+    from k8s_tpu.api.apiserver import LocalApiServer
+    from k8s_tpu.api.restcluster import RestCluster
+
+    api = LocalApiServer().start()
+    controller = kubelet = None
+    try:
+        client = KubeClient(RestCluster(api.url))
+        jc = TpuJobClient(RestCluster(api.url))
+        node_client = KubeClient(api.cluster)
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.1)
+        executor = SubprocessExecutor(
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "KTPU_FORCE_PLATFORM": "cpu",
+                "KTPU_NUM_CPU_DEVICES": "1",
+                # workers run the serving program; the router pod's
+                # template env overrides KTPU_PROGRAM with the router
+                "KTPU_PROGRAM": "k8s_tpu.programs.serving:main",
+                "KTPU_PROGRAM_ARGS": (
+                    "--model=tiny --max_seq_len=64 --max_slots=2 "
+                    "--decode_chunk=4 --prompt_buckets=4,8,16 "
+                    "--prefill_chunk=4"
+                ),
+            },
+        )
+        kubelet = LocalKubelet(node_client, executor)
+        kubelet.start()
+        controller.start()
+
+        j = S.TpuJob()
+        j.metadata.name = "serve-fleet"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER")
+        ]
+        j.spec.serving = S.ServingSpec(
+            replicas=2, prefix_tokens=8, engine_port=8000,
+            router_port=8080)
+        jc.create(j)
+
+        # all three pods ready: 2 engines + the router, each printing
+        # its machine-readable ready event with pid + bound port
+        def _log(name):
+            import glob
+
+            pats = glob.glob(str(tmp_path / "logs" / f"{name}-*.log"))
+            return {p: open(p).read() for p in sorted(pats)}
+
+        deadline = time.monotonic() + 300
+        engines, router = {}, None
+        while time.monotonic() < deadline:
+            engines, router = {}, None
+            for path, log in _log("serve-fleet").items():
+                for line in log.splitlines():
+                    if '"event": "serving_ready"' in line:
+                        ev = json.loads(line)
+                        engines[ev["replica"]] = ev
+                    elif '"event": "router_ready"' in line:
+                        router = json.loads(line)
+            if len(engines) == 2 and router is not None:
+                break
+            time.sleep(0.3)
+        assert len(engines) == 2 and router is not None, (
+            engines, router, _log("serve-fleet"))
+        # the operator materialized the whole fleet as API objects
+        names = sorted(x.metadata.name for x in client.jobs.list("default"))
+        assert sum("worker" in n for n in names) == 2, names
+        assert sum("router" in n for n in names) == 1, names
+
+        # the router subprocess discovered both engine subprocesses
+        # through the rewritten Service-DNS peers env
+        rport = router["port"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            if health["ready_replicas"] == 2:
+                break
+            time.sleep(0.2)
+        assert health["ready_replicas"] == 2, health
+
+        # phase 1 — routed traffic: repeated-system-prompt requests
+        # pin to one replica (affinity) and reuse its prefix KV
+        sys_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        results = []
+        for i in range(4):
+            code, body = _post(
+                rport, {"prompt": sys_prompt + [10 + i],
+                        "max_new_tokens": 4})
+            results.append((code, body))
+        assert [c for c, _ in results] == [200] * 4, results
+        served_by = {b["replica"] for _, b in results}
+        assert len(served_by) == 1, results  # affinity stickiness
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["affinity"]["hits"] >= 3, health["affinity"]
+        # the affine ENGINE measured real prefix-reuse savings
+        affine = served_by.pop()
+        with urllib.request.urlopen(
+                "http://127.0.0.1:{}/healthz".format(
+                    engines[affine]["port"]), timeout=10) as r:
+            estats = json.loads(r.read())["stats"]
+        assert estats["prefix_hits"] >= 3, estats
+        assert estats["prefix_tokens_saved"] >= 24, estats
+
+        # phase 2 — kill one engine mid-flight: zero accepted requests
+        # lost (the router retries them on the peer). Distinct prompts
+        # so both replicas carry traffic when the SIGKILL lands.
+        out2 = {}
+
+        def one(i):
+            code, body = _post(
+                rport, {"prompt": [i + 1, i + 2, i + 3, i + 4, i + 5],
+                        "max_new_tokens": 12}, timeout=120)
+            out2[i] = (code, body)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        os.kill(engines[0]["pid"], signal.SIGKILL)
+        for t in threads:
+            t.join()
+        codes = [v[0] for v in out2.values()]
+        assert codes == [200] * 6, out2
+        # identical greedy request re-served on the survivor matches
+        # the pre-kill fleet's answer (engine determinism, any replica)
+        code, body = _post(
+            rport, {"prompt": sys_prompt + [10], "max_new_tokens": 4})
+        assert code == 200 and body["tokens"] == results[0][1]["tokens"]
+
+        # delete over REST ⇒ SIGTERM ⇒ router + engines drain
+        jc.delete("default", "serve-fleet")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            logs = "\n".join(_log("serve-fleet").values())
+            if '"event": "router_drained"' in logs:
+                break
+            time.sleep(0.3)
+        logs = "\n".join(_log("serve-fleet").values())
+        assert '"event": "router_drained"' in logs
+    finally:
+        if controller is not None:
+            controller.stop()
+        if kubelet is not None:
+            kubelet.stop()
+        api.stop()

@@ -1,0 +1,616 @@
+"""Serving-fleet router core (ISSUE 7, docs/SERVING.md "Fleet").
+
+Five layers of proof, all tier-1:
+
+- **Scoring** is deterministic: least load wins, queue-depth ties break
+  on the lower index, unroutable replicas are excluded (pure
+  ``note_stats`` → ``pick_replica``, no sockets).
+- **Affinity** sticks a shared prefix to one replica, survives load
+  shifts, and YIELDS when the affine replica saturates or dies.
+- **Autoscaler hysteresis**: scale only after consecutive breaches /
+  clears, a dead band around the SLO boundary, and the Backoff
+  hold-off between events — all on a fake clock.
+- **Fleet sequence** (the CI ``serving-fleet`` stage): create → route →
+  kill-one-mid-flight → drain over stand-in engines; a killed
+  replica's in-flight requests are retried on a peer, zero lost. Plus
+  the mid-restart poll tolerance fix and the chaos fault classes.
+- **Spec round-trip**: ``spec.serving`` validation, defaulting (router
+  replica synthesis), operator env injection (peers over the whole
+  maxReplicas range, independent single-process engine worlds), and
+  the reconciler-side autoscaling loop mutating real cluster objects.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_tpu.router import (
+    LocalFleet,
+    Router,
+    SloAutoscaler,
+    StandinEngine,
+    parse_peers,
+    prefix_key,
+)
+from k8s_tpu.router import router as router_mod
+
+
+def _bare_router(n=3, **kw):
+    """Router over fake endpoints, never started — the pure-policy
+    test surface (note_stats in, pick_replica out)."""
+    kw.setdefault("prefix_tokens", 4)
+    r = Router({i: f"http://replica-{i}:1" for i in range(n)}, **kw)
+    r._server.server_close()  # no HTTP in policy tests
+    return r
+
+
+def _stats(queue_depth=0, in_flight=0, draining=False, progress=None):
+    return {
+        "ok": not draining,
+        "draining": draining,
+        "in_flight": in_flight,
+        "stats": {"queue_depth": queue_depth},
+        "scheduler": {"prefill_chunk": 8},
+        "prefill_progress": progress or {},
+    }
+
+
+class TestScoring:
+    def test_least_loaded_wins_and_ties_break_low_index(self):
+        r = _bare_router(3)
+        r.note_stats(0, _stats(queue_depth=2))
+        r.note_stats(1, _stats(queue_depth=1))
+        r.note_stats(2, _stats(queue_depth=1))
+        # 1 and 2 tie on load → lower index wins, deterministically
+        assert r.pick_replica([1, 2])[0] == 1
+        assert r.pick_replica([3, 4])[0] == 1
+
+    def test_routed_since_poll_compensates_stale_view(self):
+        r = _bare_router(2)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        with r._lock:
+            r.replicas[0].routed_since_poll = 3
+        assert r.pick_replica([1])[0] == 1
+        # a fresh poll clears the compensation
+        r.note_stats(0, _stats())
+        assert r.pick_replica([1])[0] == 0
+
+    def test_prefill_backlog_counts_in_chunks(self):
+        r = _bare_router(2)
+        r.note_stats(0, _stats(progress={
+            "7": {"done": 0, "total": 40}}))  # 5 chunks of 8 pending
+        r.note_stats(1, _stats(queue_depth=4))
+        assert r.pick_replica([1])[0] == 1  # 4 < 5
+        r.note_stats(1, _stats(queue_depth=6))
+        assert r.pick_replica([1])[0] == 0
+
+    def test_unroutable_replicas_excluded(self):
+        r = _bare_router(3)
+        r.note_stats(0, _stats(draining=True))
+        r.note_poll_failure(1, "connection refused")
+        r.note_stats(2, _stats(queue_depth=50))
+        assert r.pick_replica([1])[0] == 2  # loaded but the only READY
+        r.note_poll_failure(2, "boom")
+        assert r.pick_replica([1]) == (None, "none")
+
+
+class TestPollerTolerance:
+    """Fix en route: stats polling must tolerate a replica
+    mid-restart — refused connections mark it draining/down, never
+    crash the loop, and a recovered replica is routable again."""
+
+    def test_refused_marks_draining_then_down_then_recovers(self):
+        r = _bare_router(2, down_after=2)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        r.note_poll_failure(1, "connection refused")
+        assert r.replicas[1].state == router_mod.DRAINING
+        r.note_poll_failure(1, "connection refused")
+        assert r.replicas[1].state == router_mod.DOWN
+        assert r.pick_replica([1])[0] == 0
+        r.note_stats(1, _stats())  # pod came back
+        assert r.replicas[1].state == router_mod.READY
+        assert r.replicas[1].failures == 0
+
+    def test_poll_loop_survives_dead_endpoint(self):
+        # a live poll against a port nobody listens on: _poll_once
+        # must mark the replica, not raise
+        r = Router({0: "http://127.0.0.1:9"}, poll_timeout=0.2)
+        r._server.server_close()
+        r._poll_once()
+        r._poll_once()
+        assert r.replicas[0].state == router_mod.DOWN
+
+    def test_stats_flake_is_a_miss_not_a_crash(self):
+        fleet = LocalFleet([StandinEngine(round_wall_s=0.001)]).start()
+        try:
+            assert fleet.router.replicas[0].state == router_mod.READY
+            fleet.flake_stats(0, 2)
+            fleet.router._poll_once()
+            assert fleet.router.replicas[0].state == router_mod.DRAINING
+            fleet.router._poll_once()  # second flake
+            fleet.router._poll_once()  # endpoint healthy again
+            assert fleet.router.replicas[0].state == router_mod.READY
+        finally:
+            fleet.stop()
+
+
+class TestAffinity:
+    def test_prefix_key_requires_full_prefix(self):
+        assert prefix_key([1, 2, 3], 4) is None
+        assert prefix_key([1, 2, 3, 4], 4) == prefix_key([1, 2, 3, 4, 9], 4)
+        assert prefix_key([1, 2, 3, 4], 4) != prefix_key([1, 2, 3, 5], 4)
+
+    def test_stickiness_beats_mild_load_imbalance(self):
+        r = _bare_router(2)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        p = [7, 7, 7, 7, 1]
+        first, verdict = r.pick_replica(p)
+        assert verdict == "miss"
+        # the affine replica now carries load the other doesn't — a
+        # hit still sticks (that's where the prefix KV is warm)
+        r.note_stats(first, _stats(queue_depth=3))
+        idx, verdict = r.pick_replica(p + [2])
+        assert (idx, verdict) == (first, "hit")
+
+    def test_fallback_when_affine_saturated_rebinds(self):
+        r = _bare_router(2, saturation_depth=4)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        p = [9, 9, 9, 9]
+        first, _ = r.pick_replica(p)
+        other = 1 - first
+        r.note_stats(first, _stats(queue_depth=10))  # saturated
+        idx, verdict = r.pick_replica(p)
+        assert (idx, verdict) == (other, "fallback")
+        # re-bound: subsequent hits go to the fallback replica
+        idx2, verdict2 = r.pick_replica(p)
+        assert (idx2, verdict2) == (other, "hit")
+
+    def test_fallback_when_affine_dead(self):
+        r = _bare_router(2)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        p = [5, 5, 5, 5]
+        first, _ = r.pick_replica(p)
+        r.note_poll_failure(first, "connection refused")
+        idx, verdict = r.pick_replica(p)
+        assert idx == 1 - first and verdict == "fallback"
+
+    def test_short_prompt_is_unpinned(self):
+        r = _bare_router(2)
+        r.note_stats(0, _stats())
+        r.note_stats(1, _stats())
+        assert r.pick_replica([1, 2])[1] == "none"
+
+
+class TestAutoscalerHysteresis:
+    def _as(self, **kw):
+        clock = {"t": 0.0}
+        kw.setdefault("slo_ttft_ms", 500.0)
+        a = SloAutoscaler(1, 4, clock=lambda: clock["t"], **kw)
+        return a, clock
+
+    def _slo(self, ttft_ms, itl_ms=0.0):
+        return {"window": 32, "ttft_p95_ms": ttft_ms, "itl_p95_ms": itl_ms}
+
+    def test_scale_up_needs_consecutive_breaches(self):
+        a, _ = self._as(breach_ticks=2)
+        assert a.observe(1, self._slo(900))[0] == 1  # one breach: hold
+        assert a.observe(1, self._slo(900))[0] == 2  # second: scale
+
+    def test_boundary_oscillation_never_flaps(self):
+        """p95 bouncing across the SLO boundary: breaches never become
+        consecutive, the neutral band resets both streaks — replica
+        count must not move in either direction."""
+        a, clock = self._as(breach_ticks=2, clear_ticks=2)
+        for i in range(20):
+            clock["t"] += 10.0
+            ttft = 510.0 if i % 2 == 0 else 490.0  # around the 500 SLO
+            desired, _ = a.observe(2, self._slo(ttft))
+            assert desired == 2
+        assert a.scale_events == 0
+
+    def test_backoff_holds_consecutive_scale_events(self):
+        a, clock = self._as(breach_ticks=1)
+        assert a.observe(1, self._slo(900))[0] == 2  # event arms hold
+        # immediate further breaches are held by the backoff
+        desired, reason = a.observe(2, self._slo(900))
+        assert desired == 2 and "held" in reason
+        clock["t"] += 31.0  # base hold is 30s
+        assert a.observe(2, self._slo(900))[0] == 3
+
+    def test_scale_down_needs_clear_margin_and_floor(self):
+        a, clock = self._as(breach_ticks=1, clear_ticks=2,
+                            scale_down_margin=0.5)
+        # 300ms > 0.5*500 → inside the dead band, not "clear"
+        for _ in range(6):
+            clock["t"] += 40.0
+            assert a.observe(2, self._slo(300))[0] == 2
+        # truly clear (< 250ms) for clear_ticks → scale down
+        clock["t"] += 40.0
+        assert a.observe(2, self._slo(100))[0] == 2
+        clock["t"] += 40.0
+        assert a.observe(2, self._slo(100))[0] == 1
+        # at minReplicas: never below
+        clock["t"] += 1000.0
+        for _ in range(4):
+            clock["t"] += 40.0
+            assert a.observe(1, self._slo(100))[0] == 1
+
+    def test_max_replicas_cap_and_no_data_holds(self):
+        a, _ = self._as(breach_ticks=1)
+        assert a.observe(4, self._slo(900))[0] == 4  # at cap
+        assert a.observe(2, {})[0] == 2              # no samples: hold
+        assert a.observe(2, {"window": 0})[0] == 2
+
+    def test_disabled_without_slo_or_range(self):
+        a = SloAutoscaler(2, 2, slo_ttft_ms=500.0)
+        assert not a.enabled
+        b = SloAutoscaler(1, 4)
+        assert not b.enabled
+
+
+class TestFleetSequence:
+    """The CI serving-fleet sequence: create → route → kill-one →
+    drain over a router + 2 stand-in engines."""
+
+    def test_route_spread_kill_one_drain_zero_lost(self):
+        fleet = LocalFleet(
+            [StandinEngine(round_wall_s=0.005, decode_chunk=8)
+             for _ in range(2)]).start()
+        try:
+            # route: distinct prefixes spread over both replicas
+            results = {}
+
+            def one(i, max_new=12):
+                code, body = fleet.generate(
+                    list(range(i, i + 20)), max_new)
+                results[i] = (code, body)
+
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert [c for c, _ in results.values()] == [200] * 8
+            spread = {b["replica"] for _, b in results.values()}
+            assert spread == {0, 1}, results
+
+            # kill one replica with requests in flight: every accepted
+            # request must complete on a peer (idempotent retry)
+            results.clear()
+            # 64 tokens at 8/round over a 5 ms roofline: no request can
+            # finish before the kill lands 20 ms in — every request
+            # routed to replica 0 is provably mid-flight when it dies
+            ts = [threading.Thread(target=one, args=(i, 64))
+                  for i in range(6)]
+            for t in ts:
+                t.start()
+            time.sleep(0.02)
+            fleet.kill_replica(0)
+            for t in ts:
+                t.join()
+            codes = [c for c, _ in results.values()]
+            assert codes == [200] * 6, results
+            # the survivors all landed on replica 1, with retries
+            assert all(b["replica"] == 1 for _, b in results.values())
+            assert fleet.router.retries > 0
+            # ... and the stand-in oracle: tokens are a function of the
+            # prompt alone, so a retried request's stream is identical
+            # to what the dead replica would have produced
+            eng = StandinEngine()
+            for i, (_, body) in results.items():
+                prompt = np.asarray(range(i, i + 20))
+                req = type("R", (), {"prompt": prompt})
+                want = [eng._token(req, j) for j in range(64)]
+                assert body["tokens"] == want
+
+            # the router's view converges to the loss
+            fleet.router._poll_once()
+            assert fleet.router.replicas[0].state != router_mod.READY
+            health = fleet.router.healthz()
+            assert health["ok"] and health["ready_replicas"] == 1
+        finally:
+            fleet.stop()
+
+    def test_chaos_faults_fire_and_leave_one_standing(self):
+        import random
+
+        from k8s_tpu.runtime.chaos import (
+            RouterReplicaLossFault,
+            RouterStatsFlakeFault,
+        )
+
+        fleet = LocalFleet(
+            [StandinEngine(round_wall_s=0.002) for _ in range(3)]).start()
+        try:
+            rng_seed = 7
+            loss = RouterReplicaLossFault(fleet, rate=1.0, seed=rng_seed)
+            flake = RouterStatsFlakeFault(fleet, rate=1.0, seed=rng_seed)
+            assert flake.fire() is not None
+            fleet.router._poll_once()  # consumes a flake, no crash
+            assert loss.fire() is not None
+            assert loss.fire() is not None
+            # never kills the last replica
+            assert loss.fire() is None
+            assert len(fleet.alive()) == 1
+            # the fleet still serves through the survivor
+            code, body = fleet.generate(list(range(30)), 6)
+            assert code == 200 and body["replica"] == fleet.alive()[0]
+        finally:
+            fleet.stop()
+
+    def test_all_replicas_saturated_surfaces_429_retry_after(self):
+        # backpressure end to end: tiny queue bound + a roofline slow
+        # enough that the flood can't drain — the router must surface
+        # 429 + Retry-After rather than queueing unboundedly
+        fleet = LocalFleet(
+            [StandinEngine(round_wall_s=0.05, max_slots=1,
+                           decode_chunk=2) for _ in range(2)],
+            max_queue_depth=1).start()
+        try:
+            results = []
+
+            def one(i):
+                results.append(fleet.generate(
+                    list(range(i, i + 20)), 30, timeout=30))
+
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in range(10)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            codes = sorted(c for c, _ in results)
+            assert 429 in codes, codes
+            assert set(codes) <= {200, 429}, codes
+        finally:
+            fleet.stop()
+
+
+class TestBackpressure:
+    """Satellite: ServingFrontend 429 + Retry-After on a deep queue."""
+
+    def test_429_with_retry_after_header(self):
+        eng = StandinEngine()
+        from k8s_tpu.serving.server import Overloaded, ServingFrontend
+
+        fe = ServingFrontend(eng, port=0, max_queue_depth=2,
+                             retry_after_s=2.5)
+        fe._http_thread.start()
+        try:
+            eng.submit([1, 2, 3], 4)  # unpumped: queue stays deep
+            eng.submit([1, 2, 3], 4)
+            with pytest.raises(Overloaded):
+                fe.submit_and_wait([1, 2, 3], 4)
+            assert fe.rejected == 1
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/v1/generate",
+                data=json.dumps({"prompt": [1], "max_new_tokens": 2}
+                                ).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"] == "2.5"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/healthz",
+                    timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["rejected"] == 2
+            assert health["scheduler"]["max_queue_depth"] == 2
+        finally:
+            fe._server.shutdown()
+            fe._server.server_close()
+            eng.close()
+
+
+class TestSpecRoundTrip:
+    """spec.serving → operator env → router round-trip (tier-1)."""
+
+    def _job(self, **serving_kw):
+        from k8s_tpu import spec as S
+
+        j = S.TpuJob()
+        j.metadata.name = "fleet"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [S.TpuReplicaSpec(replica_type="WORKER")]
+        j.spec.serving = S.ServingSpec(**serving_kw)
+        return j
+
+    def test_validation(self):
+        from k8s_tpu import spec as S
+
+        j = self._job(replicas=2, max_replicas=4)
+        j.spec.set_defaults()
+        j.spec.validate()
+        with pytest.raises(S.ValidationError):
+            S.ServingSpec(replicas=0).validate()
+        with pytest.raises(S.ValidationError):
+            S.ServingSpec(replicas=3, max_replicas=2).validate()
+        with pytest.raises(S.ValidationError):
+            S.ServingSpec(engine_port=8000, router_port=8000).validate()
+        with pytest.raises(S.ValidationError):
+            S.ServingSpec(slo_ttft_ms=-1).validate()
+        # ROUTER replicas without a serving block are rejected
+        j2 = S.TpuJob()
+        j2.metadata.name = "bad"
+        j2.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="ROUTER", replicas=1,
+                             port=2222)]
+        with pytest.raises(S.ValidationError):
+            j2.spec.validate()
+        # serving fleets need single-host engines
+        j3 = self._job(replicas=2, max_replicas=2)
+        j3.spec.tpu = S.TpuSpec(accelerator="v5p-16")
+        j3.spec.set_defaults()
+        with pytest.raises(S.ValidationError):
+            j3.spec.validate()
+
+    def test_defaults_synthesize_router_and_bounds(self):
+        from k8s_tpu import spec as S
+
+        j = self._job(replicas=2, slo_ttft_ms=500, max_replicas=4)
+        j.spec.set_defaults()
+        assert j.spec.serving.min_replicas == 2
+        assert j.spec.serving.max_replicas == 4
+        router = j.spec.replica_spec(S.ROUTER)
+        assert router is not None and router.replicas == 1
+        env = {e.name: e.value
+               for e in router.template.spec.containers[0].env}
+        assert env["KTPU_PROGRAM"] == "k8s_tpu.programs.router:main"
+        worker = j.spec.replica_spec(S.WORKER)
+        assert worker.replicas == 2  # derived from serving.replicas
+        # defaulting is idempotent: no second router on re-run
+        j.spec.set_defaults()
+        assert sum(1 for r in j.spec.replica_specs
+                   if r.replica_type == S.ROUTER) == 1
+
+    def _materialize(self, job):
+        from k8s_tpu import spec as S
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        jc.create(job)
+        tj = TrainingJob(client, jc, job)
+        tj.setup(S.ControllerConfig())
+        assert tj.status.phase == "Creating", tj.status.reason
+        tj.create_resources(S.ControllerConfig())
+        return client, jc, tj
+
+    def test_operator_env_round_trip(self):
+        job = self._job(replicas=2, max_replicas=4, slo_ttft_ms=500,
+                        prefix_tokens=12, max_queue_depth=64)
+        client, _, tj = self._materialize(job)
+        jobs = client.jobs.list("default")
+        names = sorted(x.metadata.name for x in jobs)
+        rid = job.spec.runtime_id
+        assert f"fleet-router-{rid}-0" in names
+        assert sum("worker" in n for n in names) == 2
+        # services cover the WHOLE maxReplicas range (stable DNS over
+        # scale events) + the router's own
+        services = client.services.list("default")
+        svcs = sorted(s.metadata.name for s in services)
+        assert sum("worker" in s for s in svcs) == 4
+        assert any("router" in s for s in svcs)
+        # a ClusterIP Service forwards only DECLARED ports: the fleet
+        # data plane runs on the serving ports, so every worker Service
+        # must declare enginePort and the router's its routerPort
+        for s in services:
+            declared = {p.port for p in s.spec.ports}
+            if "worker" in s.metadata.name:
+                assert 8000 in declared, (s.metadata.name, declared)
+            elif "router" in s.metadata.name:
+                assert 8080 in declared, (s.metadata.name, declared)
+
+        worker0 = next(x for x in jobs
+                       if x.metadata.name == f"fleet-worker-{rid}-0")
+        env = {e.name: e.value
+               for e in worker0.spec.template.spec.containers[0].env}
+        # each engine is its OWN single-process world — a fleet must
+        # never form one jax.distributed mesh across replicas
+        assert env["KTPU_NUM_PROCESSES"] == "1"
+        assert env["KTPU_PROCESS_ID"] == "0"
+        assert env["KTPU_SERVING_REPLICA"] == "0"
+        assert env["KTPU_SERVING_ADVERTISE"] == \
+            f"fleet-worker-{rid}-0:8000"
+        assert env["KTPU_SERVING_PREFIX_TOKENS"] == "12"
+        assert env["KTPU_SERVING_MAX_QUEUE"] == "64"
+
+        router = next(x for x in jobs if "router" in x.metadata.name)
+        renv = {e.name: e.value
+                for e in router.spec.template.spec.containers[0].env}
+        assert renv["KTPU_PROGRAM"] == "k8s_tpu.programs.router:main"
+        assert renv["KTPU_ROUTER_ADVERTISE"] == \
+            f"fleet-router-{rid}-0:8080"
+        peers = parse_peers(renv["KTPU_SERVING_PEERS"])
+        # the whole autoscale range, in order, over per-index Services
+        assert sorted(peers) == [0, 1, 2, 3]
+        assert peers[3] == f"http://fleet-worker-{rid}-3:8000"
+        # serving workers are NOT a gang: one replica's death must not
+        # tear down its peers
+        assert all(not r.is_gang for r in tj.replicas)
+
+    def test_reconciler_autoscales_against_injected_slo(self):
+        from k8s_tpu import spec as S
+
+        clock = {"t": 0.0}
+        job = self._job(replicas=1, max_replicas=3, slo_ttft_ms=500)
+        client, jc, tj = self._materialize(job)
+        tj.clock = lambda: clock["t"]
+        slo = {"window": 16, "ttft_p95_ms": 900.0, "itl_p95_ms": 1.0}
+        tj.router_stats_fetcher = lambda: {"slo": dict(slo)}
+        cfg = S.ControllerConfig()
+
+        def workers():
+            return sorted(x.metadata.name
+                          for x in client.jobs.list("default")
+                          if "worker" in x.metadata.name)
+
+        assert len(workers()) == 1
+        # two breach ticks → scale 1 → 2; resources materialize next tick
+        tj.reconcile(cfg)
+        tj.reconcile(cfg)
+        assert tj.status.serving_replicas == 2
+        tj.reconcile(cfg)
+        assert len(workers()) == 2
+        assert any(c.type == "ServingScaled"
+                   for c in tj.status.conditions)
+        # breaches continue but the Backoff hold-off damps the ramp
+        tj.reconcile(cfg)
+        assert tj.status.serving_replicas == 2
+        clock["t"] += 31.0
+        tj.reconcile(cfg)
+        tj.reconcile(cfg)
+        assert tj.status.serving_replicas == 3
+        # SLO recovers → after clear_ticks + hold, scale back down;
+        # the removed index's Job goes, its Service stays
+        slo.update(ttft_p95_ms=50.0)
+        clock["t"] += 1000.0
+        for _ in range(6):
+            clock["t"] += 40.0
+            tj.reconcile(cfg)
+        assert tj.status.serving_replicas == 2
+        assert len(workers()) == 2
+        svcs = [s.metadata.name for s in client.services.list("default")]
+        assert sum("worker" in s for s in svcs) == 3  # maxReplicas DNS
+
+    def test_example_yaml_serving_block(self):
+        import os
+
+        from k8s_tpu import spec as S
+        from k8s_tpu.tools.kubectl_local import load_tpu_job_yaml
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "tpu_job_serving.yaml")
+        with open(path) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        job.spec.validate()
+        s = job.spec.serving
+        assert s is not None
+        assert (s.replicas, s.min_replicas, s.max_replicas) == (2, 2, 6)
+        assert s.slo_ttft_ms == 800 and s.slo_itl_ms == 60
+        assert s.prefix_tokens == 32 and s.max_queue_depth == 128
+        assert s.autoscale_enabled()
+        assert job.spec.replica_spec(S.ROUTER) is not None
+
+    def test_router_program_peer_parsing(self):
+        assert parse_peers("0=http://a:1,1=http://b:2/") == {
+            0: "http://a:1", 1: "http://b:2"}
+        assert parse_peers("junk,x=1,2=http://c:3") == {2: "http://c:3"}
+        assert parse_peers("") == {}
