@@ -137,6 +137,27 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_fleet.xml"],
             args.artifacts_dir, cases,
         )
+        # observability gate (ISSUE 9): tracer/flight-recorder units,
+        # structured-event parser, straggler-detector decision table,
+        # Prometheus label-escaping regression, spec/operator round
+        # trip — plus the metrics-lint (next stage). Always on and
+        # fast: a telemetry regression (a span that stopped summing to
+        # TTFT, a gauge that stopped exporting) fails in seconds.
+        ok = ok and stage(
+            "obs",
+            [py, "-m", "pytest", "tests/test_obs.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_obs.xml"],
+            args.artifacts_dir, cases,
+        )
+        # metrics-lint: every ktpu_* series registered in code must be
+        # cataloged in docs/OBSERVABILITY.md and vice versa — doc drift
+        # on the metrics inventory fails CI, not a reader at 3am
+        ok = ok and stage(
+            "metrics-lint",
+            [py, "-m", "k8s_tpu.obs.lint"],
+            args.artifacts_dir, cases,
+        )
         # checkpoint-tier gate (ISSUE 4): commit-marker protocol,
         # restore-planner tier selection, and the peer-fetch unit path
         # (filesystem + REST shard wire) — always on and fast, so a
@@ -178,6 +199,7 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_serving_sched.py",
                       "--ignore=tests/test_router.py",
                       "--ignore=tests/test_ckpt_tiers.py",
+                      "--ignore=tests/test_obs.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

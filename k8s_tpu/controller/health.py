@@ -13,6 +13,10 @@ Routes:
                    with no re-acquire).
   ``/metrics``  -> Prometheus text exposition from the process-global
                    :data:`k8s_tpu.controller.metrics.REGISTRY`.
+  ``/debug/flightrecorder``
+                -> the attached flight recorder's ring of recent spans/
+                   events (404 when none attached) — the live half of
+                   the post-mortem surface (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -68,6 +72,22 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debug/flightrecorder":
+            import json
+
+            rec = self.server.owner.flight_recorder
+            if rec is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps(
+                {"entries": rec.snapshot()}, default=str
+            ).encode() + b"\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self.send_response(404)
             self.end_headers()
@@ -79,6 +99,11 @@ class _Handler(BaseHTTPRequestHandler):
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
     owner: "HealthServer"
+    # the stock listen backlog of 5 drops SYNs when a liveness probe, a
+    # Prometheus scrape, a straggler-aggregation poll, and a flight-
+    # recorder pull land together — each drop costs a 1s TCP retransmit
+    # (the same cliff measured and fixed in the router/frontend, PR 7)
+    request_queue_size = 128
 
 
 class HealthServer:
@@ -89,13 +114,18 @@ class HealthServer:
     """
 
     def __init__(self, port: int, registry: Optional[metrics.Registry] = None,
-                 host: str = "0.0.0.0", stats_provider=None):
+                 host: str = "0.0.0.0", stats_provider=None,
+                 flight_recorder=None):
         self.registry = registry or metrics.REGISTRY
         self.healthy = True
         # optional callable returning a dict merged into the /healthz
         # body (checkpoint goodput, scheduler stats, ...); None keeps
         # the plain "ok" contract
         self.stats_provider = stats_provider
+        # optional k8s_tpu.obs.trace.FlightRecorder served live at
+        # /debug/flightrecorder (the on-disk dump covers the dead-pod
+        # case; this route covers the live one)
+        self.flight_recorder = flight_recorder
         self._server = _Server((host, port), _Handler)
         self._server.owner = self
         self.port = self._server.server_address[1]

@@ -15,6 +15,21 @@ from typing import Dict, List, Optional, Tuple
 LabelKV = Tuple[Tuple[str, str], ...]
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash,
+    double-quote, and newline must be escaped or a single hostile/odd
+    value (a job name with a quote, a multi-line error string) corrupts
+    the WHOLE scrape. Order matters: backslash first."""
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping per the text format: backslash and newline."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, help_text: str, mtype: str):
         self.name = name
@@ -33,11 +48,14 @@ class _Metric:
             self.values.clear()
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.type}"]
         with self._lock:
             for key, v in sorted(self.values.items()):
                 if key:
-                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    lbl = ",".join(
+                        f'{k}="{_escape_label_value(val)}"'
+                        for k, val in key)
                     out.append(f"{self.name}{{{lbl}}} {v}")
                 else:
                     out.append(f"{self.name} {v}")
@@ -210,4 +228,23 @@ SERVING_SCALE_EVENTS = REGISTRY.counter(
 SERVING_REPLICAS = REGISTRY.gauge(
     "ktpu_router_serving_replicas",
     "Current desired serving replica count per job",
+)
+# Step-phase telemetry + gang straggler detection (k8s_tpu/obs,
+# docs/OBSERVABILITY.md). Fed by the reconciler's per-host heartbeat
+# aggregation over the workers' obs endpoints.
+OBS_STEP_SKEW = REGISTRY.gauge(
+    "ktpu_obs_step_skew_seconds",
+    "Gang busy-step-time skew (slowest host - peer median), by job",
+)
+OBS_HOST_STEP_TIME = REGISTRY.gauge(
+    "ktpu_obs_host_step_time_seconds",
+    "Latest per-host train-step wall time, by job/host",
+)
+OBS_PHASE_SECONDS = REGISTRY.gauge(
+    "ktpu_obs_phase_seconds",
+    "Latest per-host step-phase duration, by job/host/phase",
+)
+OBS_STRAGGLERS = REGISTRY.counter(
+    "ktpu_obs_stragglers_total",
+    "StragglerDetected verdicts raised, by job",
 )

@@ -73,6 +73,12 @@ class Rendezvous:
         self.zero1 = env.get("KTPU_ZERO1", "") in ("1", "true")
         self.latency_hiding = env.get(
             "KTPU_LATENCY_HIDING", "") in ("1", "true")
+        # observability contract (spec.observability + the job trace id
+        # — consumed by k8s_tpu.obs via programs.common; parsed here so
+        # the contract is visible at the launch boundary)
+        self.trace_id = env.get("KTPU_TRACE_ID", "")
+        self.obs_advertise = env.get("KTPU_OBS_ADVERTISE", "")
+        self.flight_dir = env.get("KTPU_FLIGHT_DIR", "")
 
     @property
     def is_distributed(self):
@@ -223,6 +229,20 @@ def is_retryable_error(e):
     return any(m in text for m in _RETRYABLE_MARKERS)
 
 
+def _dump_flight(reason):
+    """Best-effort flight-recorder dump (k8s_tpu.obs): the post-mortem
+    must exist on disk before the process dies, whatever kills it —
+    SIGTERM, a crash exit, or preemption. Never raises and never
+    requires the obs package (bare images running the mesh smoke check
+    simply skip it)."""
+    try:
+        from k8s_tpu.obs.trace import dump_default
+
+        return dump_default(reason)
+    except Exception:
+        return None
+
+
 def install_preemption_handler():
     """TPU maintenance/preemption events arrive as SIGTERM with a grace
     period (GKE node drain; the kubelet sim mirrors it: SIGTERM, 10s,
@@ -247,6 +267,11 @@ def install_preemption_handler():
     def handler(signum, frame):
         os.environ["KTPU_PREEMPT_REQUESTED"] = "1"
         print(json.dumps({"event": "preempt_requested"}), flush=True)
+        # flush the flight recorder NOW: a preempt-aware program will
+        # dump again at its step boundary, but a program that ignores
+        # the flag (or never reaches another step) still leaves its
+        # last spans on disk for the post-mortem
+        _dump_flight("sigterm")
         if os.environ.get("KTPU_PREEMPT_AWARE") != "1":
             os._exit(EX_RETRYABLE)  # signal-safe; prior default behavior
 
@@ -311,6 +336,7 @@ def main(argv=None):
             os._exit(EX_OK)
         return EX_OK
     except Exception as e:
+        _dump_flight("crash")
         if is_retryable_error(e):
             # a peer died out from under us mid-collective: the gang
             # restart path recovers this; exiting permanent would

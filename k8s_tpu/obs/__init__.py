@@ -1,0 +1,25 @@
+"""Observability layer: tracing, step-phase telemetry, flight
+recorder, structured events, straggler detection (docs/OBSERVABILITY.md).
+
+Dependency-free (stdlib only) by design: every piece of it rides in
+the same ConfigMap-shipped image as the launcher and must import in a
+bare pod, a test harness, and the operator process alike.
+"""
+
+from k8s_tpu.obs.events import (  # noqa: F401
+    events_of,
+    last_event,
+    parse_events,
+)
+from k8s_tpu.obs.straggler import (  # noqa: F401
+    StragglerDetector,
+    StragglerVerdict,
+)
+from k8s_tpu.obs.trace import (  # noqa: F401
+    FlightRecorder,
+    Tracer,
+    arm_slow_host,
+    default_tracer,
+    dump_default,
+    set_default_tracer,
+)

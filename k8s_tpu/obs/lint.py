@@ -1,0 +1,90 @@
+"""Metrics-docs lint: every ``ktpu_*`` series registered in code must
+be cataloged in docs/OBSERVABILITY.md, and vice versa.
+
+A metric nobody can find is dead weight and a documented metric that
+no longer exists is a debugging trap, so the CI ``obs`` stage (and a
+tier-1 test) fails on drift in EITHER direction. Registration sites
+are found syntactically — the first string argument of any
+``.counter(`` / ``.gauge(`` call under ``k8s_tpu/`` whose name starts
+with ``ktpu_`` — so a new series added anywhere in the package is
+caught without a central list to forget to update.
+
+Run: ``python -m k8s_tpu.obs.lint`` (exit 1 + readable diff on drift).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Set
+
+_REGISTER_RE = re.compile(
+    r"\.(?:counter|gauge)\(\s*\n?\s*\"(ktpu_[a-z0-9_]*[a-z0-9])\"")
+_DOC_RE = re.compile(r"\bktpu_[a-z0-9_]*[a-z0-9]\b")
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_DOC = os.path.join(_REPO_ROOT, "docs", "OBSERVABILITY.md")
+DEFAULT_SRC = os.path.join(_REPO_ROOT, "k8s_tpu")
+
+
+def registered_series(src_root: str = DEFAULT_SRC) -> Set[str]:
+    """Every ktpu_* series name passed to a .counter()/.gauge() call
+    under ``src_root`` (tests excluded by construction — they live
+    outside the package)."""
+    out: Set[str] = set()
+    for dirpath, _dirnames, filenames in os.walk(src_root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                out.update(_REGISTER_RE.findall(f.read()))
+    return out
+
+
+def documented_series(doc_path: str = DEFAULT_DOC) -> Set[str]:
+    """Every ktpu_* token mentioned in the catalog doc. The doc must
+    therefore spell out full series names (no ``ktpu_foo_*`` wildcard
+    prose) — that is the point: the catalog IS the inventory."""
+    if not os.path.exists(doc_path):
+        return set()
+    with open(doc_path) as f:
+        return set(_DOC_RE.findall(f.read()))
+
+
+def lint(src_root: str = DEFAULT_SRC, doc_path: str = DEFAULT_DOC
+         ) -> List[str]:
+    """Return a list of human-readable problems (empty = clean)."""
+    problems: List[str] = []
+    if not os.path.exists(doc_path):
+        return [f"metrics catalog missing: {doc_path}"]
+    reg = registered_series(src_root)
+    doc = documented_series(doc_path)
+    for name in sorted(reg - doc):
+        problems.append(
+            f"registered but not documented in "
+            f"{os.path.relpath(doc_path, _REPO_ROOT)}: {name}")
+    for name in sorted(doc - reg):
+        problems.append(
+            f"documented but not registered anywhere under k8s_tpu/: "
+            f"{name}")
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = lint()
+    if problems:
+        print("metrics-lint: FAILED")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    n = len(registered_series())
+    print(f"metrics-lint: ok ({n} ktpu_* series, all documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

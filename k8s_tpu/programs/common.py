@@ -61,6 +61,11 @@ def maybe_preempt_exit(mgr, rdzv, step: int, state) -> None:
     mgr.close()
     print(json.dumps({"event": "preempt_checkpoint", "step": step}),
           flush=True)
+    # same signal path, same guarantee: the flight recorder's final
+    # spans land on node-local disk next to the flushed checkpoint
+    from k8s_tpu.obs.trace import dump_default
+
+    dump_default("preempt")
     raise SystemExit(143)
 
 
@@ -151,6 +156,71 @@ def build_checkpoint_manager(cfg: RunConfig, rdzv):
 
         return CheckpointManager(cfg.checkpoint_dir), None
     return None, None
+
+
+def build_tracer(rdzv):
+    """The one tracer-construction path every training program shares:
+    trace id + knobs from the operator env (KTPU_TRACE_*), host/task
+    identity from the rendezvous, registered as the process default so
+    the launcher's SIGTERM/crash/preempt paths can dump the flight
+    recorder (docs/OBSERVABILITY.md)."""
+    from k8s_tpu.obs.trace import Tracer, set_default_tracer
+
+    host = max(0, getattr(rdzv, "process_id", 0))
+    tracer = Tracer.from_env(
+        task=f"{getattr(rdzv, 'replica_type', 'worker')}-{host}",
+        host=host,
+    )
+    set_default_tracer(tracer)
+    return tracer
+
+
+def start_obs_server(rdzv, tracer, extra_stats=None):
+    """Per-host observability endpoint (spec.observability →
+    ``KTPU_OBS_ADVERTISE`` = "<svc-dns>:<port>", rewritten to a
+    loopback endpoint by the local kubelet's resolver): serves the
+    step heartbeat (+ any ``extra_stats``, e.g. checkpoint goodput) in
+    the /healthz stats block, the process-global /metrics registry,
+    and the live flight recorder at /debug/flightrecorder.
+
+    Best-effort: an unbindable port degrades observability for this
+    host, never the training job. Returns the server or None; the
+    bound port is printed as the machine-readable ``obs_ready`` event
+    (the straggler e2e's discovery contract)."""
+    advertise = os.environ.get("KTPU_OBS_ADVERTISE", "")
+    if not advertise:
+        return None
+    port = 0
+    if ":" in advertise:
+        try:
+            port = int(advertise.rsplit(":", 1)[1])
+        except ValueError:
+            port = 0
+
+    def stats():
+        out = {"obs": tracer.heartbeat()}
+        if extra_stats is not None:
+            try:
+                out.update(extra_stats() or {})
+            except Exception:
+                pass  # aux stats must never break the heartbeat
+        return out
+
+    from k8s_tpu.controller.health import HealthServer
+
+    host_id = max(0, getattr(rdzv, "process_id", 0))
+    try:
+        srv = HealthServer(
+            port=port, host="0.0.0.0", stats_provider=stats,
+            flight_recorder=tracer.recorder,
+        ).start()
+    except OSError as e:
+        print(json.dumps({"event": "obs_error", "host": host_id,
+                          "error": str(e)}), flush=True)
+        return None
+    print(json.dumps({"event": "obs_ready", "host": host_id,
+                      "port": srv.port}), flush=True)
+    return srv
 
 
 class maybe_profile:

@@ -27,9 +27,11 @@ from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
 from k8s_tpu.programs.common import (
     MetricLogger,
     build_checkpoint_manager,
+    build_tracer,
     mark_preempt_aware,
     maybe_preempt_exit,
     parse_run_config,
+    start_obs_server,
 )
 from k8s_tpu.train import (
     create_sharded_state,
@@ -159,6 +161,17 @@ def main(rdzv) -> None:
     # construction path for every training program (docs/CHECKPOINT.md)
     mgr, peer_server = build_checkpoint_manager(cfg, rdzv)
     multi_tier = hasattr(mgr, "note_step")
+    # tracing + per-host obs endpoint (docs/OBSERVABILITY.md): the
+    # tracer wraps every step in phase spans (feeding the flight
+    # recorder + the heartbeat the reconciler's straggler detection
+    # aggregates); the obs server publishes them — with the checkpoint
+    # goodput block riding along when the multi-tier manager is on
+    tracer = build_tracer(rdzv)
+    obs_server = start_obs_server(
+        rdzv, tracer,
+        extra_stats=(lambda: {"ckpt": mgr.goodput()}) if multi_tier
+        else None,
+    )
     if mgr is not None:
         restored = mgr.restore(state)
         if restored is not None:
@@ -251,25 +264,53 @@ def main(rdzv) -> None:
     # host only blocks at log points and after the loop
     first_loss = final_loss = None
     for step in range(start + 1, cfg.steps + 1):
-        if step_sleep:
-            import time as _time
+        # every step runs inside a trace span with phase breakdown
+        # (data_wait / step_compute / host_sync / ckpt_save — the
+        # taxonomy docs/OBSERVABILITY.md catalogs): the per-step record
+        # lands in the flight recorder ring and refreshes the heartbeat
+        # the reconciler's straggler detection reads. A preempt exit
+        # raising out of the span still finalizes + flushes it.
+        with tracer.step(step) as st:
+            if step_sleep:
+                import time as _time
 
-            _time.sleep(step_sleep)
-        state, metrics = step_fn(state, next(data), rng)
-        final_loss = metrics["loss"]
-        if first_loss is None:
-            first_loss = final_loss
-        if step % cfg.log_every == 0 or step == cfg.steps:
-            logger.log(step, {"loss": float(final_loss)})
-        maybe_preempt_exit(mgr, rdzv, step, state)
-        if multi_tier:
-            # the manager routes: local tier every localIntervalSteps
-            # (cheap device→host + node-local write), persistent tier
-            # every persistentIntervalSteps
-            mgr.save(step, state)
-            mgr.note_step(step)
-        elif mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
-            mgr.save(step, state)
+                _time.sleep(step_sleep)
+            with st.phase("data_wait"):
+                batch = next(data)
+            with st.phase("step_compute"):
+                state, metrics = step_fn(state, batch, rng)
+            final_loss = metrics["loss"]
+            if first_loss is None:
+                first_loss = final_loss
+            if step % cfg.log_every == 0 or step == cfg.steps:
+                with st.phase("host_sync"):
+                    # the ONLY per-step host sync (see the loop note
+                    # above) — now measured instead of invisible
+                    loss_f = float(final_loss)
+                logger.log(step, {"loss": loss_f})
+            maybe_preempt_exit(mgr, rdzv, step, state)
+            if multi_tier:
+                # the manager routes: local tier every localIntervalSteps
+                # (cheap device→host + node-local write), persistent tier
+                # every persistentIntervalSteps
+                with st.phase("ckpt_save"):
+                    mgr.save(step, state)
+                mgr.note_step(step)
+            elif mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+                with st.phase("ckpt_save"):
+                    mgr.save(step, state)
+        if (step % cfg.log_every == 0 or step == cfg.steps) \
+                and rdzv.process_id <= 0 and tracer.enabled:
+            # the per-step breakdown, machine-readable next to the
+            # loss line: where did this step's wall time go
+            last = tracer.last_step()
+            print(json.dumps({
+                "event": "step_phases", "step": step,
+                "wall_ms": round(1e3 * last.get("step_time_s", 0.0), 3),
+                "phases_ms": {
+                    k: round(1e3 * v, 3)
+                    for k, v in (last.get("phases_s") or {}).items()},
+            }), flush=True)
     if first_loss is not None:
         first_loss = float(first_loss)
         final_loss = float(final_loss)
@@ -284,6 +325,9 @@ def main(rdzv) -> None:
         mgr.close()
     if peer_server is not None:
         peer_server.stop()
+    tracer.flush("done")
+    if obs_server is not None:
+        obs_server.stop()
     # --require_convergence=R: the job FAILS (permanent — a learning
     # bug is deterministic, retrying wastes the gang-restart budget)
     # unless final_loss < R * first_loss. With --data=learnable this

@@ -49,6 +49,7 @@ class _Req:
         self.submitted_at = time.perf_counter()
         self.first_token_at = 0.0
         self.finished_at = 0.0
+        self.prefill_start_at = 0.0
         self.prefill_remaining = int(len(prompt))
         self.token_times: List = []
 
@@ -125,6 +126,7 @@ class StandinEngine:
             for i in range(self.max_slots):
                 if self._slots[i] is None and self._queue:
                     self._slots[i] = self._queue.pop(0)
+                    self._slots[i].prefill_start_at = time.perf_counter()
                     self.stats["prefills"] += 1
             self.stats["queue_depth"] = len(self._queue)
             active = [r for r in self._slots if r is not None]

@@ -190,6 +190,10 @@ class Router:
         self.affinity_misses = 0
         self.affinity_fallbacks = 0
         self._slo: deque = deque(maxlen=int(slo_window))
+        # per-request span samples (router_s + the engine-side
+        # decomposition) backing the /healthz ``trace`` block — the
+        # aggregate view of where TTFT goes (docs/OBSERVABILITY.md)
+        self._spans: deque = deque(maxlen=int(slo_window))
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
 
@@ -238,7 +242,9 @@ class Router:
                     prompt = [int(t) for t in payload["prompt"]]
                 except Exception as e:
                     return self._json(400, {"error": f"bad request: {e}"})
-                code, out, headers = router.route_and_forward(prompt, body)
+                code, out, headers = router.route_and_forward(
+                    prompt, body,
+                    trace_id=self.headers.get("X-KTPU-Trace-Id", ""))
                 return self._json(code, out, headers=headers)
 
         class Server(ThreadingHTTPServer):
@@ -378,19 +384,32 @@ class Router:
 
     # ------------------------------------------------------------ data path
 
-    def _forward(self, url: str, body: bytes):
+    def _forward(self, url: str, body: bytes, trace_id: str = ""):
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            # trace propagation: the replica stamps its spans under
+            # the SAME id this router (and its caller) logs
+            headers["X-KTPU-Trace-Id"] = trace_id
         req = urllib.request.Request(
-            url + "/v1/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            url + "/v1/generate", data=body, headers=headers)
         with urllib.request.urlopen(
                 req, timeout=self.request_timeout) as resp:
             return resp.status, json.loads(resp.read())
 
-    def route_and_forward(self, prompt, body: bytes):
+    def route_and_forward(self, prompt, body: bytes, trace_id: str = ""):
         """Route one request, retrying replica-side failures on peers.
-        Returns ``(http code, payload, extra headers)``."""
+        Returns ``(http code, payload, extra headers)``. The payload
+        carries ``trace_id`` + a ``spans`` block decomposing the
+        request path: ``router_s`` (time this router spent on scoring,
+        forwarding overhead, and any peer retries) over the engine's
+        queue → prefill → decode spans."""
         if self._draining:
             return 503, {"error": "router draining"}, None
+        if not trace_id:
+            import uuid
+
+            trace_id = "req-" + uuid.uuid4().hex[:12]
+        t_route0 = time.perf_counter()
         tried: set = set()
         saw_429 = False
         retry_after = "1"
@@ -410,7 +429,8 @@ class Router:
                 r.routed_since_poll += 1
             metrics.ROUTER_REQUESTS.inc({"replica": str(idx)})
             try:
-                code, payload = self._forward(r.url, body)
+                code, payload = self._forward(r.url, body,
+                                              trace_id=trace_id)
             except urllib.error.HTTPError as e:
                 try:
                     err_payload = json.loads(e.read())
@@ -435,6 +455,11 @@ class Router:
                 self.note_poll_failure(idx, str(e))
                 self._note_retry(idx)
                 continue
+            engine_latency = 0.0
+            if isinstance(payload, dict):
+                engine_latency = float(payload.get("latency_s") or 0.0)
+            router_s = max(
+                0.0, time.perf_counter() - t_route0 - engine_latency)
             with self._lock:
                 self.routed_total += 1
                 if isinstance(payload, dict):
@@ -443,10 +468,19 @@ class Router:
                     if ttft is not None:
                         self._slo.append(
                             (float(ttft), float(itl or 0.0)))
+                    self._spans.append({
+                        "router_s": router_s,
+                        **{k: float(v) for k, v in
+                           (payload.get("spans") or {}).items()},
+                    })
             if isinstance(payload, dict):
                 payload = dict(payload)
                 payload["replica"] = idx
                 payload["retries"] = len(tried) - 1
+                payload.setdefault("trace_id", trace_id)
+                spans = dict(payload.get("spans") or {})
+                spans["router_s"] = round(router_s, 4)
+                payload["spans"] = spans
             return code, payload, None
         with self._lock:
             self.rejected += 1
@@ -486,6 +520,20 @@ class Router:
             "itl_p95_ms": round(_pct(itl, 0.95), 3),
         }
 
+    def trace_snapshot(self) -> dict:
+        """Aggregate request-path decomposition over the sliding
+        window: where TTFT goes, fleet-wide — router overhead vs
+        engine queue vs prefill (docs/OBSERVABILITY.md)."""
+        with self._lock:
+            samples = list(self._spans)
+        out: dict = {"window": len(samples)}
+        for key in ("router_s", "engine_queue_s", "prefill_s",
+                    "decode_s"):
+            xs = [s[key] for s in samples if key in s]
+            out[f"{key[:-2]}_p50_ms"] = round(1e3 * _pct(xs, 0.5), 3)
+            out[f"{key[:-2]}_p95_ms"] = round(1e3 * _pct(xs, 0.95), 3)
+        return out
+
     def healthz(self) -> dict:
         with self._lock:
             replicas = {
@@ -520,6 +568,7 @@ class Router:
             "replicas": replicas,
             "affinity": affinity,
             "slo": self.slo_snapshot(),
+            "trace": self.trace_snapshot(),
             **counters,
         }
 

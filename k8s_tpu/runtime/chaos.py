@@ -33,6 +33,11 @@ router-replica-loss one serving-fleet engine replica crashed abruptly
                     on a peer, zero accepted requests lost
 router-stats-flake  a replica's /healthz errors while it keeps serving
                     → the router poll loop survives and keeps routing
+slow-host           one gang host's train steps throttled (armed via
+                    the obs tracer hook in-process, or
+                    ``KTPU_CHAOS_SLOW_HOST`` env for subprocess gangs)
+                    → straggler detection names the right pod
+                    (StragglerDetected condition + skew gauges)
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -484,6 +489,35 @@ class RouterStatsFlakeFault(FaultInjector):
         return f"replica-{victim}:{n}"
 
 
+class SlowHostFault(FaultInjector):
+    """Throttle this process's traced train steps — the straggler-
+    detection fault (``slow-host``): the throttled host's step time
+    diverges from its gang peers until the reconciler's skew
+    aggregation raises ``StragglerDetected`` naming it. In-process
+    trainers are armed through :func:`k8s_tpu.obs.trace.arm_slow_host`;
+    subprocess gangs arm ONE host at spawn via
+    ``KTPU_CHAOS_SLOW_HOST="<host>:<seconds>[:<steps>]"`` (consumed by
+    the same tracer hook), which is what the chaos e2e does."""
+
+    name = "slow-host"
+
+    def __init__(self, rate: float = 1.0, seed: Optional[int] = None,
+                 seconds: float = 0.5, steps: int = 5):
+        super().__init__(rate, seed)
+        self.seconds = seconds
+        self.steps = steps
+
+    def fire(self) -> str:
+        from k8s_tpu.obs.trace import arm_slow_host
+
+        n = 1 + self.rng.randrange(self.steps)
+        arm_slow_host(self.seconds, steps=n)
+        self.injected += 1
+        log.info("chaos[%s]: armed %.2fs step throttle for %d steps",
+                 self.name, self.seconds, n)
+        return f"{self.seconds}s x{n}"
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -580,7 +614,8 @@ class ChaosMonkey:
         - 1: aggressive pod kills (every tick)
         - 2: + apiserver flakes, watch drops, slow handlers (needs the
           FaultyCluster wrapper; silently narrower without one)
-        - 3+: + checkpoint-save failures, leader-lease loss, and — when
+        - 3+: + checkpoint-save failures, slow-host step throttles
+          (straggler detection), leader-lease loss, and — when
           ``ckpt_root`` names a multi-tier local checkpoint root —
           partial local commits, local shard corruption, and whole-host
           local-tier loss (the k8s_tpu/ckpt recovery matrix); when
@@ -604,6 +639,7 @@ class ChaosMonkey:
             ]
         if level >= 3:
             inj.append(CheckpointSaveFault(rate=0.5, seed=s(), burst=2))
+            inj.append(SlowHostFault(rate=0.2, seed=s()))
             inj.append(LeaseLossFault(
                 client.cluster, namespace=lease_namespace, rate=0.2, seed=s()))
             if ckpt_root:

@@ -60,24 +60,34 @@ def _free_port() -> int:
 
 class LocalServiceResolver:
     """Maps Service DNS names to loopback endpoints, consistently for
-    all pods of a job."""
+    all pods of a job.
+
+    Ports are keyed by ``(service, original port)``: one Service name
+    resolves to one IP on a cluster, and its DECLARED ports are
+    distinct listeners behind it. Conflating them into a single
+    loopback port (the pre-obs behavior) collided the first time one
+    pod served two ports — worker 0's JAX coordinator (``:2222``) and
+    its observability endpoint (``:8790``) landed on the same local
+    port and the obs listener lost the bind."""
 
     def __init__(self):
-        self._ports: Dict[str, int] = {}
+        self._ports: Dict[Tuple[str, int], int] = {}
         self._lock = threading.Lock()
 
-    def port_for(self, service_name: str) -> int:
+    def port_for(self, service_name: str, port: int = 0) -> int:
+        """Local port for ``service_name:port`` (``port=0`` = the
+        service's portless mentions)."""
+        key = (service_name, int(port))
         with self._lock:
-            if service_name not in self._ports:
-                self._ports[service_name] = _free_port()
-            return self._ports[service_name]
+            if key not in self._ports:
+                self._ports[key] = _free_port()
+            return self._ports[key]
 
     def rewrite_env(self, env: Dict[str, str], service_names: List[str]) -> Dict[str, str]:
         """Replace ``<svc>:<port>`` with ``127.0.0.1:<localport>`` and
         bare service hostnames with ``127.0.0.1`` in env values."""
         out = dict(env)
         for name in sorted(service_names, key=len, reverse=True):
-            local = f"127.0.0.1:{self.port_for(name)}"
             for k, v in out.items():
                 if name in v:
                     nv = []
@@ -89,10 +99,14 @@ class LocalServiceResolver:
                             break
                         nv.append(v[i:j])
                         rest = v[j + len(name) :]
-                        if rest.startswith(":"):
-                            # swallow the original port digits
+                        if rest.startswith(":") and \
+                                rest[1:2].isdigit():
+                            # swallow the original port digits and map
+                            # this (service, port) pair's own listener
                             m = len(rest) - len(rest[1:].lstrip("0123456789")) - 1
-                            nv.append(local)
+                            orig = int(rest[1:1 + m])
+                            nv.append(
+                                f"127.0.0.1:{self.port_for(name, orig)}")
                             i = j + len(name) + 1 + m
                         else:
                             nv.append("127.0.0.1")

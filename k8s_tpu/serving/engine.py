@@ -69,6 +69,10 @@ class Request:
     submitted_at: float = 0.0   # time.perf_counter at submit()
     finished_at: float = 0.0    # ... at attribution of the last token
     first_token_at: float = 0.0  # ... at attribution of the first token
+    # ... when the scheduler first picked this request up (left the
+    # admission queue): splits TTFT into engine-queue vs prefill — the
+    # request-path span decomposition (docs/OBSERVABILITY.md)
+    prefill_start_at: float = 0.0
     prefill_done: int = 0       # real prompt tokens prefilled so far
     # (attribution wall time, tokens attributed) per harvested chunk —
     # the raw material for TTFT / inter-token percentiles; bounded by
@@ -701,6 +705,7 @@ class ContinuousBatchingEngine:
             if self._slot_req[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            req.prefill_start_at = time.perf_counter()
             plen = int(req.prompt.size)
             plen_b = self._bucket_for(plen)
             padded = np.zeros((1, plen_b), np.int32)
@@ -805,6 +810,7 @@ class ContinuousBatchingEngine:
                 if slot is None:
                     break
                 self._prefilling = self._queue.popleft()
+                self._prefilling.prefill_start_at = time.perf_counter()
                 self._prefill_slot = slot
                 self._admit_prefix(self._prefilling)
             req, slot = self._prefilling, self._prefill_slot

@@ -59,12 +59,18 @@ class Overloaded(RuntimeError):
 class _Result:
     """One finished request's payload + timing, resolved to a waiter."""
 
-    __slots__ = ("tokens", "ttft_s", "itl_ms")
+    __slots__ = ("tokens", "ttft_s", "itl_ms", "spans")
 
-    def __init__(self, tokens, ttft_s: float, itl_ms: float):
+    def __init__(self, tokens, ttft_s: float, itl_ms: float,
+                 spans=None):
         self.tokens = tokens
         self.ttft_s = ttft_s
         self.itl_ms = itl_ms
+        # request-path decomposition (docs/OBSERVABILITY.md):
+        # engine_queue_s + prefill_s == ttft_s by construction (all
+        # three derive from the same request timestamps), decode_s is
+        # the stream tail after the first token
+        self.spans = spans or {}
 
 
 class ServingFrontend:
@@ -167,6 +173,14 @@ class ServingFrontend:
                     max_new = int(req.get("max_new_tokens", 16))
                 except Exception as e:  # malformed request → caller's 400
                     return self._json(400, {"error": f"bad request: {e}"})
+                # trace propagation: honor the caller's id (the router
+                # forwards one), mint one otherwise — every response
+                # carries the id its spans are attributable under
+                trace_id = self.headers.get("X-KTPU-Trace-Id", "")
+                if not trace_id:
+                    import uuid
+
+                    trace_id = "req-" + uuid.uuid4().hex[:12]
                 t0 = time.perf_counter()
                 try:
                     result = frontend.submit_and_wait(prompt, max_new)
@@ -189,6 +203,12 @@ class ServingFrontend:
                     # the SLO autoscaler scales on
                     "ttft_s": round(result.ttft_s, 4),
                     "itl_ms": round(result.itl_ms, 3),
+                    "trace_id": trace_id,
+                    # engine-side span decomposition: queue+prefill
+                    # sum to ttft_s (same timestamps), decode is the
+                    # rest of the stream (docs/OBSERVABILITY.md)
+                    "spans": {k: round(v, 4)
+                              for k, v in result.spans.items()},
                 })
 
         class Server(ThreadingHTTPServer):
@@ -278,8 +298,8 @@ class ServingFrontend:
                     # getattr: stub/legacy engines without timing
                     # fields still resolve (timing reads as 0)
                     first = getattr(req, "first_token_at", 0.0)
-                    ttft = max(
-                        0.0, first - getattr(req, "submitted_at", 0.0))
+                    sub = getattr(req, "submitted_at", 0.0)
+                    ttft = max(0.0, first - sub)
                     # mean stream cadence after the first token — the
                     # per-request ITL sample the router aggregates
                     # (percentile-grade ITL needs per-chunk walls,
@@ -288,8 +308,20 @@ class ServingFrontend:
                         1e3 * max(
                             0.0, getattr(req, "finished_at", 0.0) - first)
                         / (n - 1) if n > 1 else 0.0)
+                    # TTFT decomposition off the engine's own stamps:
+                    # queue (submit → scheduler pickup) + prefill
+                    # (pickup → first token) == ttft; engines without
+                    # the pickup stamp report it all as prefill
+                    ps = getattr(req, "prefill_start_at", 0.0)
+                    queue_s = max(0.0, ps - sub) if ps else 0.0
+                    prefill_s = max(0.0, first - ps) if ps else ttft
+                    decode_s = max(
+                        0.0, getattr(req, "finished_at", 0.0) - first)
                     self._results[rid] = _Result(
-                        np.asarray(req.tokens, np.int32), ttft, itl_ms)
+                        np.asarray(req.tokens, np.int32), ttft, itl_ms,
+                        spans={"engine_queue_s": queue_s,
+                               "prefill_s": prefill_s,
+                               "decode_s": decode_s})
                     ev.set()
                 else:
                     # no waiter ⇒ the client timed out and left: drop

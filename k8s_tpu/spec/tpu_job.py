@@ -368,6 +368,76 @@ class ServingSpec(K8sObject):
 
 @register_type
 @dataclass
+class ObservabilitySpec(K8sObject):
+    """Tracing + telemetry block (docs/OBSERVABILITY.md). The operator
+    always stamps jobs with a trace id (``KTPU_TRACE_ID``); this block
+    turns on the rest:
+
+    ``obsPort`` > 0 gives every gang WORKER a per-host observability
+    endpoint on that port (step heartbeats in the ``/healthz`` stats
+    block, ``/metrics``, ``/debug/flightrecorder``), declared on the
+    per-index Service and advertised via ``KTPU_OBS_ADVERTISE`` —
+    the reconciler then aggregates per-host step/phase skew from it
+    and raises ``StragglerDetected`` when one host diverges.
+
+    ``flightRecorderDir`` names a node-local path (emptyDir / local
+    SSD) where each host's flight recorder re-dumps its span ring
+    every ~0.5s and force-dumps on SIGTERM/crash — the post-mortem
+    that survives the pod.
+
+    ``stragglerThreshold``/``stragglerSteps``: a host is flagged when
+    its step time >= threshold x its peers' median for that many
+    consecutive fresh observations (hysteresis both ways — see
+    ``k8s_tpu.obs.straggler``).
+
+    ``trace: false`` disables span recording entirely (``KTPU_TRACE=0``
+    in the pod env); the measured overhead of enabled spans is < 1% of
+    step time (guarded by the llama_bench smoke test)."""
+
+    obs_port: int = 0
+    flight_recorder_dir: str = ""
+    flight_recorder_capacity: int = 256
+    straggler_threshold: float = 1.5
+    straggler_steps: int = 3
+    trace: bool = True
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not 0 <= self.obs_port <= 65535:
+            raise ValidationError(
+                f"observability: obsPort out of range: {self.obs_port}")
+        if self.flight_recorder_capacity < 1:
+            raise ValidationError(
+                "observability: flightRecorderCapacity must be >= 1")
+        if self.straggler_threshold <= 1.0:
+            raise ValidationError(
+                "observability: stragglerThreshold must be > 1.0 (it "
+                "multiplies the peer-median step time)")
+        if self.straggler_steps < 1:
+            raise ValidationError(
+                "observability: stragglerSteps must be >= 1")
+        if not isinstance(self.trace, bool):
+            raise ValidationError("observability: trace must be a boolean")
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher/program contract (``KTPU_TRACE``/
+        ``KTPU_FLIGHT_*`` consumed by ``k8s_tpu.obs.trace.Tracer
+        .from_env``; ``KTPU_OBS_ADVERTISE`` is added per-index by
+        ``trainer/replicas.py`` since it embeds the Service name)."""
+        env: Dict[str, str] = {}
+        if not self.trace:
+            env["KTPU_TRACE"] = "0"
+        # capacity applies to the IN-MEMORY ring too (the live
+        # /debug/flightrecorder route works without a dump dir), so it
+        # must not be gated on flightRecorderDir
+        env["KTPU_FLIGHT_CAPACITY"] = str(self.flight_recorder_capacity)
+        if self.flight_recorder_dir:
+            env["KTPU_FLIGHT_DIR"] = self.flight_recorder_dir
+        return env
+
+
+@register_type
+@dataclass
 class TpuJobSpec(K8sObject):
     runtime_id: str = field(default="", metadata={"json": "RuntimeId"})
     tensorboard: Optional[TensorBoardSpec] = None
@@ -396,6 +466,10 @@ class TpuJobSpec(K8sObject):
     # replicas + a prefix-aware router pod + SLO autoscaling. None →
     # plain job semantics (a serving WORKER is then a gang of 1).
     serving: Optional[ServingSpec] = None
+    # Tracing + telemetry (docs/OBSERVABILITY.md): per-host obs
+    # endpoint, flight recorder, straggler detection. None → trace id
+    # stamping only.
+    observability: Optional[ObservabilitySpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -453,6 +527,22 @@ class TpuJobSpec(K8sObject):
             self.checkpoint_policy.validate()
         if self.training is not None:
             self.training.validate()
+        if self.observability is not None:
+            self.observability.validate()
+            if self.serving is not None:
+                # no serving program runs the per-host obs endpoint or
+                # the flight recorder, and straggler detection is a
+                # GANG concept — accepting the block there would be a
+                # silent no-op (a declared port with no listener), so
+                # reject loudly instead. Serving replicas already
+                # publish their stats on the engine /healthz and the
+                # router aggregates request-path spans (docs/SERVING.md
+                # "Observability"); trace-id stamping is always on.
+                raise ValidationError(
+                    "observability: obsPort/flight-recorder telemetry "
+                    "is a training-gang feature; serving fleets get "
+                    "engine /healthz stats + router request-path "
+                    "spans instead (remove the observability block)")
         if self.serving is not None:
             self.serving.validate()
             w = self.replica_spec(WORKER)

@@ -112,6 +112,11 @@ class RendezvousSpec:
     # _MAX_QUEUE; the router gets KTPU_SERVING_PEERS (per-index Service
     # endpoints over the WHOLE maxReplicas range) + KTPU_ROUTER_*
     serving_env: Optional[Dict[str, str]] = None
+    # observability contract (spec.observability + the always-on job
+    # trace id, docs/OBSERVABILITY.md): KTPU_TRACE_ID, KTPU_TRACE,
+    # KTPU_FLIGHT_*, and KTPU_OBS_ADVERTISE (per-index Service DNS the
+    # host's obs endpoint binds/advertises, same plumbing as serving)
+    obs_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -138,6 +143,8 @@ class RendezvousSpec:
             env.update(self.training_env)
         if self.serving_env:
             env.update(self.serving_env)
+        if self.obs_env:
+            env.update(self.obs_env)
         return env
 
 
@@ -277,6 +284,17 @@ class TpuReplicaSet:
             elif self.spec.replica_type == ROUTER:
                 ports.append(ServicePort(
                     name="ktpu-router", port=serving.router_port))
+        obs = self.job.job.spec.observability
+        if (obs is not None and obs.obs_port
+                and self.spec.replica_type == WORKER
+                and not self.is_serving):
+            # same lesson as the serving ports above: a ClusterIP
+            # forwards only DECLARED ports — the reconciler's straggler
+            # polls and operator-side flight-recorder pulls ride this.
+            # (serving + observability is rejected at validation; the
+            # gate here keeps adoption paths, which skip validation,
+            # from declaring a listener-less port)
+            ports.append(ServicePort(name="ktpu-obs", port=obs.obs_port))
         svc = Service(
             metadata=ObjectMeta(
                 name=self.job_name(index),
@@ -442,6 +460,7 @@ class TpuReplicaSet:
                 job.job.spec.training.to_env()
                 if job.job.spec.training is not None else None
             ),
+            obs_env=self._obs_env(index),
         )
 
     def _serving_rendezvous(self, index: int) -> RendezvousSpec:
@@ -494,7 +513,29 @@ class TpuReplicaSet:
                 if self.spec.replica_type == WORKER else None),
             cluster=self.job.cluster_spec(),
             serving_env=env,
+            obs_env=self._obs_env(index),
         )
+
+    def _obs_env(self, index: int) -> Dict[str, str]:
+        """The observability contract (docs/OBSERVABILITY.md): EVERY
+        replica gets the job trace id (spans/requests from any layer
+        join on it); gang WORKERs with an ``observability`` block
+        additionally get the tracing knobs and their per-index obs
+        advertise address (Service DNS + obsPort — the local kubelet's
+        resolver rewrites it to a loopback port, so the subprocess e2e
+        exercises the same discovery path a cluster does)."""
+        env = {
+            "KTPU_TRACE_ID":
+                f"{self.job.job.metadata.name}-{self.runtime_id}",
+        }
+        obs = self.job.job.spec.observability
+        if (obs is not None and self.spec.replica_type == WORKER
+                and not self.is_serving):
+            env.update(obs.to_env())
+            if obs.obs_port:
+                env["KTPU_OBS_ADVERTISE"] = \
+                    f"{self.job_name(index)}:{obs.obs_port}"
+        return env
 
     def _checkpoint_env(self, workers) -> Optional[Dict[str, str]]:
         """spec.checkpointPolicy → KTPU_CKPT_* (+ per-index peer shard

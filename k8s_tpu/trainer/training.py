@@ -96,6 +96,14 @@ class TrainingJob:
         # router must never wedge a reconcile tick)
         self._serving_autoscaler = None
         self.router_stats_fetcher: Optional[Callable[[], Optional[dict]]] = None
+        # Gang straggler detection (spec.observability,
+        # docs/OBSERVABILITY.md): the detector is pure decision logic
+        # over per-host step heartbeats; the stats source is pluggable
+        # exactly like the autoscaler's (the default fetcher GETs each
+        # worker's per-index Service obs endpoint, best-effort)
+        self._straggler_detector = None
+        self.worker_stats_fetcher: Optional[
+            Callable[[], Optional[Dict[int, dict]]]] = None
         # (clock_time, delay_armed_for_the_NEXT_restart) per restart —
         # what the soak asserts spacing from
         self.restart_history: List[Tuple[float, float]] = []
@@ -454,6 +462,103 @@ class TrainingJob:
             "ServingScaled",
             f"serving replicas {current} -> {desired} ({reason})")
 
+    # ------------------------------------------------------------ stragglers
+
+    def _http_worker_stats(self) -> Optional[Dict[int, dict]]:
+        """Default per-host heartbeat source: GET each gang WORKER's
+        per-index Service obs endpoint concurrently (a serial sweep
+        would lag the tick by workers x timeout on a partially-up
+        gang). Any per-host failure is a miss — a host that answers
+        nothing is the gang-restart path's problem, not this one's."""
+        import json as _json
+        import urllib.request
+
+        obs = self.job.spec.observability
+        wset = self._worker_set()
+        if obs is None or not obs.obs_port or wset is None:
+            return None
+        out: Dict[int, dict] = {}
+
+        def poll(i: int) -> None:
+            url = (f"http://{wset.job_name(i)}:"
+                   f"{obs.obs_port}/healthz")
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    payload = _json.loads(r.read())
+                hb = payload.get("obs")
+                if isinstance(hb, dict):
+                    out[i] = hb
+            except Exception:
+                pass
+
+        threads = [
+            threading.Thread(target=poll, args=(i,), daemon=True)
+            for i in range(wset.spec.replicas or 0)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=3)
+        return out or None
+
+    def _maybe_detect_stragglers(self) -> None:
+        """Straggler tick: aggregate per-host step/phase heartbeats,
+        export the skew gauges, and raise a ``StragglerDetected``
+        condition + Warning Event NAMING the divergent pod when one
+        host's step time stays past the threshold (all hysteresis
+        lives in :class:`k8s_tpu.obs.straggler.StragglerDetector`)."""
+        from k8s_tpu.controller import metrics
+
+        obs = self.job.spec.observability
+        wset = self._worker_set()
+        if wset is None:
+            return
+        if obs is None and self.worker_stats_fetcher is None:
+            return
+        if self._straggler_detector is None:
+            from k8s_tpu.obs.straggler import StragglerDetector
+
+            self._straggler_detector = StragglerDetector(
+                threshold=obs.straggler_threshold if obs else 1.5,
+                consecutive=obs.straggler_steps if obs else 3,
+                clock=self.clock,
+            )
+        fetch = self.worker_stats_fetcher or self._http_worker_stats
+        stats = fetch()
+        if not stats:
+            return
+        verdict = self._straggler_detector.observe(stats)
+        job_lbl = {"job": self.fullname}
+        metrics.OBS_STEP_SKEW.set(verdict.skew_s, job_lbl)
+        for host, hb in stats.items():
+            host_lbl = {"job": self.fullname, "host": str(host)}
+            metrics.OBS_HOST_STEP_TIME.set(
+                float(hb.get("step_time_s", 0.0) or 0.0), host_lbl)
+            for phase, secs in (hb.get("phases_s") or {}).items():
+                metrics.OBS_PHASE_SECONDS.set(
+                    float(secs), {**host_lbl, "phase": str(phase)})
+        if verdict.new_straggler is not None:
+            idx = verdict.new_straggler
+            pod = wset.job_name(idx)
+            reason = (
+                f"host {idx} ({pod}) busy step time "
+                f"{verdict.step_times.get(idx, 0.0):.3f}s vs gang median "
+                f"{verdict.median_s:.3f}s (x{verdict.ratio:.2f} over "
+                f"{verdict.streak} consecutive steps)"
+            )
+            metrics.OBS_STRAGGLERS.inc(job_lbl)
+            self.status.append_condition("StragglerDetected", reason=reason)
+            log.warning("job %s: straggler detected: %s",
+                        self.fullname, reason)
+            self._record_event("StragglerDetected", reason, etype="Warning")
+        if verdict.cleared is not None:
+            pod = wset.job_name(verdict.cleared)
+            reason = (f"host {verdict.cleared} ({pod}) back within "
+                      f"x{self._straggler_detector.threshold:.2f} of the "
+                      f"gang median")
+            self.status.append_condition("StragglerCleared", reason=reason)
+            self._record_event("StragglerCleared", reason)
+
     def _record_event(self, reason: str, message: str,
                       etype: str = "Normal") -> None:
         """Best-effort event write: a transient apiserver error must
@@ -556,6 +661,19 @@ class TrainingJob:
                     # autoscaling is best-effort — it must never take
                     # down the reconcile tick that keeps the fleet up
                     log.error("job %s: serving autoscale: %s",
+                              self.fullname, e)
+            if (
+                state == TpuJobState.RUNNING
+                and self.job.spec.serving is None
+                and (self.job.spec.observability is not None
+                     or self.worker_stats_fetcher is not None)
+            ):
+                try:
+                    self._maybe_detect_stragglers()
+                except Exception as e:
+                    # observability is best-effort — it must never take
+                    # down the reconcile tick
+                    log.error("job %s: straggler detection: %s",
                               self.fullname, e)
             self.status.replica_statuses = replica_statuses
             if state == TpuJobState.FAILED:

@@ -163,6 +163,15 @@ class TestBenches:
         assert hbm["source"] == "abstract_shard_sizes"
         # replicated adamw: mu+nu ≈ 2x param bytes (opt scalars are noise)
         assert hbm["opt_state"] >= 2 * hbm["params"] * 0.95
+        # tracing-overhead guard (ISSUE 9, docs/OBSERVABILITY.md): the
+        # step-phase spans must cost < 1% of step time. The ACCOUNTED
+        # fraction (the tracer's own bookkeeping clock, deterministic)
+        # carries the 1% bar; the wall A/B (min-of-N, still subject to
+        # CI-box interference) gets a loose gross-regression bound.
+        tr = out["trace"]
+        assert tr["overhead_frac_accounted"] < 0.01, tr
+        assert tr["traced_step_time_ms"] > 0 and tr["step_time_ms"] > 0
+        assert tr["overhead_frac_wall"] < 0.25, tr
 
     def test_llama_bench_smoke_zero1_shape(self, capsys):
         """--zero1 --smoke keeps the full JSON line shape (the bench.py

@@ -525,9 +525,12 @@ class TestChaosMonkeyProfiles:
         m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty)
         assert self._names(m) == [
             "api-flake", "checkpoint-save", "lease-loss", "pod-kill",
-            "slow-handler", "watch-drop",
+            "slow-handler", "slow-host", "watch-drop",
         ]
         ckpt_mod.arm_save_faults(0)  # in case a tick armed it
+        from k8s_tpu.obs import trace as obs_trace
+
+        obs_trace.arm_slow_host(0.0, steps=0)
 
     def test_level_3_with_ckpt_root_adds_local_tier_faults(self, tmp_path):
         """A configured multi-tier local root arms the three local-tier
@@ -539,12 +542,14 @@ class TestChaosMonkeyProfiles:
         assert self._names(m) == [
             "api-flake", "checkpoint-save", "ckpt-corruption",
             "ckpt-partial-commit", "ckpt-peer-loss", "lease-loss",
-            "pod-kill", "slow-handler", "watch-drop",
+            "pod-kill", "slow-handler", "slow-host", "watch-drop",
         ]
         from k8s_tpu.ckpt import local as ckpt_local
+        from k8s_tpu.obs import trace as obs_trace
 
         ckpt_local.arm_partial_commit(0)
         ckpt_mod.arm_save_faults(0)
+        obs_trace.arm_slow_host(0.0, steps=0)
 
     def test_tick_is_exception_safe_and_counts(self):
         class Broken(FaultInjector):

@@ -613,8 +613,9 @@ def test_multi_tier_checkpoint_gang_restart_e2e(tmp_path):
         assert job.status.gang_restarts == 1
 
         log0 = worker_log(job.spec.runtime_id, 0)
-        restores = [_json.loads(l) for l in log0.splitlines()
-                    if '"event": "ckpt_restore"' in l]
+        from k8s_tpu.obs.events import events_of
+
+        restores = events_of(log0, "ckpt_restore")
         assert restores, "no ckpt_restore event:\n" + log0
         last = restores[-1]
         # the restore came from the LOCAL tier at a step the persistent
@@ -623,8 +624,7 @@ def test_multi_tier_checkpoint_gang_restart_e2e(tmp_path):
         assert last["source"] in ("local", "local+peer"), last
         assert last["step"] >= 2, last
         assert '"step": 12' in log0
-        goodput = [_json.loads(l) for l in log0.splitlines()
-                   if '"event": "ckpt_goodput"' in l]
+        goodput = events_of(log0, "ckpt_goodput")
         assert goodput, "no goodput report:\n" + log0
         g = goodput[-1]
         assert g["restore_sources"].get("local", 0) + \

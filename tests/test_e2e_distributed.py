@@ -16,6 +16,8 @@ import time
 
 import pytest
 
+from k8s_tpu.obs.events import events_of, last_event
+
 from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.cluster import InMemoryCluster
 from k8s_tpu.api.crd_client import TpuJobClient
@@ -65,8 +67,9 @@ def _run_two_worker_job(tmp_path, name, extra_env=None, timeout=240):
 def test_distributed_smoke_job(tmp_path):
     job, log0, latency = _run_two_worker_job(tmp_path, "smoke", timeout=180)
     # both workers ran and the smoke check passed on worker 0
-    assert '"event": "smoke_ok"' in log0, log0
-    assert '"devices": 4' in log0  # 2 procs × 2 devices aggregated
+    smoke = last_event(log0, "smoke_ok")
+    assert smoke is not None, log0
+    assert smoke["devices"] == 4  # 2 procs × 2 devices aggregated
     print(f"create→done latency: {latency:.1f}s")
 
 
@@ -270,8 +273,9 @@ def test_multislice_cross_process_chaos(tmp_path):
             raise AssertionError("never reached step 5\n" + _logs(tmp_path))
 
         # the launcher consumed MEGASCALE: data axis spans the 2 slices
-        assert '"num_slices": 2' in log0, log0
-        assert '"data": 2' in log0.replace("'", '"'), log0
+        mesh_ev = last_event(log0, "mesh")
+        assert mesh_ev is not None and mesh_ev["num_slices"] == 2, log0
+        assert mesh_ev["shape"]["data"] == 2, mesh_ev
 
         # SIGKILL one live worker that is VERIFIABLY in slice 0 (pod
         # start order is thread-scheduling-dependent, so identify the
@@ -304,10 +308,7 @@ def test_multislice_cross_process_chaos(tmp_path):
             _xfail_if_glibc_heap_bug(_logs(tmp_path))
         assert job.status.gang_restarts == 1, job.to_dict()
         log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "mslice")
-        restored = [
-            json.loads(l)["step"] for l in log0.splitlines()
-            if '"event": "restored"' in l
-        ]
+        restored = [e["step"] for e in events_of(log0, "restored")]
         assert restored and restored[-1] >= 2, log0
         assert '"step": 10' in log0, log0
     finally:
@@ -396,18 +397,13 @@ def test_preemption_sigterm_checkpoint_flush(tmp_path):
         assert job.status.gang_restarts == 1, job.to_dict()
         log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "preempt")
         # the flush happened...
-        flushed = [
-            json.loads(l)["step"] for l in log0.splitlines()
-            if '"event": "preempt_checkpoint"' in l
-        ]
+        flushed = [e["step"]
+                   for e in events_of(log0, "preempt_checkpoint")]
         assert flushed, "no preemption checkpoint flush in:\n" + log0
         # ...at a step past the last periodic save (5), and the restart
         # resumed exactly from it
         assert flushed[-1] >= 6, log0
-        restored = [
-            json.loads(l)["step"] for l in log0.splitlines()
-            if '"event": "restored"' in l
-        ]
+        restored = [e["step"] for e in events_of(log0, "restored")]
         assert restored and restored[-1] == flushed[-1], log0
         assert '"step": 12' in log0, log0
     finally:
@@ -495,10 +491,7 @@ def test_gang_restart_mid_training_kill(tmp_path):
         assert any(c.type == "GangRestart" for c in job.status.conditions)
         # the fresh gang restored from a checkpoint and resumed PAST it
         log0 = _read_worker_log(tmp_path, job.spec.runtime_id, 0, "chaos")
-        restored = [
-            json.loads(l)["step"] for l in log0.splitlines()
-            if '"event": "restored"' in l
-        ]
+        restored = [e["step"] for e in events_of(log0, "restored")]
         assert restored and restored[-1] >= 2, log0
         assert '"step": 12' in log0, log0
         ev_reasons = {e.reason for e in client.events.list("default")}
@@ -529,7 +522,6 @@ def test_distributed_convergence_gate(tmp_path):
         },
         timeout=420,
     )
-    conv = [json.loads(l) for l in log0.splitlines()
-            if '"event": "convergence"' in l]
+    conv = events_of(log0, "convergence")
     assert conv, log0
     assert conv[-1]["ratio"] < 0.7, conv

@@ -1,0 +1,241 @@
+"""Observability end to end (ISSUE 9, docs/OBSERVABILITY.md) over REAL
+subprocess gangs:
+
+- chaos ``slow-host``: one worker of a 2-process FSDP gang is throttled
+  (``KTPU_CHAOS_SLOW_HOST`` — the subprocess arm of the fault); the
+  reconciler polls each host's obs endpoint through the SAME
+  Service-DNS plumbing a cluster uses (the local kubelet resolver
+  rewrites ``KTPU_OBS_ADVERTISE`` to loopback ports) and must raise a
+  ``StragglerDetected`` condition + Event NAMING the throttled pod,
+  with the skew gauges populated — while the job still trains to
+  Succeeded.
+- SIGKILL post-mortem: a worker killed with SIGKILL (uncatchable — no
+  handler, no flush hook) must still leave a flight-recorder dump on
+  node-local disk containing the final steps' phase spans.
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.obs.events import events_of, last_event
+from k8s_tpu.runtime.kubelet import (
+    LocalKubelet,
+    LocalServiceResolver,
+    SubprocessExecutor,
+)
+from k8s_tpu import spec as S
+from k8s_tpu.trainer.training import TrainingJob
+
+
+def _worker_log(tmp_path, name, rid, idx):
+    import glob
+
+    pats = glob.glob(
+        str(tmp_path / "logs" / f"{name}-worker-{rid}-{idx}-pod-*.log"))
+    return "\n".join(open(p).read() for p in sorted(pats))
+
+
+def _all_logs(tmp_path):
+    import glob
+
+    return "\n".join(
+        f"--- {p} ---\n" + open(p).read()
+        for p in glob.glob(str(tmp_path / "logs" / "*.log")))
+
+
+@pytest.mark.integration
+def test_slow_host_straggler_detection_e2e(tmp_path):
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    resolver = LocalServiceResolver()
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=30 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp --seq_len=32 --step_sleep=0.15"
+            ),
+            # the slow-host chaos fault, subprocess arm: ONLY host 1
+            # throttles (0.8s per step, every step)
+            "KTPU_CHAOS_SLOW_HOST": "1:0.8",
+        },
+    )
+    kubelet = LocalKubelet(client, executor, resolver=resolver)
+    kubelet.start()
+
+    j = S.TpuJob()
+    j.metadata.name = "slowjob"
+    j.metadata.namespace = "default"
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+    j.spec.observability = S.ObservabilitySpec(
+        obs_port=8790, straggler_threshold=2.0, straggler_steps=2)
+    jc.create(j)
+    tj = TrainingJob(client, jc, j)
+
+    def fetch():
+        # the test-side stand-in for cluster DNS only: it asks the
+        # kubelet's resolver for the SAME loopback ports it rewrote
+        # KTPU_OBS_ADVERTISE to — the heartbeat payloads come over
+        # real HTTP from the real worker subprocesses
+        rid = tj.job.spec.runtime_id
+        if not rid:
+            return None
+        out = {}
+        for i in range(2):
+            port = resolver.port_for(f"slowjob-worker-{rid}-{i}", 8790)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    payload = json.loads(r.read())
+                hb = payload.get("obs")
+                if isinstance(hb, dict):
+                    out[i] = hb
+            except Exception:
+                pass
+        return out or None
+
+    tj.worker_stats_fetcher = fetch
+    tj.start(S.ControllerConfig(), reconcile_interval=0.3)
+    try:
+        # the condition must appear while training runs, naming host 1
+        deadline = time.monotonic() + 240
+        cond = None
+        while time.monotonic() < deadline:
+            cond = next((c for c in tj.status.conditions
+                         if c.type == "StragglerDetected"), None)
+            if cond is not None:
+                break
+            assert not tj.finished, (
+                "job finished before any straggler verdict\n"
+                + _all_logs(tmp_path))
+            time.sleep(0.2)
+        rid = tj.job.spec.runtime_id
+        assert cond is not None, _all_logs(tmp_path)
+        assert f"slowjob-worker-{rid}-1" in cond.reason, cond.reason
+        # the K8s Event names the same pod
+        evs = [e for e in client.events.list("default")
+               if e.reason == "StragglerDetected"]
+        assert evs and f"slowjob-worker-{rid}-1" in evs[0].message
+        # skew gauges populated from the REAL heartbeats
+        from k8s_tpu.controller import metrics as M
+
+        job_lbl = {"job": tj.fullname}
+        assert M.OBS_STEP_SKEW.get(job_lbl) > 0.4, (
+            M.OBS_STEP_SKEW.get(job_lbl))
+        assert M.OBS_HOST_STEP_TIME.get({**job_lbl, "host": "1"}) > 0
+        assert M.OBS_PHASE_SECONDS.get(
+            {**job_lbl, "host": "1", "phase": "chaos_slow_host"}
+        ) == pytest.approx(0.8, abs=0.2)
+
+        # observability must never cost the job: it still succeeds
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not tj.finished:
+            time.sleep(0.3)
+        assert tj.finished and \
+            tj.status.state == S.TpuJobState.SUCCEEDED, (
+                json.dumps(tj.status.to_dict(), indent=1),
+                _all_logs(tmp_path))
+        # worker 0 printed the per-step phase breakdown events
+        log0 = _worker_log(tmp_path, "slowjob", rid, 0)
+        phases = events_of(log0, "step_phases")
+        assert phases and "step_compute" in phases[-1]["phases_ms"]
+    finally:
+        tj.stop()
+        tj.join(timeout=10)
+        kubelet.stop()
+
+
+@pytest.mark.integration
+def test_sigkill_leaves_flight_recorder_dump(tmp_path):
+    fr_dir = tmp_path / "flightrec"
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=60 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp --seq_len=32 --step_sleep=0.25"
+            ),
+        },
+    )
+    kubelet = LocalKubelet(client, executor)
+    kubelet.start()
+
+    j = S.TpuJob()
+    j.metadata.name = "frjob"
+    j.metadata.namespace = "default"
+    # no restarts: this test is about the post-mortem, not recovery
+    j.spec.max_gang_restarts = 0
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+    j.spec.observability = S.ObservabilitySpec(
+        obs_port=8790, flight_recorder_dir=str(fr_dir))
+    jc.create(j)
+    tj = TrainingJob(client, jc, j)
+    tj.start(S.ControllerConfig(), reconcile_interval=0.3)
+    try:
+        # wait until both hosts are past step 5 (dump files exist and
+        # carry real step spans by then: flush interval is 0.5s,
+        # steps take ~0.3s+)
+        deadline = time.monotonic() + 240
+        rid = None
+        seen_step = 0
+        while time.monotonic() < deadline:
+            rid = tj.job.spec.runtime_id or rid
+            if rid:
+                log0 = _worker_log(tmp_path, "frjob", rid, 0)
+                ev = last_event(log0, "step_phases")
+                if ev is not None:
+                    seen_step = ev["step"]
+                    if seen_step >= 6:
+                        break
+            time.sleep(0.2)
+        assert seen_step >= 6, _all_logs(tmp_path)
+
+        # SIGKILL every live worker — uncatchable; only the interval
+        # dump can have saved the evidence
+        victims = [p for p in executor._procs if p.poll() is None]
+        assert len(victims) == 2
+        for v in victims:
+            os.kill(v.pid, signal.SIGKILL)
+        for v in victims:
+            v.wait()
+
+        for host in (0, 1):
+            path = fr_dir / f"flight-host{host}.json"
+            assert path.exists(), list(fr_dir.glob("*"))
+            dump = json.load(open(path))
+            steps = [e for e in dump["entries"] if e.get("kind") == "step"]
+            assert steps, dump
+            # the dump holds the FINAL steps' spans: at most one flush
+            # interval (~2 steps here) behind where the kill landed
+            assert steps[-1]["step"] >= seen_step - 3, (
+                seen_step, steps[-1])
+            assert steps[-1]["trace_id"] == f"frjob-{rid}"
+            assert "step_compute" in steps[-1]["phases_s"]
+            assert steps[-1]["wall_s"] >= 0.2  # step_sleep is inside
+    finally:
+        tj.stop()
+        tj.join(timeout=10)
+        kubelet.stop()

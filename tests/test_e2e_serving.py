@@ -11,13 +11,14 @@ extended to the serving surface the reference never had.
 """
 
 import json
-import re
 import time
 import urllib.error
 import urllib.request
 
 import numpy as np
 import pytest
+
+from k8s_tpu.obs.events import events_of, last_event, parse_events
 
 from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.cluster import InMemoryCluster
@@ -80,10 +81,10 @@ def test_operator_launched_serving_job(tmp_path):
         deadline = time.monotonic() + 240
         port = None
         while time.monotonic() < deadline:
-            log = _worker_log(tmp_path, "serve")
-            m = re.search(r'\{"event": "serving_ready".*\}', log)
-            if m:
-                port = json.loads(m.group(0))["port"]
+            ev = last_event(_worker_log(tmp_path, "serve"),
+                            "serving_ready")
+            if ev is not None:
+                port = ev["port"]
                 break
             time.sleep(0.2)
         assert port, "server never became ready:\n" + _worker_log(
@@ -114,8 +115,7 @@ def test_operator_launched_serving_job(tmp_path):
             time.sleep(0.2)
         log = _worker_log(tmp_path, "serve")
         assert '"event": "serving_drained"' in log, log
-        drained = [json.loads(l) for l in log.splitlines()
-                   if '"event": "serving_drained"' in l]
+        drained = events_of(log, "serving_drained")
         assert drained[-1]["served"] == 2, drained
         # the server refused nothing and crashed nowhere
         assert "Traceback" not in log, log
@@ -205,10 +205,9 @@ def test_serving_restores_trained_checkpoint(tmp_path):
         deadline = time.monotonic() + 240
         port = None
         while time.monotonic() < deadline:
-            log = _worker_log(tmp_path, "serve-ckpt")
-            m = re.search(r'\{"event": "serving_ready".*\}', log)
-            if m:
-                ready = json.loads(m.group(0))
+            ready = last_event(_worker_log(tmp_path, "serve-ckpt"),
+                               "serving_ready")
+            if ready is not None:
                 assert ready["restored"] is True, ready
                 port = ready["port"]
                 break
@@ -278,10 +277,10 @@ def test_rest_backed_serving_job(tmp_path):
         deadline = time.monotonic() + 240
         port = None
         while time.monotonic() < deadline:
-            log = _worker_log(tmp_path, "serve-rest")
-            m = re.search(r'\{"event": "serving_ready".*\}', log)
-            if m:
-                port = json.loads(m.group(0))["port"]
+            ev = last_event(_worker_log(tmp_path, "serve-rest"),
+                            "serving_ready")
+            if ev is not None:
+                port = ev["port"]
                 break
             time.sleep(0.2)
         assert port, "server never became ready:\n" + _worker_log(
@@ -301,8 +300,7 @@ def test_rest_backed_serving_job(tmp_path):
             time.sleep(0.2)
         log = _worker_log(tmp_path, "serve-rest")
         assert '"event": "serving_drained"' in log, log
-        drained = [json.loads(l) for l in log.splitlines()
-                   if '"event": "serving_drained"' in l]
+        drained = events_of(log, "serving_drained")
         assert drained[-1]["served"] >= 1, drained
         # GC over REST: the job's compute is gone from the server store
         deadline = time.monotonic() + 30
@@ -391,12 +389,11 @@ def test_fleet_serving_job_rest_backed(tmp_path):
         while time.monotonic() < deadline:
             engines, router = {}, None
             for path, log in _log("serve-fleet").items():
-                for line in log.splitlines():
-                    if '"event": "serving_ready"' in line:
-                        ev = json.loads(line)
+                for ev in parse_events(log):
+                    if ev["event"] == "serving_ready":
                         engines[ev["replica"]] = ev
-                    elif '"event": "router_ready"' in line:
-                        router = json.loads(line)
+                    elif ev["event"] == "router_ready":
+                        router = ev
             if len(engines) == 2 and router is not None:
                 break
             time.sleep(0.3)
@@ -430,6 +427,15 @@ def test_fleet_serving_job_rest_backed(tmp_path):
                         "max_new_tokens": 4})
             results.append((code, body))
         assert [c for c, _ in results] == [200] * 4, results
+        # request-path tracing over the REAL engine (ISSUE 9): every
+        # routed response carries a trace id and a span decomposition
+        # whose engine-side queue+prefill sum to the measured TTFT
+        for _, b in results:
+            assert b["trace_id"], b
+            spans = b["spans"]
+            assert spans["engine_queue_s"] + spans["prefill_s"] == \
+                pytest.approx(b["ttft_s"], abs=3e-4), b
+            assert "router_s" in spans, b
         served_by = {b["replica"] for _, b in results}
         assert len(served_by) == 1, results  # affinity stickiness
         with urllib.request.urlopen(
@@ -477,11 +483,11 @@ def test_fleet_serving_job_rest_backed(tmp_path):
         deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
             logs = "\n".join(_log("serve-fleet").values())
-            if '"event": "router_drained"' in logs:
+            if last_event(logs, "router_drained") is not None:
                 break
             time.sleep(0.3)
         logs = "\n".join(_log("serve-fleet").values())
-        assert '"event": "router_drained"' in logs
+        assert last_event(logs, "router_drained") is not None, logs
     finally:
         if controller is not None:
             controller.stop()

@@ -1,0 +1,880 @@
+"""Observability layer (ISSUE 9, docs/OBSERVABILITY.md): tracer +
+step-phase spans, flight recorder, structured-event parser, straggler
+decision logic, Prometheus escaping, request-path spans through the
+real fleet HTTP stack, spec/operator plumbing, and the metrics-docs
+lint. Runs in the always-on CI ``obs`` stage."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.obs import events as obs_events
+from k8s_tpu.obs.straggler import StragglerDetector
+from k8s_tpu.obs.trace import (
+    FlightRecorder,
+    Tracer,
+    arm_slow_host,
+)
+
+
+# ---------------------------------------------------------------------------
+# tracer + spans
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_step_phases_recorded(self):
+        tr = Tracer(trace_id="t-1", task="worker-0")
+        with tr.step(7) as st:
+            with st.phase("data_wait"):
+                time.sleep(0.01)
+            with st.phase("step_compute"):
+                time.sleep(0.02)
+        entries = tr.recorder.snapshot()
+        assert len(entries) == 1
+        e = entries[0]
+        assert e["kind"] == "step" and e["step"] == 7
+        assert e["trace_id"] == "t-1" and e["task"] == "worker-0"
+        ph = e["phases_s"]
+        assert ph["data_wait"] >= 0.009
+        assert ph["step_compute"] >= 0.019
+        # phases are inside the step wall, which covers them
+        assert e["wall_s"] >= ph["data_wait"] + ph["step_compute"] - 1e-4
+
+    def test_repeated_phase_accumulates(self):
+        tr = Tracer()
+        with tr.step(1) as st:
+            for _ in range(3):
+                with st.phase("ckpt_save"):
+                    time.sleep(0.004)
+        ph = tr.recorder.snapshot()[0]["phases_s"]
+        assert ph["ckpt_save"] >= 0.010
+
+    def test_heartbeat_reflects_last_step(self):
+        tr = Tracer(trace_id="t-2", host=3)
+        with tr.step(12) as st:
+            with st.phase("step_compute"):
+                time.sleep(0.005)
+        hb = tr.heartbeat()
+        assert hb["step"] == 12 and hb["host"] == 3
+        assert hb["step_time_s"] >= 0.004
+        assert "step_compute" in hb["phases_s"]
+        assert 0 <= hb["age_s"] < 5
+
+    def test_disabled_tracer_noops(self):
+        tr = Tracer(enabled=False)
+        with tr.step(1) as st:
+            with st.phase("anything"):
+                pass
+        assert tr.recorder.snapshot() == []
+        # a never-stepped heartbeat is recognizably stale
+        assert tr.heartbeat()["age_s"] == -1.0
+
+    def test_from_env_contract(self, tmp_path):
+        env = {
+            "KTPU_TRACE_ID": "job-abcd",
+            "KTPU_FLIGHT_DIR": str(tmp_path),
+            "KTPU_FLIGHT_CAPACITY": "32",
+        }
+        tr = Tracer.from_env(env=env, task="worker-1", host=1)
+        assert tr.trace_id == "job-abcd" and tr.enabled
+        assert tr.recorder.capacity == 32
+        assert tr.recorder.dump_path == str(tmp_path / "flight-host1.json")
+        off = Tracer.from_env(env={"KTPU_TRACE": "0"})
+        assert not off.enabled
+
+    def test_env_slow_host_only_matching_host(self):
+        env = {"KTPU_CHAOS_SLOW_HOST": "1:0.05:2"}
+        slow = Tracer.from_env(env=env, host=1)
+        fast = Tracer.from_env(env=env, host=0)
+        t0 = time.perf_counter()
+        with slow.step(1):
+            pass
+        assert time.perf_counter() - t0 >= 0.045
+        assert slow.recorder.snapshot()[-1]["phases_s"][
+            "chaos_slow_host"] == pytest.approx(0.05)
+        t0 = time.perf_counter()
+        with fast.step(1):
+            pass
+        assert time.perf_counter() - t0 < 0.04
+        # the step budget runs out: step 2 throttled, step 3 is not
+        with slow.step(2):
+            pass
+        t0 = time.perf_counter()
+        with slow.step(3):
+            pass
+        assert time.perf_counter() - t0 < 0.04
+
+    def test_arm_slow_host_process_hook(self):
+        tr = Tracer()
+        arm_slow_host(0.03, steps=1)
+        t0 = time.perf_counter()
+        with tr.step(1):
+            pass
+        assert time.perf_counter() - t0 >= 0.025
+        t0 = time.perf_counter()
+        with tr.step(2):
+            pass
+        assert time.perf_counter() - t0 < 0.02
+
+    def test_overhead_accounted(self):
+        tr = Tracer()
+        for i in range(50):
+            with tr.step(i) as st:
+                with st.phase("a"):
+                    pass
+        # bookkeeping for 50 steps is microseconds, and it is COUNTED
+        assert 0 < tr.overhead_s < 0.25
+
+
+class TestFlightRecorder:
+    def test_ring_bounded(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record({"i": i})
+        snap = rec.snapshot()
+        assert [e["i"] for e in snap] == [6, 7, 8, 9]
+
+    def test_dump_atomic_and_valid(self, tmp_path):
+        path = str(tmp_path / "d" / "flight.json")
+        rec = FlightRecorder(capacity=8, dump_path=path)
+        rec.record({"kind": "step", "step": 1})
+        out = rec.dump("test")
+        assert out == path and os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+        payload = json.load(open(path))
+        assert payload["reason"] == "test"
+        assert payload["entries"][0]["step"] == 1
+
+    def test_interval_flush(self, tmp_path):
+        path = str(tmp_path / "flight.json")
+        rec = FlightRecorder(capacity=8, dump_path=path,
+                             flush_interval_s=0.05)
+        rec.record({"step": 1})
+        rec.maybe_flush()  # first flush: interval elapsed since epoch 0
+        assert os.path.exists(path)
+        rec.record({"step": 2})
+        rec.maybe_flush()  # within the interval: no rewrite
+        assert len(json.load(open(path))["entries"]) == 1
+        time.sleep(0.06)
+        rec.maybe_flush()
+        assert len(json.load(open(path))["entries"]) == 2
+
+    def test_memory_only_dump_is_none(self):
+        rec = FlightRecorder()
+        assert rec.dump("x") is None
+
+    def test_dump_failure_degrades_never_raises(self, tmp_path):
+        """Telemetry must never take down the training step that
+        flushed it: a dead/ full dump target returns None (logged
+        once) and the interval clock still advances so a dead disk
+        isn't retried every step."""
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file where the dump dir should be")
+        rec = FlightRecorder(
+            capacity=4, dump_path=str(blocker / "flight.json"),
+            flush_interval_s=5.0)
+        rec.record({"step": 1})
+        assert rec.dump("x") is None
+        assert rec.dump_failures == 1
+        rec.maybe_flush()  # interval advanced by the failure: no-op
+        assert rec.dump_failures == 1
+        # a tracer stepping over the broken recorder keeps training
+        tr = Tracer(recorder=rec)
+        with tr.step(2):
+            pass
+        assert tr.heartbeat()["step"] == 2
+
+    def test_reentrant_dump_same_thread(self, tmp_path):
+        """The SIGTERM-handler shape: a dump interleaving another dump
+        on the SAME thread (signal between bytecodes) must not
+        deadlock and must leave a valid final file."""
+        path = str(tmp_path / "flight.json")
+        rec = FlightRecorder(capacity=4, dump_path=path)
+        rec.record({"step": 1})
+        with rec._lock:           # interrupted frame holds the ring lock
+            with rec._dump_lock:  # ...and is mid-dump
+                assert rec.dump("signal") == path
+        assert json.load(open(path))["reason"] == "signal"
+
+    def test_step_flush_reaches_disk_for_sigkill_case(self, tmp_path):
+        """The SIGKILL guarantee: per-step maybe_flush keeps the
+        on-disk dump at most one interval behind the ring."""
+        path = str(tmp_path / "flight.json")
+        tr = Tracer(trace_id="t", recorder=FlightRecorder(
+            capacity=16, dump_path=path, flush_interval_s=0.0))
+        for i in range(1, 4):
+            with tr.step(i):
+                pass
+        steps = [e["step"] for e in json.load(open(path))["entries"]]
+        assert steps == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# structured events
+# ---------------------------------------------------------------------------
+
+
+class TestEvents:
+    LOG = "\n".join([
+        "some free-form print",
+        '{"event": "serving_ready", "port": 123}',
+        '{"not_an_event": 1}',
+        "Traceback (most recent call last):",
+        '{"event": "step_phases", "step": 4}',
+        '{"event": "serving_ready", "port": 456}',
+        '{"event": "serving_dra',  # SIGKILL-truncated tail
+    ])
+
+    def test_parse_tolerant(self):
+        evs = obs_events.parse_events(self.LOG)
+        assert [e["event"] for e in evs] == [
+            "serving_ready", "step_phases", "serving_ready"]
+
+    def test_events_of_and_last(self):
+        ready = obs_events.events_of(self.LOG, "serving_ready")
+        assert [e["port"] for e in ready] == [123, 456]
+        assert obs_events.last_event(self.LOG, "serving_ready")["port"] == 456
+        assert obs_events.last_event(self.LOG, "missing") is None
+
+    def test_strict_raises_on_corrupt_event_line(self):
+        with pytest.raises(obs_events.EventParseError):
+            obs_events.parse_events('{"event": "x", truncated', strict=True)
+        with pytest.raises(obs_events.EventParseError):
+            # an "event" key that is not a non-empty string
+            obs_events.parse_events('{"event": 3}', strict=True)
+        # tolerant mode skips both
+        assert obs_events.parse_events('{"event": "x", truncated') == []
+
+
+# ---------------------------------------------------------------------------
+# straggler decision logic
+# ---------------------------------------------------------------------------
+
+
+def _table(step, times):
+    return {h: {"step": step, "step_time_s": t, "age_s": 0.1,
+                "phases_s": {"step_compute": t}}
+            for h, t in times.items()}
+
+
+class TestStragglerDetector:
+    def test_uniform_gang_no_verdict(self):
+        det = StragglerDetector(threshold=1.5, consecutive=2)
+        for step in range(1, 5):
+            v = det.observe(_table(step, {0: 0.2, 1: 0.21, 2: 0.19}))
+            assert v.new_straggler is None and v.active is None
+        assert v.skew_s < 0.03
+
+    def test_straggler_after_consecutive_fresh_observations(self):
+        det = StragglerDetector(threshold=1.5, consecutive=3)
+        verdicts = []
+        for step in range(1, 5):
+            v = det.observe(_table(step, {0: 0.2, 1: 0.9, 2: 0.2}))
+            verdicts.append(v.new_straggler)
+        # fires exactly once, on the 3rd FRESH observation
+        assert verdicts == [None, None, 1, None]
+        assert v.active == 1  # stays active, no re-raise (no flap)
+        assert v.slowest == 1 and v.ratio == pytest.approx(4.5)
+        assert v.skew_s == pytest.approx(0.7)
+
+    def test_unchanged_heartbeat_does_not_advance_streak(self):
+        """Reconcile ticks are much faster than steps: re-polling the
+        same heartbeat must not count as new evidence."""
+        det = StragglerDetector(threshold=1.5, consecutive=3)
+        same = _table(5, {0: 0.2, 1: 0.9})
+        for _ in range(10):
+            v = det.observe(same)
+        assert v.new_straggler is None and v.active is None
+        assert v.streak == 1  # only the first poll counted
+
+    def test_synchronized_gang_judged_on_busy_time(self):
+        """The SPMD reality: collectives equalize every host's step
+        WALL time (fast hosts wait in host_sync), so the straggler
+        must be found via busy time — the host that is NOT waiting."""
+        det = StragglerDetector(threshold=2.0, consecutive=2)
+        verdicts = []
+        for step in range(1, 4):
+            stats = {
+                # fast host: 1.0s wall, 0.75s of it waiting on the gang
+                0: {"step": step, "step_time_s": 1.0, "busy_s": 0.25,
+                    "age_s": 0.1},
+                # slow host: same 1.0s wall, all of it its own work
+                1: {"step": step, "step_time_s": 1.0, "busy_s": 1.0,
+                    "age_s": 0.1},
+            }
+            verdicts.append(det.observe(stats).new_straggler)
+        assert verdicts == [None, 1, None]
+
+    def test_zero_busy_is_a_value_not_a_fallback(self):
+        """A host whose whole step was gang-coupled reports busy_s ==
+        0.0; substituting its gang-equalized WALL time (the falsy-zero
+        trap) would flag the LEAST busy host as the straggler."""
+        det = StragglerDetector(threshold=1.5, consecutive=1)
+        stats = {
+            0: {"step": 1, "step_time_s": 1.0, "busy_s": 0.0,
+                "age_s": 0.1},
+            1: {"step": 1, "step_time_s": 1.0, "busy_s": 0.01,
+                "age_s": 0.1},
+            2: {"step": 1, "step_time_s": 1.0, "busy_s": 0.012,
+                "age_s": 0.1},
+        }
+        v = det.observe(stats)
+        assert v.step_times[0] == 0.0      # busy used, wall NOT substituted
+        assert v.new_straggler is None or v.new_straggler != 0
+
+    def test_peer_median_excludes_slowest_two_host_gang(self):
+        det = StragglerDetector(threshold=2.0, consecutive=1)
+        v = det.observe(_table(1, {0: 0.2, 1: 0.8}))
+        # baseline is the OTHER host, not a median the straggler drags
+        assert v.median_s == pytest.approx(0.2)
+        assert v.new_straggler == 1
+
+    def test_clears_with_hysteresis(self):
+        det = StragglerDetector(threshold=1.5, consecutive=2,
+                                clear_after=2)
+        step = 0
+        for _ in range(2):
+            step += 1
+            v = det.observe(_table(step, {0: 0.2, 1: 0.9}))
+        assert v.active == 1
+        # one clean observation is NOT enough to clear
+        step += 1
+        v = det.observe(_table(step, {0: 0.2, 1: 0.21}))
+        assert v.active == 1 and v.cleared is None
+        step += 1
+        v = det.observe(_table(step, {0: 0.2, 1: 0.21}))
+        assert v.cleared == 1 and v.active is None
+
+    def test_straggler_handoff_clears_old_episode(self):
+        """When the straggler identity switches hosts, the SAME
+        verdict that raises the new episode must close the old one —
+        otherwise the first host's StragglerDetected is never followed
+        by a StragglerCleared."""
+        det = StragglerDetector(threshold=1.5, consecutive=2)
+        step = 0
+        for _ in range(2):
+            step += 1
+            v = det.observe(_table(step, {0: 0.2, 1: 0.9}))
+        assert v.active == 1
+        handoff = None
+        for _ in range(3):
+            step += 1
+            v = det.observe(_table(step, {0: 0.9, 1: 0.2}))
+            if v.new_straggler is not None:
+                handoff = v
+        assert handoff is not None
+        assert handoff.new_straggler == 0 and handoff.cleared == 1
+        assert v.active == 0
+
+    def test_stale_and_dead_hosts_excluded(self):
+        det = StragglerDetector(threshold=1.5, consecutive=1,
+                                stale_after_s=5.0)
+        stats = _table(1, {0: 0.2, 1: 0.2})
+        stats[2] = {"step": 1, "step_time_s": 9.0, "age_s": 600.0}
+        v = det.observe(stats)
+        assert v.observed_hosts == 2 and v.new_straggler is None
+        # a lone fresh host can't be judged against peers
+        v = det.observe({0: {"step": 2, "step_time_s": 0.2, "age_s": 0.0}})
+        assert v.observed_hosts == 1 and v.slowest is None
+
+    def test_min_window_on_injected_clock(self):
+        """The injected-clock guard: N heartbeats arriving in a burst
+        (after an apiserver stall) must not fire until the streak also
+        spans real time."""
+        now = [100.0]
+        det = StragglerDetector(threshold=1.5, consecutive=2,
+                                min_window_s=10.0, clock=lambda: now[0])
+        v = det.observe(_table(1, {0: 0.2, 1: 0.9}))
+        v = det.observe(_table(2, {0: 0.2, 1: 0.9}))
+        assert v.new_straggler is None  # streak ok, window not spanned
+        now[0] += 11.0
+        v = det.observe(_table(3, {0: 0.2, 1: 0.9}))
+        assert v.new_straggler == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition escaping (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestLabelEscaping:
+    def test_label_values_escaped(self):
+        from k8s_tpu.controller import metrics as M
+
+        reg = M.Registry()
+        c = reg.counter("esc_total", "help")
+        c.inc({"job": 'bad"name\\with\nnewline'})
+        text = reg.expose()
+        assert 'esc_total{job="bad\\"name\\\\with\\nnewline"} 1.0' in text
+        # the scrape stays line-structured: no raw newline inside a series
+        for line in text.splitlines():
+            assert line.startswith(("#", "esc_total"))
+
+    def test_help_escaped(self):
+        from k8s_tpu.controller import metrics as M
+
+        reg = M.Registry()
+        reg.gauge("g1", "line1\nline2 \\ backslash")
+        text = reg.expose()
+        assert "# HELP g1 line1\\nline2 \\\\ backslash" in text
+
+    def test_plain_values_unchanged(self):
+        from k8s_tpu.controller import metrics as M
+
+        reg = M.Registry()
+        reg.counter("plain_total", "x").inc({"type": "ADDED"})
+        assert 'plain_total{type="ADDED"} 1.0' in reg.expose()
+
+
+# ---------------------------------------------------------------------------
+# obs endpoint: backlog, stats block, flight-recorder route
+# ---------------------------------------------------------------------------
+
+
+class TestObsHealthServer:
+    def test_request_queue_size_bumped(self):
+        from k8s_tpu.controller.health import _Server
+
+        # the SYN-drop cliff fix (PR 7) applied to the health listener
+        assert _Server.request_queue_size == 128
+
+    def test_flightrecorder_route(self):
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.controller.health import HealthServer
+
+        tr = Tracer(trace_id="t-hs")
+        with tr.step(9) as st:
+            with st.phase("step_compute"):
+                pass
+        srv = HealthServer(port=0, registry=M.Registry(),
+                           host="127.0.0.1",
+                           stats_provider=lambda: {"obs": tr.heartbeat()},
+                           flight_recorder=tr.recorder).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(
+                    f"{base}/debug/flightrecorder", timeout=5) as r:
+                payload = json.loads(r.read())
+            assert payload["entries"][0]["step"] == 9
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["obs"]["step"] == 9
+            assert body["obs"]["trace_id"] == "t-hs"
+        finally:
+            srv.stop()
+
+    def test_flightrecorder_404_when_absent(self):
+        import urllib.error
+
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.controller.health import HealthServer
+
+        srv = HealthServer(port=0, registry=M.Registry(),
+                           host="127.0.0.1").start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/flightrecorder",
+                    timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# request-path spans through the real fleet HTTP stack
+# ---------------------------------------------------------------------------
+
+
+class TestRequestPathSpans:
+    @pytest.fixture()
+    def fleet(self):
+        from k8s_tpu.router.fleet import LocalFleet, StandinEngine
+
+        fl = LocalFleet(
+            [StandinEngine(max_slots=2, decode_chunk=4,
+                           round_wall_s=0.005) for _ in range(2)],
+            router_kwargs={"prefix_tokens": 4, "poll_interval": 0.1},
+        ).start()
+        yield fl
+        fl.stop()
+
+    def test_trace_id_and_spans_in_response(self, fleet):
+        code, body = fleet.generate([1, 2, 3, 4, 5], 8)
+        assert code == 200
+        assert body["trace_id"].startswith("req-")
+        spans = body["spans"]
+        for k in ("router_s", "engine_queue_s", "prefill_s", "decode_s"):
+            assert k in spans, spans
+        # the acceptance invariant: engine-side queue+prefill sum to
+        # the measured TTFT (same timestamps; rounding tolerance only)
+        assert spans["engine_queue_s"] + spans["prefill_s"] == \
+            pytest.approx(body["ttft_s"], abs=3e-4)
+
+    def test_client_trace_id_propagates_to_engine(self, fleet):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet.router.port}/v1/generate",
+            data=json.dumps({"prompt": [9, 8, 7, 6, 5],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-KTPU-Trace-Id": "client-trace-42"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        # the ENGINE echoed it (the router forwards the header), so
+        # both hops logged the same id
+        assert body["trace_id"] == "client-trace-42"
+
+    def test_router_healthz_trace_block(self, fleet):
+        for i in range(4):
+            # > decode_chunk tokens so the stream spans several chunks
+            # (a single-chunk stream has first token == last token and
+            # a legitimately zero decode span)
+            code, _ = fleet.generate(
+                [i + 1, i + 2, i + 3, i + 4, i + 5], 12)
+            assert code == 200
+        health = fleet.router.healthz()
+        tr = health["trace"]
+        assert tr["window"] >= 4
+        # prefill + decode actually took wall time on the paced stand-in
+        assert tr["prefill_p50_ms"] > 0
+        assert tr["decode_p50_ms"] > 0
+        assert tr["router_p95_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# spec + operator plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestObservabilitySpec:
+    def test_validate_and_env(self):
+        from k8s_tpu import spec as S
+
+        obs = S.ObservabilitySpec(obs_port=8790,
+                                  flight_recorder_dir="/scratch/fr")
+        obs.validate()
+        env = obs.to_env()
+        assert env["KTPU_FLIGHT_DIR"] == "/scratch/fr"
+        assert env["KTPU_FLIGHT_CAPACITY"] == "256"
+        assert "KTPU_TRACE" not in env  # enabled is the default
+        # capacity reaches the IN-MEMORY ring even without a dump dir
+        # (the live /debug/flightrecorder route is dir-less)
+        env2 = S.ObservabilitySpec(
+            obs_port=8790, flight_recorder_capacity=1024).to_env()
+        assert env2["KTPU_FLIGHT_CAPACITY"] == "1024"
+        assert "KTPU_FLIGHT_DIR" not in env2
+        assert S.ObservabilitySpec(trace=False).to_env()["KTPU_TRACE"] == "0"
+        with pytest.raises(S.ValidationError):
+            S.ObservabilitySpec(straggler_threshold=1.0).validate()
+        with pytest.raises(S.ValidationError):
+            S.ObservabilitySpec(straggler_steps=0).validate()
+        with pytest.raises(S.ValidationError):
+            S.ObservabilitySpec(obs_port=70000).validate()
+
+    def test_rejected_on_serving_jobs(self):
+        """No serving program runs the obs endpoint — the combination
+        would be a declared port with no listener, so it is rejected
+        at validation instead of silently doing nothing."""
+        from k8s_tpu import spec as S
+
+        j = S.TpuJob()
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER")]
+        j.spec.serving = S.ServingSpec(replicas=1)
+        j.spec.observability = S.ObservabilitySpec(obs_port=8790)
+        j.spec.set_defaults()
+        with pytest.raises(S.ValidationError, match="training-gang"):
+            j.spec.validate()
+
+    def test_roundtrip_through_dict(self):
+        from k8s_tpu import spec as S
+
+        j = S.TpuJob()
+        j.spec.observability = S.ObservabilitySpec(
+            obs_port=8790, straggler_threshold=2.0, straggler_steps=4)
+        d = j.to_dict()
+        back = S.TpuJob.from_dict(d)
+        assert back.spec.observability.obs_port == 8790
+        assert back.spec.observability.straggler_threshold == 2.0
+        assert back.spec.observability.straggler_steps == 4
+
+    def _make_job(self, with_obs=True):
+        from k8s_tpu import spec as S
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "obsjob"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+        ]
+        if with_obs:
+            j.spec.observability = S.ObservabilitySpec(
+                obs_port=8790, flight_recorder_dir="/scratch/fr")
+        tj = TrainingJob(client, TpuJobClient(cluster), j)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        return client, j
+
+    def test_operator_env_reaches_worker_pods(self):
+        """spec.observability → RendezvousSpec.obs_env → the jax
+        container's env on every worker pod (mirror of the
+        checkpointPolicy/training flow tests)."""
+        client, j = self._make_job()
+        rid = j.spec.runtime_id
+        for idx in range(2):
+            w = client.jobs.get("default", f"obsjob-worker-{rid}-{idx}")
+            env = w.spec.template.spec.containers[0].env_dict()
+            assert env["KTPU_TRACE_ID"] == f"obsjob-{rid}"
+            assert env["KTPU_OBS_ADVERTISE"] == \
+                f"obsjob-worker-{rid}-{idx}:8790"
+            assert env["KTPU_FLIGHT_DIR"] == "/scratch/fr"
+        # the obs port is DECLARED on the per-index Service (a
+        # ClusterIP forwards only declared ports — the serving lesson)
+        svc = client.services.get("default", f"obsjob-worker-{rid}-0")
+        ports = {p.name: p.port for p in svc.spec.ports}
+        assert ports.get("ktpu-obs") == 8790
+
+    def test_trace_id_stamped_without_block(self):
+        client, j = self._make_job(with_obs=False)
+        rid = j.spec.runtime_id
+        w = client.jobs.get("default", f"obsjob-worker-{rid}-0")
+        env = w.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_TRACE_ID"] == f"obsjob-{rid}"
+        assert "KTPU_OBS_ADVERTISE" not in env
+        svc = client.services.get("default", f"obsjob-worker-{rid}-0")
+        assert all(p.name != "ktpu-obs" for p in svc.spec.ports)
+
+    def test_launcher_parses_contract(self):
+        from k8s_tpu.launcher.spmd_launcher import Rendezvous
+
+        rdzv = Rendezvous(env={
+            "KTPU_TRACE_ID": "j-abcd",
+            "KTPU_OBS_ADVERTISE": "j-worker-abcd-0:8790",
+            "KTPU_FLIGHT_DIR": "/scratch/fr",
+        })
+        assert rdzv.trace_id == "j-abcd"
+        assert rdzv.obs_advertise == "j-worker-abcd-0:8790"
+        assert rdzv.flight_dir == "/scratch/fr"
+
+    def test_example_yaml_observability_block(self):
+        from k8s_tpu.tools.kubectl_local import load_tpu_job_yaml
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "tpu_job_multislice_llama.yaml")
+        with open(path) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        job.spec.validate()
+        assert job.spec.observability is not None
+        assert job.spec.observability.obs_port == 8790
+        assert job.spec.observability.flight_recorder_dir == \
+            "/scratch/flightrec"
+
+
+# ---------------------------------------------------------------------------
+# reconciler straggler tick (fast, injected stats)
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerReconcile:
+    def _job(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "skewjob"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+        ]
+        j.spec.observability = S.ObservabilitySpec(
+            obs_port=8790, straggler_threshold=1.5, straggler_steps=2)
+        jc.create(j)
+        return client, TrainingJob(client, jc, j)
+
+    def test_condition_names_pod_and_gauges_export(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.controller import metrics as M
+
+        client, tj = self._job()
+        cfg = S.ControllerConfig()
+        step = [0]
+
+        def fetch():
+            step[0] += 1
+            return _table(step[0], {0: 0.2, 1: 0.9})
+
+        tj.worker_stats_fetcher = fetch
+        tj.reconcile(cfg)  # observation 1
+        assert not any(c.type == "StragglerDetected"
+                       for c in tj.status.conditions)
+        tj.reconcile(cfg)  # observation 2 → verdict
+        conds = [c for c in tj.status.conditions
+                 if c.type == "StragglerDetected"]
+        assert len(conds) == 1
+        rid = tj.job.spec.runtime_id
+        assert f"skewjob-worker-{rid}-1" in conds[0].reason
+        # K8s Event recorded, naming the same pod
+        evs = [e for e in client.events.list("default")
+               if e.reason == "StragglerDetected"]
+        assert evs and f"skewjob-worker-{rid}-1" in evs[0].message
+        # skew + per-phase gauges populated
+        job_lbl = {"job": tj.fullname}
+        assert M.OBS_STEP_SKEW.get(job_lbl) == pytest.approx(0.7)
+        assert M.OBS_HOST_STEP_TIME.get(
+            {**job_lbl, "host": "1"}) == pytest.approx(0.9)
+        assert M.OBS_PHASE_SECONDS.get(
+            {**job_lbl, "host": "1", "phase": "step_compute"}
+        ) == pytest.approx(0.9)
+        assert M.OBS_STRAGGLERS.get(job_lbl) == 1.0
+        # no flap: continued skew does not re-append the condition
+        tj.reconcile(cfg)
+        tj.reconcile(cfg)
+        assert sum(1 for c in tj.status.conditions
+                   if c.type == "StragglerDetected") == 1
+        assert M.OBS_STRAGGLERS.get(job_lbl) == 1.0
+
+    def test_clears_after_recovery(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job()
+        cfg = S.ControllerConfig()
+        step = [0]
+        times = {0: 0.2, 1: 0.9}
+
+        def fetch():
+            step[0] += 1
+            return _table(step[0], times)
+
+        tj.worker_stats_fetcher = fetch
+        for _ in range(2):
+            tj.reconcile(cfg)
+        assert any(c.type == "StragglerDetected"
+                   for c in tj.status.conditions)
+        times[1] = 0.21
+        for _ in range(4):
+            tj.reconcile(cfg)
+        assert any(c.type == "StragglerCleared"
+                   for c in tj.status.conditions)
+
+    def test_no_stats_no_crash(self):
+        from k8s_tpu import spec as S
+
+        _, tj = self._job()
+        tj.worker_stats_fetcher = lambda: None
+        tj.reconcile(S.ControllerConfig())  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# metrics-docs lint
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsLint:
+    def test_repo_is_clean(self):
+        from k8s_tpu.obs import lint
+
+        assert lint.lint() == [], lint.lint()
+
+    def test_detects_undocumented_series(self, tmp_path):
+        from k8s_tpu.obs import lint
+
+        src = tmp_path / "pkg"
+        src.mkdir()
+        (src / "m.py").write_text(
+            'A = REGISTRY.counter(\n    "ktpu_new_thing_total", "x")\n')
+        doc = tmp_path / "OBSERVABILITY.md"
+        doc.write_text("# nothing here\n")
+        problems = lint.lint(str(src), str(doc))
+        assert len(problems) == 1
+        assert "ktpu_new_thing_total" in problems[0]
+        assert "not documented" in problems[0]
+
+    def test_detects_stale_doc_entry(self, tmp_path):
+        from k8s_tpu.obs import lint
+
+        src = tmp_path / "pkg"
+        src.mkdir()
+        (src / "m.py").write_text("")
+        doc = tmp_path / "OBSERVABILITY.md"
+        doc.write_text("| `ktpu_ghost_series` | gauge | gone |\n")
+        problems = lint.lint(str(src), str(doc))
+        assert len(problems) == 1
+        assert "ktpu_ghost_series" in problems[0]
+        assert "not registered" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# obs server helper (the trainer-side endpoint)
+# ---------------------------------------------------------------------------
+
+
+class TestStartObsServer:
+    class Rdzv:
+        process_id = 0
+        replica_type = "worker"
+
+    def test_serves_heartbeat_and_extra_stats(self, capsys, monkeypatch):
+        from k8s_tpu.programs.common import start_obs_server
+
+        monkeypatch.setenv("KTPU_OBS_ADVERTISE", "127.0.0.1:0")
+        tr = Tracer(trace_id="t-obs")
+        with tr.step(3) as st:
+            with st.phase("step_compute"):
+                pass
+        srv = start_obs_server(self.Rdzv(), tr,
+                               extra_stats=lambda: {"ckpt": {"x": 1}})
+        assert srv is not None
+        try:
+            ev = obs_events.last_event(capsys.readouterr().out, "obs_ready")
+            assert ev is not None and ev["port"] == srv.port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["obs"]["step"] == 3
+            assert body["ckpt"] == {"x": 1}
+        finally:
+            srv.stop()
+
+    def test_absent_advertise_is_noop(self, monkeypatch):
+        from k8s_tpu.programs.common import start_obs_server
+
+        monkeypatch.delenv("KTPU_OBS_ADVERTISE", raising=False)
+        assert start_obs_server(self.Rdzv(), Tracer()) is None
+
+    def test_unbindable_port_degrades_not_crashes(self, capsys,
+                                                  monkeypatch):
+        import socket
+
+        from k8s_tpu.programs.common import start_obs_server
+
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        monkeypatch.setenv("KTPU_OBS_ADVERTISE", f"127.0.0.1:{port}")
+        try:
+            srv = start_obs_server(self.Rdzv(), Tracer())
+            assert srv is None
+            ev = obs_events.last_event(capsys.readouterr().out, "obs_error")
+            assert ev is not None
+        finally:
+            blocker.close()
