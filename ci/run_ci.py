@@ -137,8 +137,11 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_fleet.xml"],
             args.artifacts_dir, cases,
         )
-        # observability gate (ISSUE 9): tracer/flight-recorder units,
-        # structured-event parser, straggler-detector decision table,
+        # observability gate (ISSUEs 9+10): tracer/flight-recorder
+        # units, structured-event parser, straggler-detector AND
+        # training-health-monitor decision tables (NaN one-shot,
+        # spike-vs-EMA, plateau, hysteresis), the reconciler's
+        # observe→act divergence tick, HBM gauges, /debug/profile,
         # Prometheus label-escaping regression, spec/operator round
         # trip — plus the metrics-lint (next stage). Always on and
         # fast: a telemetry regression (a span that stopped summing to

@@ -293,7 +293,15 @@ def local_shards_of(leaf, devices=None) -> Dict[str, np.ndarray]:
     shards: Dict[str, np.ndarray] = {}
     addressable = getattr(leaf, "addressable_shards", None)
     if addressable is None:
-        arr = np.asarray(leaf)
+        # copy=True everywhere in this function: save()'s contract is
+        # that the device→host copy happens NOW so the caller may
+        # donate immediately — but np.asarray of a CPU-backend jax
+        # array can be a ZERO-COPY view of the device buffer, and the
+        # async writer then serializes whatever the NEXT (donated)
+        # step scribbled into it: a crc-consistent garbage checkpoint
+        # (found by the divergence e2e — restored states differed
+        # nondeterministically run to run)
+        arr = np.array(leaf, copy=True)
         full = index_key(tuple(slice(0, d) for d in arr.shape), arr.shape)
         return {full: arr}
     for sh in addressable:
@@ -301,7 +309,7 @@ def local_shards_of(leaf, devices=None) -> Dict[str, np.ndarray]:
             continue
         key = index_key(sh.index, leaf.shape)
         if key not in shards:
-            shards[key] = np.asarray(sh.data)
+            shards[key] = np.array(sh.data, copy=True)
     return shards
 
 

@@ -53,6 +53,11 @@ class CheckpointPolicy:
     persistent_dir: str = ""
     persistent_interval_steps: int = 0
     peer_fetch: bool = True
+    # restore ceiling ("last healthy step"): after a TrainingDiverged
+    # verdict the operator injects KTPU_CKPT_RESTORE_MAX_STEP on the
+    # restarted gang so planning never targets a NaN checkpoint
+    # (docs/OBSERVABILITY.md "Training health", docs/CHECKPOINT.md)
+    max_restore_step: Optional[int] = None
 
     @classmethod
     def from_env(cls, env=None) -> "CheckpointPolicy":
@@ -64,6 +69,11 @@ class CheckpointPolicy:
             except ValueError:
                 return default
 
+        raw_max = env.get("KTPU_CKPT_RESTORE_MAX_STEP", "")
+        try:
+            max_restore = int(raw_max) if raw_max else None
+        except ValueError:
+            max_restore = None
         return cls(
             local_dir=env.get("KTPU_CKPT_LOCAL_DIR", ""),
             local_interval_steps=num("KTPU_CKPT_LOCAL_EVERY", 0),
@@ -72,6 +82,7 @@ class CheckpointPolicy:
             persistent_interval_steps=num("KTPU_CKPT_PERSIST_EVERY", 0),
             peer_fetch=env.get("KTPU_CKPT_PEER_FETCH", "1")
             not in ("0", "false"),
+            max_restore_step=max_restore,
         )
 
     @property
@@ -170,6 +181,7 @@ class MultiTierCheckpointManager:
         self.planner = RestorePlanner(
             self.local, self.persistent, transport=transport,
             consensus=consensus, gang_consistent=gang_consistent,
+            max_step=policy.max_restore_step,
         )
         self.last_restore_plan: Optional[RestorePlan] = None
 
@@ -185,17 +197,41 @@ class MultiTierCheckpointManager:
 
     # ------------------------------------------------------------ save
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, force: bool = False,
+             unhealthy=None) -> bool:
         """Tier routing: local every ``local_interval`` steps,
         persistent every ``persistent_interval`` steps; ``force`` writes
         BOTH (the preemption-flush / final-save path must land durably
-        AND be the newest local step so the restart restores it fast)."""
+        AND be the newest local step so the restart restores it fast).
+
+        ``unhealthy`` (optional callable) gates every write: evaluated
+        ONLY on steps a tier would actually write (it may sync the
+        device — e.g. reading the in-step health block), and a True
+        verdict skips BOTH tiers with a ``ckpt_skip_unhealthy`` event.
+        A diverged run must never checkpoint its NaN state — retention
+        would rotate the healthy snapshots out from under the restart
+        (docs/CHECKPOINT.md, "last healthy step"). Owning the gate HERE
+        keeps it in lockstep with the routing predicate by
+        construction."""
         t0 = time.monotonic()
         wrote = False
         try:
-            if self.local is not None and (
+            wants_local = self.local is not None and (
                 force or step % self.policy.local_interval_steps == 0
-            ):
+            )
+            wants_persistent = self.persistent is not None and (
+                force
+                or (
+                    self.policy.persistent_interval_steps > 0
+                    and step % self.policy.persistent_interval_steps == 0
+                )
+            )
+            if ((wants_local or wants_persistent)
+                    and unhealthy is not None and unhealthy()):
+                print(json.dumps({"event": "ckpt_skip_unhealthy",
+                                  "step": step}), flush=True)
+                return False
+            if wants_local:
                 # best-effort: a failed local snapshot (full node disk,
                 # chaos partial commit) degrades THIS interval's restart
                 # cost, never the training job — the persistent tier is
@@ -211,13 +247,7 @@ class MultiTierCheckpointManager:
                         "local checkpoint save failed at step %d (%s: %s); "
                         "local tier degraded this interval",
                         step, type(e).__name__, e)
-            if self.persistent is not None and (
-                force
-                or (
-                    self.policy.persistent_interval_steps > 0
-                    and step % self.policy.persistent_interval_steps == 0
-                )
-            ):
+            if wants_persistent:
                 if self.persistent.save(step, state, force=force):
                     self.stats.persistent_saves += 1
                     wrote = True

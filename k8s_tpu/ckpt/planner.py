@@ -117,6 +117,7 @@ class RestorePlanner:
         consensus: Optional[Callable[[int], int]] = None,
         devices=None,
         gang_consistent: bool = False,
+        max_step: Optional[int] = None,
     ):
         self.local = local
         self.persistent = persistent  # train.checkpoint.CheckpointManager
@@ -129,6 +130,13 @@ class RestorePlanner:
         # the union of visible manifests (see module docstring) so every
         # host picks the same step without communicating
         self.gang_consistent = gang_consistent
+        # restore ceiling ("last healthy step", docs/OBSERVABILITY.md
+        # "Training health"): after a divergence verdict the operator
+        # injects KTPU_CKPT_RESTORE_MAX_STEP on the restarted gang —
+        # steps past it are invisible to planning on EVERY tier, so a
+        # NaN checkpoint is never the restore target. Deterministic
+        # like the gang rule: every host gets the same ceiling env.
+        self.max_step = max_step
 
     # ------------------------------------------------------------ planning
 
@@ -151,7 +159,33 @@ class RestorePlanner:
         steps = set(self.local.committed_steps() if self.local else [])
         for peer_list in peer_steps.values():
             steps.update(peer_list)
+        if self.max_step is not None:
+            steps = {s for s in steps if s <= self.max_step}
         return sorted(steps, reverse=True)
+
+    def _persistent_step(self) -> Optional[int]:
+        """Newest persistent-tier step within the restore ceiling.
+        Orbax managers expose ``all_steps`` so a bounded plan can reach
+        past a too-new latest; a persistent tier without it degrades to
+        all-or-nothing (its latest counts only when within bound)."""
+        if self.persistent is None:
+            return None
+        try:
+            if self.max_step is not None:
+                all_steps = getattr(self.persistent, "all_steps", None)
+                if callable(all_steps):
+                    steps = [s for s in (all_steps() or [])
+                             if s <= self.max_step]
+                    return max(steps) if steps else None
+            step = self.persistent.latest_step()
+        except Exception as e:
+            log.warning("restore planner: persistent tier step discovery "
+                        "failed (%s)", e)
+            return None
+        if (self.max_step is not None and step is not None
+                and step > self.max_step):
+            return None
+        return step
 
     def plan(self, template: Any) -> RestorePlan:
         """Choose the step + per-shard sources for this host. Template
@@ -161,13 +195,7 @@ class RestorePlanner:
             # a peer blacklisted during an earlier restore (booting,
             # transient timeout) gets a fresh chance each plan
             self.transport.reset()
-        persistent_step = None
-        if self.persistent is not None:
-            try:
-                persistent_step = self.persistent.latest_step()
-            except Exception as e:
-                log.warning("restore planner: persistent tier latest_step "
-                            "failed (%s)", e)
+        persistent_step = self._persistent_step()
         needed = {
             path: required_indices(leaf, devices=self.devices)
             for path, leaf in _leaf_paths(template)
@@ -341,11 +369,7 @@ class RestorePlanner:
             log.warning(
                 "restore: local-tier restore of step %s failed mid-way; "
                 "falling back to the persistent tier", plan.step)
-            persistent_step = (
-                self.persistent.latest_step()
-                if self.persistent is not None else None
-            )
-            plan = self._persistent_plan(persistent_step)
+            plan = self._persistent_plan(self._persistent_step())
         if plan.source == SOURCE_PERSISTENT:
             tree = self.persistent.restore(template, step=plan.step)
             if tree is None:
@@ -429,4 +453,18 @@ class RestorePlanner:
                 jax.make_array_from_callback(shape, sharding, cb)
             )
         flat, treedef = jax.tree_util.tree_flatten(template)
-        return jax.tree_util.tree_unflatten(treedef, leaves_out)
+        tree = jax.tree_util.tree_unflatten(treedef, leaves_out)
+        # re-buffer through XLA-allocated storage: the train step
+        # DONATES the restored state, and on jax 0.4.x CPU gloo
+        # runtimes donating externally-created buffers
+        # (make_array_from_callback) corrupts the heap — the known
+        # "restored gloo worker" container bug, which surfaces either
+        # as a glibc abort or as SILENT corruption a step later
+        # (observed: bit-identical first post-restore step, garbage
+        # second). One device-side copy per restore is noise next to
+        # the disk reads it follows.
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            tree)

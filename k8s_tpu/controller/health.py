@@ -17,6 +17,12 @@ Routes:
                 -> the attached flight recorder's ring of recent spans/
                    events (404 when none attached) — the live half of
                    the post-mortem surface (docs/OBSERVABILITY.md).
+  ``/debug/profile?seconds=N``
+                -> run the attached profiler hook for N seconds (a
+                   bounded jax.profiler trace into the flight-recorder
+                   dir on trainer obs endpoints; 404 when no hook) and
+                   return its JSON result — the on-demand profiling
+                   surface (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -72,6 +78,33 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.split("?", 1)[0] == "/debug/profile":
+            import json
+            from urllib.parse import parse_qs, urlsplit
+
+            hook = self.server.owner.profiler
+            if hook is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                q = parse_qs(urlsplit(self.path).query)
+                seconds = float((q.get("seconds") or ["2"])[0])
+            except ValueError:
+                seconds = 2.0
+            # the hook blocks this handler thread for the capture
+            # window (ThreadingHTTPServer — probes/scrapes unaffected)
+            # and never raises (capture_profile's contract)
+            try:
+                result = hook(seconds)
+            except Exception as e:  # a hook bug must not kill the probe
+                result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            body = json.dumps(result, default=str).encode() + b"\n"
+            self.send_response(200 if result.get("ok") else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/debug/flightrecorder":
             import json
 
@@ -115,7 +148,7 @@ class HealthServer:
 
     def __init__(self, port: int, registry: Optional[metrics.Registry] = None,
                  host: str = "0.0.0.0", stats_provider=None,
-                 flight_recorder=None):
+                 flight_recorder=None, profiler=None):
         self.registry = registry or metrics.REGISTRY
         self.healthy = True
         # optional callable returning a dict merged into the /healthz
@@ -126,6 +159,10 @@ class HealthServer:
         # /debug/flightrecorder (the on-disk dump covers the dead-pod
         # case; this route covers the live one)
         self.flight_recorder = flight_recorder
+        # optional callable(seconds) -> dict behind /debug/profile —
+        # the on-demand jax.profiler capture on trainer obs endpoints
+        # (k8s_tpu.obs.health.capture_profile); None keeps the route 404
+        self.profiler = profiler
         self._server = _Server((host, port), _Handler)
         self._server.owner = self
         self.port = self._server.server_address[1]

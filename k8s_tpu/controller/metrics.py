@@ -248,3 +248,46 @@ OBS_STRAGGLERS = REGISTRY.counter(
     "ktpu_obs_stragglers_total",
     "StragglerDetected verdicts raised, by job",
 )
+# Training-health monitoring (k8s_tpu/obs/health.py,
+# docs/OBSERVABILITY.md "Training health"): numerics verdicts + the
+# goodput cost of divergence, fed by the reconciler's obs tick.
+OBS_DIVERGENCE_RESTARTS = REGISTRY.counter(
+    "ktpu_obs_divergence_restarts_total",
+    "Gang restarts driven by a TrainingDiverged verdict, by job",
+)
+OBS_DIVERGED_STEPS = REGISTRY.counter(
+    "ktpu_obs_diverged_steps_total",
+    "Train steps discarded to divergence (progress past the last "
+    "healthy step at verdict time), by job",
+)
+OBS_NUMERICS_WARNINGS = REGISTRY.counter(
+    "ktpu_obs_numerics_warnings_total",
+    "NumericsWarning verdicts raised (loss spike / plateau), by job/kind",
+)
+OBS_MEMORY_PRESSURE = REGISTRY.counter(
+    "ktpu_obs_memory_pressure_total",
+    "MemoryPressure events raised (HBM peak over the spec'd fraction "
+    "of capacity), by job/host",
+)
+# Device HBM gauges (jax Device.memory_stats), exported by every
+# process that serves an obs/metrics endpoint — trainer hosts and
+# serving engines alike. Empty on backends that don't report (CPU).
+OBS_HBM_IN_USE = REGISTRY.gauge(
+    "ktpu_obs_hbm_bytes_in_use",
+    "Device HBM bytes currently allocated, by device",
+)
+OBS_HBM_PEAK = REGISTRY.gauge(
+    "ktpu_obs_hbm_bytes_peak",
+    "Device HBM high-water mark since process start, by device",
+)
+OBS_HBM_LIMIT = REGISTRY.gauge(
+    "ktpu_obs_hbm_bytes_limit",
+    "Device HBM capacity visible to the allocator, by device",
+)
+# Serving: device bytes held by the shared-prefix KV snapshot LRU
+# (docs/SERVING.md "Fleet") — the count-bounded cache finally gets
+# bytes accounting so fleet capacity planning has real numbers.
+SERVING_PREFIX_CACHE_BYTES = REGISTRY.gauge(
+    "ktpu_serving_prefix_cache_bytes",
+    "Device bytes held by the engine's shared-prefix KV snapshot LRU",
+)

@@ -1,0 +1,457 @@
+"""Training-health monitoring: numerics classification, HBM pressure,
+and on-demand profiling helpers.
+
+The numerics half of the observability layer (docs/OBSERVABILITY.md,
+"Training health"). The training step computes a fused on-device
+health block (loss, global grad norm, nonfinite-grad count,
+update/param norm ratio — ``make_train_step(health=True)``); the
+program reads it at its existing log points (no extra host syncs),
+emits it as the ``step_health`` event, and carries it on the per-host
+heartbeat. The reconciler's obs tick feeds those observations into
+:class:`HealthMonitor` — pure decision logic in the same
+injected-clock/hysteresis style as
+:class:`k8s_tpu.obs.straggler.StragglerDetector` — and acts on the
+verdict per ``spec.observability.onDivergence`` (restart from the last
+*healthy* checkpoint / halt / observe only).
+
+Classification rules, deliberately simple and fully deterministic (the
+unit-test surface):
+
+- **NaN/Inf** — a non-finite loss or grad norm, or any nonfinite grad
+  element, trips ``diverged`` in ONE observation (there is no honest
+  hysteresis for NaN: the params are poisoned from the next update on).
+  The verdict carries ``first_bad_step`` and ``last_healthy_step`` —
+  the restore ceiling the operator threads into the PR-4 planner so a
+  NaN step is never the restore target.
+- **Loss spike vs EMA** — loss >= ``spike_factor`` x the running EMA of
+  healthy losses for ``spike_steps`` consecutive FRESH observations
+  (an observation counts only when the reported step advanced) raises
+  a ``loss_spike`` warning; an optional ``min_window_s`` of clock time
+  must span the streak (burst guard, injected clock).
+- **Plateau** — over the last ``plateau_window`` healthy observations
+  the relative loss improvement stays under ``plateau_rel`` → a
+  ``plateau`` warning. 0 disables.
+- Hysteresis both ways: one warning per episode, cleared after
+  ``clear_after`` clean fresh observations; a step REGRESSION (the gang
+  restarted and replays from a restored step) resets the divergence
+  episode so the monitor can judge the recovered run afresh.
+
+This module also hosts two device-facing helpers shared by the trainer
+obs endpoint and the serving frontend (imported lazily — the monitor
+itself must stay importable on device-less operator processes):
+
+- :func:`hbm_block` — per-device ``jax`` ``memory_stats()`` gauges
+  (``ktpu_obs_hbm_bytes_in_use`` / ``_peak`` / ``_limit``) plus an
+  aggregate heartbeat block with the worst-device peak fraction the
+  reconciler's MemoryPressure check reads;
+- :func:`capture_profile` — a bounded ``jax.profiler`` trace into the
+  flight-recorder dir, behind ``GET /debug/profile?seconds=N`` on the
+  per-host obs server (the on-demand successor of the env-gated
+  ``maybe_profile``).
+
+Chaos: the ``nan-grad`` fault arms here (:func:`arm_nan_grad` in
+process, ``KTPU_CHAOS_NAN_GRAD="<step>"`` for subprocess gangs — the
+same split as the slow-host hook in ``obs.trace``); the training
+program consumes it per step and poisons that step's gradients with a
+NaN loss scale, making the whole divergence→restore path drivable
+deterministically in e2e.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+# health-block keys the step emits / the heartbeat carries
+HEALTH_KEYS = ("loss", "grad_norm", "nonfinite_grads", "update_ratio")
+
+
+# -- chaos nan-grad hook (process-local arm; see runtime/chaos.py) --------
+
+_NAN_LOCK = threading.Lock()
+# armed step: None = off, -1 = "the next consumed step", N = exactly N
+_NAN_ARMED: Dict[str, Optional[int]] = {"step": None}
+
+
+def arm_nan_grad(step: int = -1) -> None:
+    """Poison the gradients of train step ``step`` of this process
+    (-1 = the next step that polls) — the in-process arm of the
+    ``nan-grad`` chaos fault. Subprocess gangs arm the same poison at
+    spawn via ``KTPU_CHAOS_NAN_GRAD="<step>"``."""
+    with _NAN_LOCK:
+        _NAN_ARMED["step"] = int(step)
+
+
+def nan_grad_armed(env=None) -> Optional[int]:
+    """The armed poison step, from the process hook or the env
+    contract (None when the fault is not armed at all — programs use
+    this to decide whether the chaos-scale leaf rides the batch)."""
+    with _NAN_LOCK:
+        if _NAN_ARMED["step"] is not None:
+            return _NAN_ARMED["step"]
+    env = env if env is not None else os.environ
+    spec = env.get("KTPU_CHAOS_NAN_GRAD", "")
+    if spec:
+        try:
+            return int(spec)
+        except ValueError:
+            return None
+    return None
+
+
+def consume_nan_grad(step: int, env=None) -> bool:
+    """True exactly once, at the armed step (or the first polled step
+    for ``-1``): the caller must poison THIS step's gradients. The
+    env arm clears process-locally so a poisoned run never re-fires."""
+    armed = nan_grad_armed(env)
+    if armed is None:
+        return False
+    if armed != -1 and armed != int(step):
+        return False
+    with _NAN_LOCK:
+        _NAN_ARMED["step"] = None
+    # the env stays set for the process lifetime — mask it so the next
+    # poll sees the fault as spent (a restarted pod re-reads the real
+    # env, which is exactly the once-per-pod-lifetime contract)
+    if env is None and os.environ.get("KTPU_CHAOS_NAN_GRAD"):
+        os.environ["KTPU_CHAOS_NAN_GRAD_FIRED"] = \
+            os.environ.pop("KTPU_CHAOS_NAN_GRAD")
+    return True
+
+
+# -- pure health classification ------------------------------------------
+
+
+def _finite(x) -> bool:
+    try:
+        return math.isfinite(float(x))
+    except (TypeError, ValueError):
+        return False
+
+
+@dataclass
+class HealthVerdict:
+    """One observation's outcome. ``new_divergence`` fires exactly once
+    per episode (the observation that tripped it); ``diverged`` holds
+    while the episode lasts (until a restart's step regression resets
+    it). ``new_warning``/``warning_cleared`` bracket a warning episode
+    the same way."""
+
+    observed_step: int = -1
+    fresh: bool = False
+    restarted: bool = False          # step regressed: a restart replayed
+    new_divergence: bool = False
+    diverged: bool = False
+    first_bad_step: Optional[int] = None
+    last_healthy_step: Optional[int] = None
+    new_warning: Optional[str] = None   # "loss_spike" | "plateau"
+    warning: Optional[str] = None       # active warning kind
+    warning_cleared: Optional[str] = None
+    reason: str = ""
+    loss: Optional[float] = None
+
+
+class HealthMonitor:
+    def __init__(
+        self,
+        spike_factor: float = 3.0,
+        spike_steps: int = 2,
+        ema_alpha: float = 0.3,
+        warmup_obs: int = 3,
+        plateau_window: int = 0,
+        plateau_rel: float = 1e-3,
+        clear_after: int = 3,
+        min_window_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if spike_factor <= 1.0:
+            raise ValueError("spike_factor must be > 1.0")
+        self.spike_factor = float(spike_factor)
+        self.spike_steps = max(1, int(spike_steps))
+        self.ema_alpha = float(ema_alpha)
+        self.warmup_obs = max(1, int(warmup_obs))
+        self.plateau_window = max(0, int(plateau_window))
+        self.plateau_rel = float(plateau_rel)
+        self.clear_after = max(1, int(clear_after))
+        self.min_window_s = float(min_window_s)
+        self.clock = clock
+        self._last_step = -1
+        self._last_healthy: Optional[int] = None
+        self._diverged = False
+        self._first_bad: Optional[int] = None
+        self._ema: Optional[float] = None
+        self._healthy_obs = 0
+        self._spike_streak = 0
+        self._spike_started_at = 0.0
+        self._warning: Optional[str] = None
+        self._clear_streak = 0
+        self._plateau: deque = deque(maxlen=max(1, self.plateau_window))
+
+    def reset(self, floor_step: int) -> None:
+        """Start a new episode after the CALLER acted on a verdict (the
+        reconciler's divergence restart): clears the divergence/warning
+        streaks and raises the fresh-observation floor to
+        ``floor_step`` — the gang's progress at verdict time — so the
+        torn-down gang's stale heartbeats (and the replay below the
+        floor) can't re-trip on old evidence, while a RECURRING fault
+        past the floor raises a new ``new_divergence`` (bounded by the
+        caller's restart budget). Without this, a replay that resumes
+        exactly at the old max step would never regress the step
+        counter and a persistent fault would never re-raise.
+        ``last_healthy_step`` survives — it is still the best-known
+        restore ceiling."""
+        self._diverged = False
+        self._first_bad = None
+        self._spike_streak = 0
+        self._clear_streak = 0
+        self._plateau.clear()
+        self._last_step = max(self._last_step, int(floor_step))
+
+    def observe(self, health: Dict) -> HealthVerdict:
+        """Judge one health observation
+        (``{"step", "loss", "grad_norm", "nonfinite_grads",
+        "update_ratio"}`` — the ``step_health`` block). Observations
+        with a non-advancing step are ignored (a reconciler re-polling
+        an unchanged heartbeat must not inflate any streak)."""
+        v = HealthVerdict(diverged=self._diverged,
+                          first_bad_step=self._first_bad,
+                          last_healthy_step=self._last_healthy,
+                          warning=self._warning)
+        try:
+            step = int(health.get("step", -1))
+        except (TypeError, ValueError):
+            return v
+        if step < 0:
+            return v
+        v.observed_step = step
+        if step < self._last_step:
+            # the gang restarted and replays from a restored step: the
+            # old episode's evidence describes a state that no longer
+            # exists — reset so the recovered run is judged afresh
+            v.restarted = True
+            self._diverged = False
+            self._first_bad = None
+            self._spike_streak = 0
+            self._clear_streak = 0
+            self._plateau.clear()
+            self._last_step = step - 1
+            v.diverged = False
+            v.first_bad_step = None
+        if step <= self._last_step:
+            return v
+        self._last_step = step
+        v.fresh = True
+
+        loss = health.get("loss")
+        v.loss = float(loss) if _finite(loss) else None
+        nonfinite = 0.0
+        try:
+            nf = float(health.get("nonfinite_grads", 0) or 0)
+            nonfinite = nf if math.isfinite(nf) else 1.0
+        except (TypeError, ValueError):
+            nonfinite = 0.0
+        bad = (
+            nonfinite > 0
+            or not _finite(loss)
+            or not _finite(health.get("grad_norm", 0.0))
+        )
+        if bad:
+            if not self._diverged:
+                self._diverged = True
+                self._first_bad = step
+                v.new_divergence = True
+                v.reason = (
+                    f"non-finite numerics at step {step} "
+                    f"(loss={health.get('loss')}, "
+                    f"grad_norm={health.get('grad_norm')}, "
+                    f"nonfinite_grads={nonfinite:g}); "
+                    f"last healthy step: {self._last_healthy}"
+                )
+            v.diverged = True
+            v.first_bad_step = self._first_bad
+            return v
+
+        # healthy observation
+        self._last_healthy = step
+        v.last_healthy_step = step
+        if self._diverged:
+            # NaN params cannot heal without a restore, so a healthy
+            # observation while diverged means the evidence is mixed
+            # (e.g. a host restarted without a step regression we saw)
+            # — count toward clearing rather than trusting one sample
+            self._clear_streak += 1
+            if self._clear_streak >= self.clear_after:
+                self._diverged = False
+                self._first_bad = None
+                self._clear_streak = 0
+            v.diverged = self._diverged
+            v.first_bad_step = self._first_bad
+            return v
+
+        lf = float(loss)
+        self._healthy_obs += 1
+        spiking = (
+            self._ema is not None
+            and self._healthy_obs > self.warmup_obs
+            and lf >= self.spike_factor * self._ema
+        )
+        if spiking:
+            if self._spike_streak == 0:
+                self._spike_started_at = self.clock()
+            self._spike_streak += 1
+        else:
+            self._spike_streak = 0
+        # EMA freezes while spike evidence accumulates PRE-verdict
+        # (updating it with the spiked samples would pull the baseline
+        # up and kill the streak before the bar); once the warning is
+        # raised it tracks again, so a sustained new loss level becomes
+        # the baseline and the warning self-clears (hysteresis).
+        if not (spiking and self._warning is None):
+            self._ema = (lf if self._ema is None
+                         else (1 - self.ema_alpha) * self._ema
+                         + self.ema_alpha * lf)
+
+        plateaued = False
+        if self.plateau_window > 0 and not spiking:
+            self._plateau.append(lf)
+            if len(self._plateau) == self.plateau_window:
+                first, last = self._plateau[0], self._plateau[-1]
+                denom = max(abs(first), 1e-12)
+                plateaued = (first - last) / denom < self.plateau_rel
+
+        if (
+            spiking
+            and self._spike_streak >= self.spike_steps
+            and self._warning != "loss_spike"
+            and self.clock() - self._spike_started_at >= self.min_window_s
+        ):
+            self._warning = "loss_spike"
+            self._clear_streak = 0
+            v.new_warning = "loss_spike"
+            v.reason = (
+                f"loss {lf:.4g} >= {self.spike_factor:g}x EMA "
+                f"{self._ema:.4g} for {self._spike_streak} consecutive "
+                f"steps (step {step})"
+            )
+        elif plateaued and self._warning != "plateau":
+            self._warning = "plateau"
+            self._clear_streak = 0
+            v.new_warning = "plateau"
+            v.reason = (
+                f"loss improvement under {self.plateau_rel:g} over the "
+                f"last {self.plateau_window} observations (step {step})"
+            )
+        elif self._warning is not None and not spiking and not plateaued:
+            self._clear_streak += 1
+            if self._clear_streak >= self.clear_after:
+                v.warning_cleared = self._warning
+                self._warning = None
+                self._clear_streak = 0
+        v.warning = self._warning
+        return v
+
+
+# -- device memory (HBM) gauges ------------------------------------------
+
+
+def device_memory_stats() -> List[Dict]:
+    """Per-local-device allocator stats from ``jax``'s
+    ``Device.memory_stats()`` — empty on backends that don't report
+    (CPU returns None) and on any error: memory telemetry is
+    best-effort everywhere."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out: List[Dict] = []
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        out.append({
+            "device": int(getattr(d, "id", len(out))),
+            "bytes_in_use": int(ms.get("bytes_in_use", 0) or 0),
+            "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0) or 0),
+            "bytes_limit": int(ms.get("bytes_limit", 0) or 0),
+        })
+    return out
+
+
+def hbm_block(stats: Optional[List[Dict]] = None,
+              export_gauges: bool = True,
+              task: str = "") -> Optional[Dict]:
+    """The heartbeat/healthz ``hbm`` block: per-device stats plus the
+    aggregate the reconciler's MemoryPressure check reads (worst-device
+    ``peak_fraction``). ``export_gauges`` also sets the process-global
+    ``ktpu_obs_hbm_*`` series (one label set per device). Returns None
+    when the backend reports nothing (CPU) — the block is simply absent
+    from the heartbeat then."""
+    stats = device_memory_stats() if stats is None else stats
+    if not stats:
+        return None
+    if export_gauges:
+        from k8s_tpu.controller import metrics
+
+        for s in stats:
+            lbl = {"device": str(s["device"])}
+            if task:
+                lbl["task"] = task
+            metrics.OBS_HBM_IN_USE.set(float(s["bytes_in_use"]), lbl)
+            metrics.OBS_HBM_PEAK.set(float(s["peak_bytes_in_use"]), lbl)
+            metrics.OBS_HBM_LIMIT.set(float(s["bytes_limit"]), lbl)
+    peak_fraction = max(
+        (s["peak_bytes_in_use"] / s["bytes_limit"]
+         for s in stats if s["bytes_limit"] > 0),
+        default=0.0,
+    )
+    return {
+        "bytes_in_use": sum(s["bytes_in_use"] for s in stats),
+        "peak_bytes_in_use": max(s["peak_bytes_in_use"] for s in stats),
+        "bytes_limit": sum(s["bytes_limit"] for s in stats),
+        "peak_fraction": round(peak_fraction, 4),
+        "devices": stats,
+    }
+
+
+# -- on-demand profiling --------------------------------------------------
+
+_PROFILE_LOCK = threading.Lock()
+
+
+def capture_profile(out_dir: str, seconds: float) -> Dict:
+    """One bounded ``jax.profiler`` trace into ``out_dir`` — the
+    ``GET /debug/profile?seconds=N`` backend on the per-host obs
+    server. Exactly one capture at a time per process (the profiler
+    cannot nest); a concurrent request gets a busy error instead of a
+    crashed trace. Never raises."""
+    seconds = min(max(float(seconds), 0.1), 60.0)
+    if not out_dir:
+        return {"ok": False, "error": "no profile dir configured "
+                                      "(set observability.flightRecorderDir)"}
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return {"ok": False, "error": "profile capture already in progress"}
+    try:
+        import jax
+
+        path = os.path.join(out_dir, f"profile-{int(time.time() * 1e3)}")
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return {"ok": True, "dir": path, "seconds": seconds}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    finally:
+        _PROFILE_LOCK.release()

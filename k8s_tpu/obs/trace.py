@@ -295,6 +295,10 @@ class Tracer:
         self.overhead_s = 0.0
         self._hb_lock = threading.Lock()
         self._hb = {"step": 0, "step_time_s": 0.0, "phases_s": {}}
+        # latest step_health block (note_health) — kept OUTSIDE _hb:
+        # _finish_step rebuilds the heartbeat every step, while health
+        # only refreshes at log points, and must survive in between
+        self._hb_health: Optional[Dict] = None
         self._hb_at = 0.0  # monotonic of last heartbeat refresh
         self._env_slow_seconds = 0.0
         self._env_slow_steps = 0
@@ -372,6 +376,31 @@ class Tracer:
             self._hb_at = time.monotonic()
         self.recorder.maybe_flush()
 
+    def note_health(self, step: int, health: Dict) -> None:
+        """Attach a step's numerics-health block (loss, grad norm,
+        nonfinite-grad count, update ratio — the ``step_health``
+        contract, docs/OBSERVABILITY.md "Training health") to the
+        heartbeat the obs endpoint serves AND the flight-recorder ring,
+        so a SIGKILLed diverging pod leaves its last losses/grad-norms
+        on disk. Called at the program's existing log points only — the
+        health scalars were device arrays until the caller read them,
+        so this adds no sync of its own."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        block = {"step": int(step), **health}
+        self.recorder.record({
+            "kind": "health", "t": time.time(),
+            "trace_id": self.trace_id, "task": self.task, **block,
+        })
+        with self._hb_lock:
+            self._hb_health = block
+        self.recorder.maybe_flush()
+        # accounted like StepTrace bookkeeping: the llama_bench < 1%
+        # overhead guard must cover the health-note path (including an
+        # interval flush's fsync'd dump) — not just the phase spans
+        self.overhead_s += time.perf_counter() - t0
+
     def _record_span(self, name: str, wall_s: float, attrs: dict) -> None:
         self.recorder.record({
             "kind": "span", "name": name, "t": time.time(),
@@ -388,6 +417,8 @@ class Tracer:
         with self._hb_lock:
             hb = dict(self._hb)
             at = self._hb_at
+            if self._hb_health is not None:
+                hb["health"] = dict(self._hb_health)
         hb["trace_id"] = self.trace_id
         hb["task"] = self.task
         hb["host"] = self.host

@@ -39,7 +39,7 @@ def mark_preempt_aware() -> None:
     os.environ["KTPU_PREEMPT_AWARE"] = "1"
 
 
-def maybe_preempt_exit(mgr, rdzv, step: int, state) -> None:
+def maybe_preempt_exit(mgr, rdzv, step: int, state, unhealthy=None) -> None:
     """The shared per-step preemption contract for every training
     program: on a gang-wide preemption verdict — JAX's coordination-
     service notifier via orbax ``reached_preemption`` when distributed
@@ -49,18 +49,32 @@ def maybe_preempt_exit(mgr, rdzv, step: int, state) -> None:
     CURRENT step, then exit 143 (retryable) so the gang restart
     resumes from here instead of the last periodic save. No-op when
     ``mgr`` is None (benches and non-checkpointing jobs never pay the
-    poll)."""
+    poll).
+
+    ``unhealthy`` (optional callable, evaluated ONLY on a positive
+    verdict — it may sync the device) gates the flush: a DIVERGED gang
+    being preempted (e.g. the operator's onDivergence restart tearing
+    it down) must NOT write its NaN state as the newest checkpoint —
+    retention would evict the healthy snapshots the restart needs
+    (docs/CHECKPOINT.md, "last healthy step"). The exit still
+    happens; only the parting save is skipped."""
     if mgr is None:
         return
     preempted = (mgr.reached_preemption(step) if rdzv.num_processes > 1
                  else preempt_requested())
     if not preempted:
         return
-    mgr.save(step, state, force=True)
-    mgr.wait()
-    mgr.close()
-    print(json.dumps({"event": "preempt_checkpoint", "step": step}),
-          flush=True)
+    if unhealthy is not None and unhealthy():
+        print(json.dumps({"event": "preempt_skip_unhealthy",
+                          "step": step}), flush=True)
+        mgr.wait()
+        mgr.close()
+    else:
+        mgr.save(step, state, force=True)
+        mgr.wait()
+        mgr.close()
+        print(json.dumps({"event": "preempt_checkpoint", "step": step}),
+              flush=True)
     # same signal path, same guarantee: the flight recorder's final
     # spans land on node-local disk next to the flushed checkpoint
     from k8s_tpu.obs.trace import dump_default
@@ -154,7 +168,17 @@ def build_checkpoint_manager(cfg: RunConfig, rdzv):
     if cfg.checkpoint_dir:
         from k8s_tpu.train.checkpoint import CheckpointManager
 
-        return CheckpointManager(cfg.checkpoint_dir), None
+        # the divergence-restart restore ceiling applies to the plain
+        # persistent path too (docs/OBSERVABILITY.md "Training health")
+        try:
+            max_restore = int(
+                os.environ.get("KTPU_CKPT_RESTORE_MAX_STEP", "") or -1)
+        except ValueError:
+            max_restore = -1
+        return CheckpointManager(
+            cfg.checkpoint_dir,
+            max_restore_step=max_restore if max_restore >= 0 else None,
+        ), None
     return None, None
 
 
@@ -179,9 +203,13 @@ def start_obs_server(rdzv, tracer, extra_stats=None):
     """Per-host observability endpoint (spec.observability →
     ``KTPU_OBS_ADVERTISE`` = "<svc-dns>:<port>", rewritten to a
     loopback endpoint by the local kubelet's resolver): serves the
-    step heartbeat (+ any ``extra_stats``, e.g. checkpoint goodput) in
-    the /healthz stats block, the process-global /metrics registry,
-    and the live flight recorder at /debug/flightrecorder.
+    step heartbeat + device HBM gauges (+ any ``extra_stats``, e.g.
+    checkpoint goodput) in the /healthz stats block, the process-global
+    /metrics registry, the live flight recorder at
+    /debug/flightrecorder, and on-demand profiling at
+    ``/debug/profile?seconds=N`` (jax.profiler trace into the flight-
+    recorder dir — the primary profiling path; the env-gated
+    ``maybe_profile`` remains for loop-scoped captures).
 
     Best-effort: an unbindable port degrades observability for this
     host, never the training job. Returns the server or None; the
@@ -199,6 +227,17 @@ def start_obs_server(rdzv, tracer, extra_stats=None):
 
     def stats():
         out = {"obs": tracer.heartbeat()}
+        try:
+            from k8s_tpu.obs.health import hbm_block
+
+            hbm = hbm_block(task=tracer.task)
+            if hbm is not None:
+                # the reconciler's MemoryPressure check reads this off
+                # the heartbeat; backends without memory_stats (CPU)
+                # simply omit the block
+                out["obs"]["hbm"] = hbm
+        except Exception:
+            pass  # memory telemetry must never break the heartbeat
         if extra_stats is not None:
             try:
                 out.update(extra_stats() or {})
@@ -206,13 +245,21 @@ def start_obs_server(rdzv, tracer, extra_stats=None):
                 pass  # aux stats must never break the heartbeat
         return out
 
+    profile_dir = (os.environ.get("KTPU_FLIGHT_DIR", "")
+                   or os.environ.get("KTPU_PROFILE_DIR", ""))
+
+    def profiler(seconds: float) -> dict:
+        from k8s_tpu.obs.health import capture_profile
+
+        return capture_profile(profile_dir, seconds)
+
     from k8s_tpu.controller.health import HealthServer
 
     host_id = max(0, getattr(rdzv, "process_id", 0))
     try:
         srv = HealthServer(
             port=port, host="0.0.0.0", stats_provider=stats,
-            flight_recorder=tracer.recorder,
+            flight_recorder=tracer.recorder, profiler=profiler,
         ).start()
     except OSError as e:
         print(json.dumps({"event": "obs_error", "host": host_id,
@@ -225,8 +272,11 @@ def start_obs_server(rdzv, tracer, extra_stats=None):
 
 class maybe_profile:
     """jax.profiler trace around the hot loop when ``KTPU_PROFILE_DIR``
-    is set (process 0 only) — the per-step tracing upgrade SURVEY §5
-    calls for (the reference delegated all profiling to TensorBoard)."""
+    is set (process 0 only). Since the training-health PR the PRIMARY
+    profiling path is on-demand — ``GET /debug/profile?seconds=N`` on
+    every host's obs endpoint (docs/OBSERVABILITY.md), which needs no
+    pre-arranged env and works per host, not just process 0 — this
+    env-gated whole-loop capture remains for bench-style runs."""
 
     def __init__(self, rdzv):
         self.dir = os.environ.get("KTPU_PROFILE_DIR", "")

@@ -82,6 +82,47 @@ def _mesh_for(strategy: str, n: int, num_slices: int, stages: int = 2):
     raise ValueError(f"unknown strategy {strategy}")
 
 
+def _unhealthy_state(health_enabled: bool, metrics) -> bool:
+    """True when the LAST step's in-step health block says the state is
+    poisoned (non-finite grads or loss) — the checkpoint-save gate.
+    Costs one device sync, so callers only ask on steps that would
+    actually write. False without the health block: a job that opted
+    out keeps the old always-save behavior."""
+    import math
+
+    if not health_enabled or not metrics:
+        return False
+    try:
+        # deliberately ONLY grads + loss: update_ratio is informative
+        # telemetry but NOT a save gate — on multi-process CPU gloo
+        # this jax line can miscompile scalar metric reductions to NaN
+        # (the same known class as the version-gated SP loss-metric
+        # xfail), and a spurious NaN here would silently disable the
+        # local tier for an entire healthy run
+        return (
+            float(metrics["nonfinite_grads"]) > 0
+            or not math.isfinite(float(metrics["loss"]))
+        )
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def _chaos_scaled(loss, batch):
+    """Apply the ``nan-grad`` chaos poison when it rides the batch
+    (``chaos_scale`` leaf, docs/OBSERVABILITY.md "Training health"):
+    the leaf is 1.0 normally and 0.0 at the poisoned step — the
+    ``scale / scale`` below renders 1.0 (no-op) or 0/0 = NaN ON DEVICE,
+    making every gradient of that step NaN. The NaN must be
+    synthesized device-side because a NaN batch leaf would fail
+    multi-process ``device_put``'s same-value-on-every-process check
+    (NaN != NaN). Under gradient accumulation one poisoned microbatch
+    NaNs the whole accumulated gradient — the fault class the
+    divergence monitor must catch. Trace-time no-op (and no extra leaf
+    in the compiled signature) when the fault is not armed."""
+    scale = batch.get("chaos_scale") if isinstance(batch, dict) else None
+    return loss if scale is None else loss * (scale / scale)
+
+
 def _rdzv_flag(rdzv, attr: str, env: str) -> bool:
     """A trainer-mode flag from the launcher contract: the Rendezvous
     already parsed the operator-injected env (spec.training → to_env),
@@ -210,7 +251,8 @@ def main(rdzv) -> None:
 
     def loss_fn(state, params, b, rng):
         if pp:
-            return pp_loss(state, params, b, rng)
+            loss, aux = pp_loss(state, params, b, rng)
+            return _chaos_scaled(loss, b), aux
         # mutable intermediates: MoE layers sow their router
         # load-balancing loss there — without adding it to the training
         # loss the router collapses onto a few experts
@@ -232,7 +274,7 @@ def main(rdzv) -> None:
         aux = sum_sown_losses(mut.get("intermediates", {}))
         # combined total of every sown router loss (load-balancing +
         # z-loss) — named accordingly so it isn't misread as one of them
-        return ce + aux, {"router_losses": aux}
+        return _chaos_scaled(ce + aux, b), {"router_losses": aux}
 
     # --latency_hiding=1 (or KTPU_LATENCY_HIDING=1 in the pod env):
     # async-collective scheduling, docs/PERF.md. The env var is also
@@ -244,9 +286,19 @@ def main(rdzv) -> None:
         "1" if _rdzv_flag(rdzv, "latency_hiding",
                           "KTPU_LATENCY_HIDING") else "0",
     ) in ("1", "true")
+    # in-step numerics health (docs/OBSERVABILITY.md "Training
+    # health"): a fused on-device block (grad norm, nonfinite-grad
+    # count, update/param ratio) added to the step metrics — read only
+    # at the existing log points (no extra host syncs), emitted as the
+    # step_health event + carried on the obs heartbeat so the
+    # reconciler's HealthMonitor can judge the gang. Rides the trace
+    # gate: spec observability.trace=false turns both off.
+    health = tracer.enabled and \
+        extra.get("health", "1") not in ("0", "false")
     step_fn = make_train_step(loss_fn, mesh, rules,
                               accum_steps=cfg.accum_steps,
-                              zero1=zero1, latency_hiding=lhs)
+                              zero1=zero1, latency_hiding=lhs,
+                              health=health)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)
     # pacing knob for chaos/e2e tests: widens the mid-training window a
@@ -259,10 +311,28 @@ def main(rdzv) -> None:
     if mgr is not None:
         mark_preempt_aware()
     start = int(state.step)
+    # chaos nan-grad (runtime/chaos.py, armed in-process or via
+    # KTPU_CHAOS_NAN_GRAD="<step>"): the poison fires only on a
+    # FROM-SCRATCH run — a gang restarted from a pre-divergence
+    # checkpoint replays the poisoned step clean, which is exactly the
+    # transient-fault model the divergence→restore e2e proves recovery
+    # from. Once the fault is armed the scale leaf rides EVERY step's
+    # batch (one compiled signature), value NaN only at the armed step.
+    from k8s_tpu.obs.health import consume_nan_grad, nan_grad_armed
+
+    chaos_nan_live = start == 0 and nan_grad_armed() is not None
     # losses stay DEVICE arrays in the loop: float() forces a
     # device-to-host sync every step, serializing async dispatch — the
     # host only blocks at log points and after the loop
     first_loss = final_loss = None
+    metrics = None  # last step's metrics (None when no step ran)
+
+    def unhealthy_now() -> bool:
+        # the never-checkpoint-a-poisoned-state gate (docs/CHECKPOINT.md
+        # "last healthy step"): reads the LAST step's health block —
+        # callers evaluate it lazily, only where a write would happen
+        return _unhealthy_state(health, metrics)
+
     for step in range(start + 1, cfg.steps + 1):
         # every step runs inside a trace span with phase breakdown
         # (data_wait / step_compute / host_sync / ckpt_save — the
@@ -277,6 +347,23 @@ def main(rdzv) -> None:
                 _time.sleep(step_sleep)
             with st.phase("data_wait"):
                 batch = next(data)
+            if not chaos_nan_live and start == 0 \
+                    and nan_grad_armed() is not None:
+                # in-process arming AFTER the loop started (the chaos
+                # matrix's NanGradFault fires mid-run): the scale leaf
+                # joins the batch from this step on — one recompile,
+                # chaos runs only
+                chaos_nan_live = True
+            if chaos_nan_live:
+                import numpy as np
+
+                poison = consume_nan_grad(step)
+                # 0.0 is the poison sentinel (0/0 -> NaN on device)
+                batch = {**batch, "chaos_scale": np.float32(
+                    0.0 if poison else 1.0)}
+                if poison and rdzv.process_id <= 0:
+                    print(json.dumps({"event": "chaos_nan_grad",
+                                      "step": step}), flush=True)
             with st.phase("step_compute"):
                 state, metrics = step_fn(state, batch, rng)
             final_loss = metrics["loss"]
@@ -287,18 +374,47 @@ def main(rdzv) -> None:
                     # the ONLY per-step host sync (see the loop note
                     # above) — now measured instead of invisible
                     loss_f = float(final_loss)
+                    health_block = None
+                    if health:
+                        # the in-step health scalars ride the same
+                        # sync point — one readback batch, no new
+                        # per-step host round-trips
+                        health_block = {
+                            "loss": loss_f,
+                            "grad_norm": float(metrics["grad_norm"]),
+                            "nonfinite_grads":
+                                float(metrics["nonfinite_grads"]),
+                            "update_ratio":
+                                float(metrics["update_ratio"]),
+                        }
                 logger.log(step, {"loss": loss_f})
-            maybe_preempt_exit(mgr, rdzv, step, state)
+                if health_block is not None:
+                    # heartbeat + flight-recorder ring on EVERY host
+                    # (each host serves its own obs endpoint; a
+                    # SIGKILLed diverging pod leaves its last losses/
+                    # grad-norms in the on-disk dump)
+                    tracer.note_health(step, health_block)
+                    if rdzv.process_id <= 0:
+                        print(json.dumps({
+                            "event": "step_health", "step": step,
+                            **{k: round(v, 6) for k, v in
+                               health_block.items()},
+                        }), flush=True)
+            maybe_preempt_exit(mgr, rdzv, step, state,
+                               unhealthy=unhealthy_now)
             if multi_tier:
-                # the manager routes: local tier every localIntervalSteps
-                # (cheap device→host + node-local write), persistent tier
-                # every persistentIntervalSteps
+                # the manager routes: local tier every
+                # localIntervalSteps (cheap device→host + node-local
+                # write), persistent tier every persistentIntervalSteps
+                # — and owns the never-checkpoint-a-poisoned-state gate
+                # (the callable syncs the device only on steps a tier
+                # would actually write)
                 with st.phase("ckpt_save"):
-                    mgr.save(step, state)
+                    mgr.save(step, state, unhealthy=unhealthy_now)
                 mgr.note_step(step)
             elif mgr is not None and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                 with st.phase("ckpt_save"):
-                    mgr.save(step, state)
+                    mgr.save(step, state, unhealthy=unhealthy_now)
         if (step % cfg.log_every == 0 or step == cfg.steps) \
                 and rdzv.process_id <= 0 and tracer.enabled:
             # the per-step breakdown, machine-readable next to the
@@ -315,7 +431,10 @@ def main(rdzv) -> None:
         first_loss = float(first_loss)
         final_loss = float(final_loss)
     if mgr is not None:
-        mgr.save(cfg.steps, state, force=True)
+        # the final force save rides the same gate (both manager
+        # kinds): a diverged run must not overwrite the tiers with NaN
+        # state as its parting act
+        mgr.save(cfg.steps, state, force=True, unhealthy=unhealthy_now)
         mgr.wait()
         if multi_tier and rdzv.process_id <= 0:
             # goodput report: restore sources, lost-steps-per-restart,

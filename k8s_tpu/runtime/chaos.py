@@ -38,6 +38,13 @@ slow-host           one gang host's train steps throttled (armed via
                     ``KTPU_CHAOS_SLOW_HOST`` env for subprocess gangs)
                     → straggler detection names the right pod
                     (StragglerDetected condition + skew gauges)
+nan-grad            one train step's gradients poisoned with NaN (armed
+                    via the obs health hook in-process, or
+                    ``KTPU_CHAOS_NAN_GRAD="<step>"`` for subprocess
+                    gangs; fires once per from-scratch run) → the
+                    health monitor raises TrainingDiverged and the
+                    onDivergence policy restores from the last
+                    HEALTHY checkpoint (never the NaN step)
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -518,6 +525,32 @@ class SlowHostFault(FaultInjector):
         return f"{self.seconds}s x{n}"
 
 
+class NanGradFault(FaultInjector):
+    """Poison one future train step's gradients with NaN — the
+    divergence fault (``nan-grad``): the training program scales that
+    step's loss by NaN on device (one poisoned microbatch NaNs the
+    whole accumulated gradient), the in-step health block reports
+    non-finite numerics, and the reconciler's HealthMonitor must raise
+    ``TrainingDiverged`` and drive the ``onDivergence`` policy —
+    restoring from the last HEALTHY checkpoint, never the NaN step.
+    In-process trainers are armed through
+    :func:`k8s_tpu.obs.health.arm_nan_grad`; subprocess gangs arm a
+    deterministic step at spawn via ``KTPU_CHAOS_NAN_GRAD="<step>"``
+    (consumed by the same hook), which is what the divergence e2e
+    does."""
+
+    name = "nan-grad"
+
+    def fire(self) -> str:
+        from k8s_tpu.obs.health import arm_nan_grad
+
+        arm_nan_grad(-1)  # the next step that polls
+        self.injected += 1
+        log.info("chaos[%s]: armed NaN gradient poison for the next "
+                 "train step", self.name)
+        return "next-step"
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -615,7 +648,8 @@ class ChaosMonkey:
         - 2: + apiserver flakes, watch drops, slow handlers (needs the
           FaultyCluster wrapper; silently narrower without one)
         - 3+: + checkpoint-save failures, slow-host step throttles
-          (straggler detection), leader-lease loss, and — when
+          (straggler detection), NaN-gradient poisons (divergence
+          monitoring), leader-lease loss, and — when
           ``ckpt_root`` names a multi-tier local checkpoint root —
           partial local commits, local shard corruption, and whole-host
           local-tier loss (the k8s_tpu/ckpt recovery matrix); when
@@ -640,6 +674,7 @@ class ChaosMonkey:
         if level >= 3:
             inj.append(CheckpointSaveFault(rate=0.5, seed=s(), burst=2))
             inj.append(SlowHostFault(rate=0.2, seed=s()))
+            inj.append(NanGradFault(rate=0.1, seed=s()))
             inj.append(LeaseLossFault(
                 client.cluster, namespace=lease_namespace, rate=0.2, seed=s()))
             if ckpt_root:

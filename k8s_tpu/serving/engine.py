@@ -564,6 +564,11 @@ class ContinuousBatchingEngine:
         # key (prefix token bytes) -> (stage, snapshot cache tree)
         self._prefix_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # key -> device bytes of that snapshot; summed into
+        # stats["prefix_cache_bytes"] on every insert/evict so the LRU
+        # is bytes-accounted, not just count-bounded — the number fleet
+        # capacity planning needs (docs/SERVING.md "Fleet")
+        self._prefix_bytes: Dict[bytes, int] = {}
         self._capture_key: Optional[bytes] = None
 
         # ALL decode state lives on device between chunks; the host
@@ -627,7 +632,8 @@ class ContinuousBatchingEngine:
                       "prefill_tokens": 0, "queue_depth": 0,
                       "ttft_s_sum": 0.0, "ttft_count": 0,
                       "prefix_hits": 0, "prefix_misses": 0,
-                      "prefix_captures": 0, "prefix_tokens_saved": 0}
+                      "prefix_captures": 0, "prefix_tokens_saved": 0,
+                      "prefix_cache_bytes": 0}
 
     # -- request intake --------------------------------------------------
 
@@ -905,8 +911,14 @@ class ContinuousBatchingEngine:
                 snap = jax.tree_util.tree_map(jnp.copy, pcache)
                 self._prefix_cache[self._capture_key] = (stage, snap)
                 self._prefix_cache.move_to_end(self._capture_key)
+                self._prefix_bytes[self._capture_key] = sum(
+                    int(getattr(x, "nbytes", 0) or 0)
+                    for x in jax.tree_util.tree_leaves(snap))
                 while len(self._prefix_cache) > self.prefix_cache_max:
-                    self._prefix_cache.popitem(last=False)
+                    evicted, _ = self._prefix_cache.popitem(last=False)
+                    self._prefix_bytes.pop(evicted, None)
+                self.stats["prefix_cache_bytes"] = sum(
+                    self._prefix_bytes.values())
                 self.stats["prefix_captures"] += 1
                 self._capture_key = None
             if final:

@@ -121,6 +121,22 @@ class ServingFrontend:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 - stdlib naming
+                if self.path == "/metrics":
+                    # Prometheus text exposition off the process-global
+                    # registry: the engine-side ktpu_serving_* /
+                    # ktpu_obs_hbm_* series a fleet scrape reads
+                    # per-replica (docs/SERVING.md "Fleet")
+                    frontend._export_gauges()
+                    from k8s_tpu.controller import metrics as M
+
+                    body = M.REGISTRY.expose().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    return self.wfile.write(body)
                 if self.path != "/healthz":
                     return self._json(404, {"error": "not found"})
                 if frontend._consume_healthz_fault():
@@ -137,8 +153,14 @@ class ServingFrontend:
                 # engine attributes (getattr: stubs/legacy engines
                 # without them still serve a valid payload)
                 progress = getattr(eng, "prefill_progress", dict)()
+                hbm = frontend._export_gauges()
                 return self._json(200, {
                     "ok": not frontend._draining,
+                    # engine device-memory telemetry: HBM allocator
+                    # stats (absent on backends without memory_stats)
+                    # — capacity planning reads this next to
+                    # stats.prefix_cache_bytes
+                    **({"hbm": hbm} if hbm else {}),
                     "draining": frontend._draining,
                     "in_flight": in_flight,
                     "served": frontend.served,
@@ -228,6 +250,26 @@ class ServingFrontend:
         )
 
     # -- handler-thread side ---------------------------------------------
+
+    def _export_gauges(self):
+        """Refresh the process-global serving gauges (prefix-KV-cache
+        device bytes + HBM allocator stats) — called on every /healthz
+        and /metrics read so a scrape always sees current truth.
+        Best-effort: telemetry must never break the probe. Returns the
+        hbm block (or None) for the healthz body."""
+        try:
+            from k8s_tpu.controller import metrics as M
+
+            M.SERVING_PREFIX_CACHE_BYTES.set(float(
+                self.engine.stats.get("prefix_cache_bytes", 0) or 0))
+        except Exception:
+            pass
+        try:
+            from k8s_tpu.obs.health import hbm_block
+
+            return hbm_block(task="serving")
+        except Exception:
+            return None
 
     def _queue_depth(self) -> int:
         qd = getattr(self.engine, "queue_depth", None)

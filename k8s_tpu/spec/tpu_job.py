@@ -392,7 +392,28 @@ class ObservabilitySpec(K8sObject):
 
     ``trace: false`` disables span recording entirely (``KTPU_TRACE=0``
     in the pod env); the measured overhead of enabled spans is < 1% of
-    step time (guarded by the llama_bench smoke test)."""
+    step time (guarded by the llama_bench smoke test).
+
+    ``onDivergence`` closes the numerics loop (docs/OBSERVABILITY.md,
+    "Training health"): when the reconciler's health monitor trips
+    ``TrainingDiverged`` (non-finite loss/grads on the gang heartbeat),
+    ``restart`` tears the gang down and restores from the last
+    *healthy* checkpoint (the restore ceiling is threaded into the
+    multi-tier planner so a NaN step is never the restore target;
+    counts against ``maxGangRestarts``), ``halt`` fails the job and
+    frees the slice (a diverged run burning its reservation is the
+    failure mode this exists for), ``none`` (default) raises the
+    condition + Warning Event only.
+
+    ``memoryPressureFraction``: a ``MemoryPressure`` Warning Event is
+    raised when any host's HBM peak crosses this fraction of device
+    capacity (heartbeats carry ``jax`` ``memory_stats()`` gauges —
+    the pre-OOM warning shot).
+
+    ``stragglerProfileSeconds`` > 0 makes the operator auto-capture a
+    profiler trace (``GET /debug/profile``) from the straggler it
+    names, so the ``StragglerDetected`` Event points at evidence in
+    ``flightRecorderDir`` instead of a bare pod name (0 = off)."""
 
     obs_port: int = 0
     flight_recorder_dir: str = ""
@@ -400,6 +421,9 @@ class ObservabilitySpec(K8sObject):
     straggler_threshold: float = 1.5
     straggler_steps: int = 3
     trace: bool = True
+    on_divergence: str = "none"
+    memory_pressure_fraction: float = 0.9
+    straggler_profile_seconds: float = 2.0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -418,6 +442,16 @@ class ObservabilitySpec(K8sObject):
                 "observability: stragglerSteps must be >= 1")
         if not isinstance(self.trace, bool):
             raise ValidationError("observability: trace must be a boolean")
+        if self.on_divergence not in ("none", "restart", "halt"):
+            raise ValidationError(
+                f"observability: onDivergence must be one of "
+                f"none|restart|halt, got {self.on_divergence!r}")
+        if not 0.0 < self.memory_pressure_fraction <= 1.0:
+            raise ValidationError(
+                "observability: memoryPressureFraction must be in (0, 1]")
+        if self.straggler_profile_seconds < 0:
+            raise ValidationError(
+                "observability: stragglerProfileSeconds must be >= 0")
 
     def to_env(self) -> Dict[str, str]:
         """The launcher/program contract (``KTPU_TRACE``/

@@ -58,7 +58,9 @@ def arm_save_faults(n: int, exc: Optional[Exception] = None) -> None:
 class CheckpointManager:
     """Thin wrapper over orbax CheckpointManager (async save)."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3, save_interval_steps: int = 1):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1,
+                 max_restore_step: "Optional[int]" = None):
         import os
 
         import orbax.checkpoint as ocp
@@ -66,6 +68,11 @@ class CheckpointManager:
         self._ocp = ocp
         self._preemption_poll_broken = False
         self.directory = directory
+        # restore ceiling ("last healthy step"): default-step restores
+        # never pick a step past it — the plain-persistent arm of the
+        # divergence-restart contract (the multi-tier planner carries
+        # its own bound; docs/OBSERVABILITY.md "Training health")
+        self.max_restore_step = max_restore_step
         # KTPU_SYNC_CHECKPOINT=1 forces synchronous saves — escape hatch
         # for runtimes where orbax's background save thread is unsafe
         # next to other native threads (e.g. gloo CPU collectives)
@@ -79,9 +86,20 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, force: bool = False,
+             unhealthy=None) -> bool:
         if step in (self.manager.all_steps() or []):
             return False  # already checkpointed at this step
+        if unhealthy is not None and unhealthy():
+            # the never-checkpoint-a-poisoned-state gate, mirrored from
+            # the multi-tier manager (docs/CHECKPOINT.md "last healthy
+            # step"): callers pass it only on steps that would write,
+            # since evaluating it syncs the device
+            import json
+
+            print(json.dumps({"event": "ckpt_skip_unhealthy",
+                              "step": step}), flush=True)
+            return False
 
         def attempt() -> bool:
             if SAVE_FAULT_HOOK is not None:
@@ -100,7 +118,7 @@ class CheckpointManager:
         )
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
-        step = step if step is not None else self.manager.latest_step()
+        step = step if step is not None else self.latest_step()
         if step is None:
             return None
         abstract = jax.tree_util.tree_map(
@@ -181,8 +199,17 @@ class CheckpointManager:
                             type(e).__name__, e)
             return False
 
+    def all_steps(self) -> "list[int]":
+        return sorted(self.manager.all_steps() or [])
+
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        step = self.manager.latest_step()
+        if (self.max_restore_step is not None and step is not None
+                and step > self.max_restore_step):
+            bounded = [s for s in self.all_steps()
+                       if s <= self.max_restore_step]
+            return max(bounded) if bounded else None
+        return step
 
     def wait(self) -> None:
         self.manager.wait_until_finished()

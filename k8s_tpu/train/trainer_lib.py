@@ -223,6 +223,38 @@ def make_batch_sharder(mesh: Mesh, rules: LogicalRules):
     return lambda batch: jax.tree_util.tree_map(put, batch)
 
 
+def _health_block(params, new_params, grads) -> Dict[str, jax.Array]:
+    """The fused in-step numerics summary (``make_train_step(health=
+    True)``): a handful of f32 reductions XLA fuses into the step —
+    cheap by construction, and every output stays a device array so
+    the step adds zero host syncs. NaN-transparent: a poisoned
+    gradient surfaces as ``nonfinite_grads > 0`` AND a NaN
+    ``grad_norm``/``update_ratio`` (squares of NaN propagate), which is
+    exactly the one-shot signal ``obs.health.HealthMonitor`` trips on."""
+
+    def sumsq(tree):
+        s = jnp.zeros((), jnp.float32)
+        for x in jax.tree_util.tree_leaves(tree):
+            s = s + jnp.sum(jnp.square(x.astype(jnp.float32)))
+        return s
+
+    nonfinite = jnp.zeros((), jnp.float32)
+    for g in jax.tree_util.tree_leaves(grads):
+        nonfinite = nonfinite + jnp.sum(
+            (~jnp.isfinite(g)).astype(jnp.float32))
+    upd_sq = jnp.zeros((), jnp.float32)
+    for new, old in zip(jax.tree_util.tree_leaves(new_params),
+                        jax.tree_util.tree_leaves(params)):
+        d = new.astype(jnp.float32) - old.astype(jnp.float32)
+        upd_sq = upd_sq + jnp.sum(jnp.square(d))
+    return {
+        "grad_norm": jnp.sqrt(sumsq(grads)),
+        "nonfinite_grads": nonfinite,
+        "update_ratio": jnp.sqrt(upd_sq)
+        / jnp.sqrt(sumsq(params) + jnp.float32(1e-20)),
+    }
+
+
 def _flat_param_shardings(state) -> Tuple:
     """Per-leaf NamedShardings of ``state.params`` in flatten order
     (None where a leaf has no mesh placement, e.g. uncommitted host
@@ -244,6 +276,7 @@ def make_train_step(
     zero1: bool = False,
     latency_hiding: bool = False,
     compiler_options: Optional[Dict[str, str]] = None,
+    health: bool = False,
 ) -> TrainStepFn:
     """Build the jitted SPMD train step.
 
@@ -285,6 +318,19 @@ def make_train_step(
     level. Combine with ``latency_hiding=True`` to overlap the new
     gather/scatter with compute (docs/PERF.md, "sharded weight
     update").
+
+    ``health=True`` adds a fused on-device numerics-health block to the
+    step's metrics (docs/OBSERVABILITY.md, "Training health"):
+    ``grad_norm`` (global L2 of the final gradients, f32), ``nonfinite_grads``
+    (count of non-finite gradient elements, f32 so huge models don't
+    overflow int32), and ``update_ratio`` (L2 of the applied parameter
+    delta over the params' L2 — the "is the optimizer doing anything
+    sane" scalar). A handful of reductions fused into the step — no
+    extra dispatches and NO host syncs: the values stay device arrays
+    until the caller reads them (the programs only do so at their
+    existing log points). Off by default so the HLO collective-budget
+    goldens and bit-exact A/B trajectories are unchanged unless asked
+    for; the llama_bench ``"trace"`` block tracks its measured cost.
 
     ``latency_hiding=True`` compiles the step with XLA's latency-hiding
     scheduler (async collectives overlapped with compute — see
@@ -469,6 +515,9 @@ def make_train_step(
             if aux and "batch_stats" in aux:
                 new_state = new_state.replace(batch_stats=aux.pop("batch_stats"))
             metrics = {"loss": loss, **{k: v for k, v in (aux or {}).items()}}
+            if health:
+                metrics.update(_health_block(
+                    state.params, new_state.params, grads))
             return new_state, metrics
 
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -541,17 +541,24 @@ class TpuReplicaSet:
         """spec.checkpointPolicy → KTPU_CKPT_* (+ per-index peer shard
         endpoints when the REST wire is enabled: the per-index Service
         names the operator already maintains give every host a stable
-        DNS address for its peers' local tiers)."""
+        DNS address for its peers' local tiers). After a
+        ``TrainingDiverged`` verdict the reconciler's restore ceiling
+        (``TrainingJob.restore_ceiling`` = the last *healthy* step)
+        rides along as ``KTPU_CKPT_RESTORE_MAX_STEP``, so the restarted
+        gang's planner never targets a NaN checkpoint
+        (docs/OBSERVABILITY.md "Training health")."""
         policy = self.job.job.spec.checkpoint_policy
-        if policy is None:
-            return None
-        env = policy.to_env()
-        if policy.peer_port and self.spec.replica_type == WORKER:
+        env: Dict[str, str] = {} if policy is None else policy.to_env()
+        if policy is not None and policy.peer_port \
+                and self.spec.replica_type == WORKER:
             env["KTPU_CKPT_PEERS"] = ",".join(
                 f"{i}=http://{w.rsplit(':', 1)[0]}:{policy.peer_port}"
                 for i, w in enumerate(workers)
             )
-        return env
+        ceiling = getattr(self.job, "restore_ceiling", None)
+        if ceiling is not None and self.spec.replica_type == WORKER:
+            env["KTPU_CKPT_RESTORE_MAX_STEP"] = str(int(ceiling))
+        return env or None
 
     # ------------------------------------------------------------- delete
 

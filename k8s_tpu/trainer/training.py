@@ -104,6 +104,21 @@ class TrainingJob:
         self._straggler_detector = None
         self.worker_stats_fetcher: Optional[
             Callable[[], Optional[Dict[int, dict]]]] = None
+        # Training-health monitor (spec.observability.onDivergence,
+        # docs/OBSERVABILITY.md "Training health"): pure decision logic
+        # over the step_health blocks riding the same heartbeats. On a
+        # TrainingDiverged verdict the restore ceiling (last HEALTHY
+        # step) is stamped here; replicas._checkpoint_env injects it
+        # into the restarted gang so the planner never restores a NaN
+        # checkpoint. Cleared once the recovered gang trains past it.
+        self._health_monitor = None
+        self.restore_ceiling: Optional[int] = None
+        self._memory_pressure_hosts: set = set()
+        # pluggable profile capture (host, seconds) -> result dict for
+        # the straggler auto-profile; default GETs the host's obs
+        # endpoint /debug/profile in a background thread
+        self.profile_trigger: Optional[Callable[[int, float],
+                                                Optional[dict]]] = None
         # (clock_time, delay_armed_for_the_NEXT_restart) per restart —
         # what the soak asserts spacing from
         self.restart_history: List[Tuple[float, float]] = []
@@ -501,19 +516,46 @@ class TrainingJob:
             t.join(timeout=3)
         return out or None
 
-    def _maybe_detect_stragglers(self) -> None:
+    def _obs_tick(self) -> Optional[str]:
+        """The reconciler's observability tick: ONE concurrent heartbeat
+        sweep feeds straggler detection, the HBM-pressure check, and the
+        training-health monitor (docs/OBSERVABILITY.md). Returns the
+        health verdict's action (``"restarted"`` / ``"halt"`` /
+        ``"exhausted"``) for reconcile to act on, or None."""
+        obs = self.job.spec.observability
+        wset = self._worker_set()
+        if wset is None:
+            return None
+        if obs is None and self.worker_stats_fetcher is None:
+            return None
+        fetch = self.worker_stats_fetcher or self._http_worker_stats
+        stats = fetch()
+        if not stats:
+            return None
+        try:
+            self._maybe_detect_stragglers(stats)
+        except Exception as e:
+            log.error("job %s: straggler detection: %s", self.fullname, e)
+        try:
+            self._maybe_memory_pressure(stats)
+        except Exception as e:
+            log.error("job %s: memory-pressure check: %s", self.fullname, e)
+        return self._maybe_monitor_health(stats)
+
+    def _maybe_detect_stragglers(self, stats: Dict[int, dict]) -> None:
         """Straggler tick: aggregate per-host step/phase heartbeats,
         export the skew gauges, and raise a ``StragglerDetected``
         condition + Warning Event NAMING the divergent pod when one
         host's step time stays past the threshold (all hysteresis
-        lives in :class:`k8s_tpu.obs.straggler.StragglerDetector`)."""
+        lives in :class:`k8s_tpu.obs.straggler.StragglerDetector`).
+        On a fresh verdict the operator also auto-captures a profiler
+        trace from the named host (``/debug/profile``), so the Event
+        points at evidence, not just a pod name."""
         from k8s_tpu.controller import metrics
 
         obs = self.job.spec.observability
         wset = self._worker_set()
         if wset is None:
-            return
-        if obs is None and self.worker_stats_fetcher is None:
             return
         if self._straggler_detector is None:
             from k8s_tpu.obs.straggler import StragglerDetector
@@ -523,10 +565,6 @@ class TrainingJob:
                 consecutive=obs.straggler_steps if obs else 3,
                 clock=self.clock,
             )
-        fetch = self.worker_stats_fetcher or self._http_worker_stats
-        stats = fetch()
-        if not stats:
-            return
         verdict = self._straggler_detector.observe(stats)
         job_lbl = {"job": self.fullname}
         metrics.OBS_STEP_SKEW.set(verdict.skew_s, job_lbl)
@@ -546,6 +584,17 @@ class TrainingJob:
                 f"{verdict.median_s:.3f}s (x{verdict.ratio:.2f} over "
                 f"{verdict.streak} consecutive steps)"
             )
+            profile_s = (obs.straggler_profile_seconds
+                         if obs is not None else 0.0)
+            if profile_s > 0:
+                # evidence attached: the Event names where the profiler
+                # trace will land; the capture itself runs off-tick (it
+                # blocks for profile_s) and reports completion as its
+                # own StragglerProfile Event
+                reason += (f"; capturing a {profile_s:g}s device profile "
+                           f"from {pod} (/debug/profile -> "
+                           f"flightRecorderDir)")
+                self._capture_straggler_profile(idx, profile_s)
             metrics.OBS_STRAGGLERS.inc(job_lbl)
             self.status.append_condition("StragglerDetected", reason=reason)
             log.warning("job %s: straggler detected: %s",
@@ -558,6 +607,264 @@ class TrainingJob:
                       f"gang median")
             self.status.append_condition("StragglerCleared", reason=reason)
             self._record_event("StragglerCleared", reason)
+
+    def _http_profile_trigger(self, host: int,
+                              seconds: float) -> Optional[dict]:
+        """Default profile capture: GET the named host's obs endpoint
+        ``/debug/profile`` (stable per-index Service DNS on a real
+        cluster). Blocks for ~``seconds`` — callers run it off-tick."""
+        import json as _json
+        import urllib.request
+
+        obs = self.job.spec.observability
+        wset = self._worker_set()
+        if obs is None or not obs.obs_port or wset is None:
+            return None
+        url = (f"http://{wset.job_name(host)}:{obs.obs_port}"
+               f"/debug/profile?seconds={seconds:g}")
+        try:
+            with urllib.request.urlopen(url, timeout=seconds + 10) as r:
+                return _json.loads(r.read())
+        except Exception:
+            return None
+
+    def _capture_straggler_profile(self, host: int, seconds: float) -> None:
+        """Kick off the straggler auto-profile in a daemon thread (the
+        capture blocks for the trace window — never the reconcile
+        tick) and report the captured trace path as a
+        ``StragglerProfile`` Event. Best-effort end to end: a dead obs
+        endpoint degrades the evidence, never the tick."""
+        trigger = self.profile_trigger or self._http_profile_trigger
+
+        def run():
+            try:
+                result = trigger(host, seconds)
+            except Exception as e:
+                log.warning("job %s: straggler profile capture: %s",
+                            self.fullname, e)
+                return
+            if result and result.get("ok"):
+                self._record_event(
+                    "StragglerProfile",
+                    f"device profile of host {host} captured: "
+                    f"{result.get('dir')} ({seconds:g}s)")
+            else:
+                log.warning(
+                    "job %s: straggler profile of host %d failed: %s",
+                    self.fullname, host,
+                    (result or {}).get("error", "unreachable"))
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"straggler-profile-{self.name}").start()
+
+    # ------------------------------------------------------------ health
+
+    def _maybe_memory_pressure(self, stats: Dict[int, dict]) -> None:
+        """HBM-pressure tick: heartbeats carry per-host device
+        ``memory_stats`` aggregates (``hbm.peak_fraction``); crossing
+        ``observability.memoryPressureFraction`` raises one
+        ``MemoryPressure`` condition + Warning Event per host episode —
+        the warning shot BEFORE the first allocation failure kills the
+        gang. Hosts without the block (CPU backends) are skipped.
+        NB the allocator peak is a process-lifetime high-water mark
+        (monotone), so an episode re-arms only when the observed peak
+        DROPS — i.e. the host's process restarted and its allocator
+        reset; within one process generation the warning fires once."""
+        from k8s_tpu.controller import metrics
+
+        obs = self.job.spec.observability
+        fraction = (obs.memory_pressure_fraction if obs is not None
+                    else 0.9)
+        wset = self._worker_set()
+        for host, hb in stats.items():
+            hbm = hb.get("hbm")
+            if not isinstance(hbm, dict):
+                continue
+            peak = float(hbm.get("peak_fraction", 0.0) or 0.0)
+            if peak >= fraction and host not in self._memory_pressure_hosts:
+                self._memory_pressure_hosts.add(host)
+                pod = wset.job_name(host) if wset is not None else str(host)
+                # peak_fraction is per-DEVICE (worst device's peak over
+                # ITS limit) — the evidence bytes must come from that
+                # device, not the host aggregate (max peak over summed
+                # limits would contradict the percentage)
+                worst = max(
+                    (d for d in (hbm.get("devices") or [])
+                     if d.get("bytes_limit", 0) > 0),
+                    key=lambda d: d["peak_bytes_in_use"] / d["bytes_limit"],
+                    default=None)
+                evidence = (
+                    f"; device {worst['device']}: "
+                    f"{worst['peak_bytes_in_use']} / "
+                    f"{worst['bytes_limit']} bytes"
+                ) if worst else ""
+                reason = (
+                    f"host {host} ({pod}) HBM peak at {peak:.0%} of "
+                    f"device capacity (threshold {fraction:.0%}"
+                    f"{evidence})"
+                )
+                metrics.OBS_MEMORY_PRESSURE.inc(
+                    {"job": self.fullname, "host": str(host)})
+                self.status.append_condition("MemoryPressure",
+                                             reason=reason)
+                log.warning("job %s: %s", self.fullname, reason)
+                self._record_event("MemoryPressure", reason,
+                                   etype="Warning")
+            elif peak < fraction:
+                self._memory_pressure_hosts.discard(host)
+
+    def _maybe_monitor_health(self, stats: Dict[int, dict]) -> Optional[str]:
+        """Numerics tick: feed the freshest ``step_health`` block off
+        the gang heartbeats (the values are global/replicated — any
+        host's copy is authoritative) into the
+        :class:`k8s_tpu.obs.health.HealthMonitor` and act per
+        ``observability.onDivergence``:
+
+        - ``restart``: stamp the restore ceiling (last HEALTHY step),
+          account the discarded steps, and gang-restart — the recreated
+          pods carry ``KTPU_CKPT_RESTORE_MAX_STEP`` so the planner
+          restores strictly before the divergence. Counts against
+          ``maxGangRestarts`` (a run that re-diverges every restart
+          must eventually fail, not loop forever); deliberately NOT
+          held by the restart backoff — a diverged gang makes zero
+          progress, so waiting buys nothing.
+        - ``halt``: tear the gang down (stop burning the reservation)
+          and fail the job.
+        - ``none``: condition + Warning Event only.
+
+        Returns ``"restarted"`` / ``"exhausted"`` / ``"halt"`` for
+        reconcile, or None."""
+        from k8s_tpu.controller import metrics
+
+        obs = self.job.spec.observability
+        blocks = [hb.get("health") for hb in stats.values()
+                  if isinstance(hb.get("health"), dict)]
+        if not blocks:
+            return None
+        if self._health_monitor is None:
+            from k8s_tpu.obs.health import HealthMonitor
+
+            self._health_monitor = HealthMonitor(clock=self.clock)
+        block = max(blocks, key=lambda b: int(b.get("step", -1) or -1))
+        verdict = self._health_monitor.observe(block)
+        job_lbl = {"job": self.fullname}
+
+        if (
+            self.restore_ceiling is not None
+            and verdict.fresh and not verdict.diverged
+            and verdict.observed_step > self.restore_ceiling
+        ):
+            reason = (f"trained past the divergence restore ceiling "
+                      f"(step {verdict.observed_step} > "
+                      f"{self.restore_ceiling}) with healthy numerics")
+            self.restore_ceiling = None
+            self.status.append_condition("TrainingRecovered",
+                                         reason=reason)
+            self._record_event("TrainingRecovered", reason)
+
+        if verdict.new_warning is not None:
+            metrics.OBS_NUMERICS_WARNINGS.inc(
+                {**job_lbl, "kind": verdict.new_warning})
+            self.status.append_condition("NumericsWarning",
+                                         reason=verdict.reason)
+            log.warning("job %s: numerics warning: %s",
+                        self.fullname, verdict.reason)
+            self._record_event("NumericsWarning", verdict.reason,
+                               etype="Warning")
+
+        if not verdict.new_divergence:
+            return None
+        # goodput: the steps whose work the recovery will discard —
+        # gang progress at verdict time past the last healthy step
+        progress = max(
+            [int(hb.get("step", 0) or 0) for hb in stats.values()]
+            + [verdict.observed_step])
+        ceiling = (verdict.last_healthy_step
+                   if verdict.last_healthy_step is not None else 0)
+        discarded = max(0, progress - ceiling)
+        metrics.OBS_DIVERGED_STEPS.inc(job_lbl, by=float(discarded))
+        policy = obs.on_divergence if obs is not None else "none"
+        reason = (
+            f"{verdict.reason}; first bad step "
+            f"{verdict.first_bad_step}, ~{discarded} steps discarded "
+            f"(policy: {policy})"
+        )
+        self.status.append_condition("TrainingDiverged", reason=reason)
+        log.warning("job %s: training diverged: %s", self.fullname, reason)
+        self._record_event("TrainingDiverged", reason, etype="Warning")
+        if policy == "restart":
+            self.restore_ceiling = ceiling
+            result = self._force_gang_restart(
+                f"TrainingDiverged at step {verdict.first_bad_step}; "
+                f"restoring from a checkpoint <= step {ceiling} "
+                f"(the last healthy step)")
+            # new episode with the observation floor at current
+            # progress: the dying gang's stale heartbeats can't re-trip
+            # on old evidence, while a fault that RECURS past the floor
+            # raises a fresh verdict (bounded by the restart budget)
+            self._health_monitor.reset(progress)
+            if result == "restarted":
+                # counted only when a restart actually happened — a
+                # budget-exhausted verdict must not inflate the series
+                metrics.OBS_DIVERGENCE_RESTARTS.inc(job_lbl)
+            else:
+                # budget spent: the job fails, but the alive-and-
+                # poisoned gang must STILL be torn down — unlike the
+                # degraded-pod exhaustion (pods already dead), these
+                # pods would otherwise burn the reservation forever
+                self._teardown_gang("divergence budget-exhausted")
+            return result
+        if policy == "halt":
+            self.status.reason = f"training diverged: {reason}"
+            # a halted job must FREE the slice, not leave a diverged
+            # gang burning the reservation
+            self._teardown_gang("halt")
+            return "halt"
+        return None
+
+    def _teardown_gang(self, why: str) -> None:
+        """Best-effort delete of every gang replica set's compute
+        (Jobs/Pods; per-index Services stay for DNS stability)."""
+        for r in self.replicas:
+            if r.is_gang:
+                try:
+                    r.delete_compute()
+                except Exception as e:
+                    log.error("job %s: %s teardown: %s",
+                              self.fullname, why, e)
+
+    def _force_gang_restart(self, reason: str) -> str:
+        """Policy-driven whole-gang restart (the divergence path): the
+        pods are alive-but-poisoned, so there is no degraded set — but
+        the budget, spacing bookkeeping, and teardown are exactly the
+        `_maybe_gang_restart` contract. Returns ``"restarted"`` or
+        ``"exhausted"`` (budget spent → the job must fail)."""
+        from k8s_tpu.controller import metrics
+
+        if self.status.gang_restarts >= self.job.spec.max_gang_restarts:
+            self.status.reason = (
+                f"gang restart budget exhausted "
+                f"({self.job.spec.max_gang_restarts}) after {reason}")
+            return "exhausted"
+        self.status.gang_restarts += 1
+        bo = self.restart_backoff()
+        next_delay = bo.note_failure()
+        self.restart_history.append((self.clock(), next_delay))
+        metrics.GANG_RESTART_BACKOFF.set(next_delay, {"job": self.fullname})
+        self.status.append_condition("GangRestart", reason=reason)
+        log.warning(
+            "job %s: gang restart %d/%d (%s)", self.fullname,
+            self.status.gang_restarts, self.job.spec.max_gang_restarts,
+            reason)
+        self._record_event(
+            "GangRestart",
+            f"restarting all gang pods "
+            f"({self.status.gang_restarts}/"
+            f"{self.job.spec.max_gang_restarts}): {reason}",
+            etype="Warning",
+        )
+        self._teardown_gang("gang restart")
+        return "restarted"
 
     def _record_event(self, reason: str, message: str,
                       etype: str = "Normal") -> None:
@@ -668,13 +975,24 @@ class TrainingJob:
                 and (self.job.spec.observability is not None
                      or self.worker_stats_fetcher is not None)
             ):
+                action = None
                 try:
-                    self._maybe_detect_stragglers()
+                    # ONE heartbeat sweep: stragglers + HBM pressure +
+                    # the training-health monitor (observe → act)
+                    action = self._obs_tick()
                 except Exception as e:
                     # observability is best-effort — it must never take
                     # down the reconcile tick
-                    log.error("job %s: straggler detection: %s",
-                              self.fullname, e)
+                    log.error("job %s: obs tick: %s", self.fullname, e)
+                if action == "restarted":
+                    # divergence restart initiated: the gang is torn
+                    # down; next tick recreates it with the restore
+                    # ceiling env (KTPU_CKPT_RESTORE_MAX_STEP)
+                    self.update_crd_status()
+                    return
+                if action in ("halt", "exhausted"):
+                    # health verdict says stop: status.reason is set
+                    state = TpuJobState.FAILED
             self.status.replica_statuses = replica_statuses
             if state == TpuJobState.FAILED:
                 self.status.phase = TpuJobPhase.DONE

@@ -172,6 +172,9 @@ class TestBenches:
         assert tr["overhead_frac_accounted"] < 0.01, tr
         assert tr["traced_step_time_ms"] > 0 and tr["step_time_ms"] > 0
         assert tr["overhead_frac_wall"] < 0.25, tr
+        # the traced arm runs the in-step health block (ISSUE 10):
+        # the accounted < 1% bar above therefore covers it too
+        assert tr["health_block"] is True, tr
 
     def test_llama_bench_smoke_zero1_shape(self, capsys):
         """--zero1 --smoke keeps the full JSON line shape (the bench.py

@@ -524,8 +524,8 @@ class TestChaosMonkeyProfiles:
         client = KubeClient(faulty)
         m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty)
         assert self._names(m) == [
-            "api-flake", "checkpoint-save", "lease-loss", "pod-kill",
-            "slow-handler", "slow-host", "watch-drop",
+            "api-flake", "checkpoint-save", "lease-loss", "nan-grad",
+            "pod-kill", "slow-handler", "slow-host", "watch-drop",
         ]
         ckpt_mod.arm_save_faults(0)  # in case a tick armed it
         from k8s_tpu.obs import trace as obs_trace
@@ -542,7 +542,8 @@ class TestChaosMonkeyProfiles:
         assert self._names(m) == [
             "api-flake", "checkpoint-save", "ckpt-corruption",
             "ckpt-partial-commit", "ckpt-peer-loss", "lease-loss",
-            "pod-kill", "slow-handler", "slow-host", "watch-drop",
+            "nan-grad", "pod-kill", "slow-handler", "slow-host",
+            "watch-drop",
         ]
         from k8s_tpu.ckpt import local as ckpt_local
         from k8s_tpu.obs import trace as obs_trace

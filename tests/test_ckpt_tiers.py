@@ -325,6 +325,57 @@ class TestRestorePlanner:
         assert plan.step == 8
         assert_tree_equal(restored, tree8)
 
+    def test_restore_ceiling_skips_nan_steps(self, tmp_path):
+        """The 'last healthy step' rule (docs/CHECKPOINT.md): after a
+        TrainingDiverged verdict the operator injects a restore ceiling
+        and the planner must restore strictly at/below it — a local
+        step written at/after the NaN step is never the target."""
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tree6 = make_tree(mesh, scale=6.0)
+        tier.save(6, tree6)
+        tier.save(10, make_tree(mesh, scale=10.0))  # the poisoned save
+        planner = RestorePlanner(
+            tier, self.FakePersistent(None, None), max_step=7)
+        restored, plan = planner.restore(template_of(tree6))
+        assert plan.step == 6 and plan.source == SOURCE_LOCAL
+        assert_tree_equal(restored, tree6)
+
+    def test_restore_ceiling_bounds_persistent_tier(self, tmp_path):
+        """A persistent tier whose latest step is past the ceiling is
+        searched through all_steps() for an older in-bound step; a
+        manager without all_steps degrades to fresh start rather than
+        restoring the poisoned latest."""
+        mesh = small_mesh()
+        tree4 = make_tree(mesh, scale=4.0)
+
+        class FakePersistentWithSteps(self.FakePersistent):
+            def __init__(self, steps, trees):
+                self._steps = steps
+                self._trees = trees
+
+            def all_steps(self):
+                return sorted(self._steps)
+
+            def latest_step(self):
+                return max(self._steps) if self._steps else None
+
+            def restore(self, template, step=None):
+                return self._trees.get(step)
+
+        persistent = FakePersistentWithSteps(
+            [4, 12], {4: tree4, 12: make_tree(mesh, scale=12.0)})
+        planner = RestorePlanner(None, persistent, max_step=7)
+        restored, plan = planner.restore(template_of(tree4))
+        assert plan.source == SOURCE_PERSISTENT and plan.step == 4
+        assert_tree_equal(restored, tree4)
+        # no all_steps surface: the too-new latest must NOT be restored
+        planner2 = RestorePlanner(
+            None, self.FakePersistent(12, make_tree(mesh, scale=12.0)),
+            max_step=7)
+        restored2, plan2 = planner2.restore(template_of(tree4))
+        assert restored2 is None and plan2.source == SOURCE_NONE
+
 
 # ---------------------------------------------------------------------------
 # peer fetch over the REST wire
@@ -643,6 +694,32 @@ class TestMultiTierManager:
         assert policy.persistent_interval_steps == 30
         assert policy.peer_fetch is False
         assert env["KTPU_CKPT_PEER_PORT"] == "7777"
+        # the restore ceiling is operator-injected (not a spec field):
+        # the policy picks it up from the restarted gang's env
+        assert policy.max_restore_step is None
+        policy2 = CheckpointPolicy.from_env(
+            {**env, "KTPU_CKPT_RESTORE_MAX_STEP": "7"})
+        assert policy2.max_restore_step == 7
+
+    def test_plain_manager_unhealthy_gate(self, tmp_path, capsys):
+        """The never-checkpoint-a-poisoned-state gate mirrored on the
+        plain persistent manager (the multi-tier manager owns its own
+        copy): a True verdict skips the write with the skip event."""
+        from k8s_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+        state = {"w": np.arange(4.0, dtype=np.float32)}
+        assert mgr.save(1, state, unhealthy=lambda: True) is False
+        mgr.wait()
+        assert mgr.latest_step() is None
+        from k8s_tpu.obs.events import last_event
+
+        ev = last_event(capsys.readouterr().out, "ckpt_skip_unhealthy")
+        assert ev is not None and ev["step"] == 1
+        assert mgr.save(2, state, unhealthy=lambda: False)
+        mgr.wait()
+        assert mgr.latest_step() == 2
+        mgr.close()
 
     def test_explicit_checkpoint_dir_overrides_policy_env(
             self, tmp_path, monkeypatch):

@@ -11,7 +11,15 @@ subprocess gangs:
   Succeeded.
 - SIGKILL post-mortem: a worker killed with SIGKILL (uncatchable — no
   handler, no flush hook) must still leave a flight-recorder dump on
-  node-local disk containing the final steps' phase spans.
+  node-local disk containing the final steps' phase spans AND the last
+  step_health blocks (a diverging pod's losses/grad-norms survive it).
+- chaos ``nan-grad`` (ISSUE 10): one step's gradients poisoned in a
+  REAL 2-process gang with a local checkpoint tier; the reconciler's
+  health monitor must raise ``TrainingDiverged`` off the live
+  heartbeats, gang-restart with the restore ceiling at the last
+  HEALTHY step (the restore lands strictly before the NaN step), and
+  the job still trains to Succeeded with the discarded steps visible
+  in the goodput accounting.
 """
 
 import json
@@ -235,6 +243,197 @@ def test_sigkill_leaves_flight_recorder_dump(tmp_path):
             assert steps[-1]["trace_id"] == f"frjob-{rid}"
             assert "step_compute" in steps[-1]["phases_s"]
             assert steps[-1]["wall_s"] >= 0.2  # step_sleep is inside
+            # step_health blocks ride the same ring (log_every=1): a
+            # SIGKILLed diverging pod leaves its last losses and grad
+            # norms on disk for the post-mortem
+            health = [e for e in dump["entries"]
+                      if e.get("kind") == "health"]
+            assert health, dump
+            last_h = health[-1]
+            assert last_h["step"] >= seen_step - 3, (seen_step, last_h)
+            for k in ("loss", "grad_norm", "nonfinite_grads",
+                      "update_ratio"):
+                assert k in last_h, last_h
+            assert float(last_h["nonfinite_grads"]) == 0.0
+    finally:
+        tj.stop()
+        tj.join(timeout=10)
+        kubelet.stop()
+
+
+def _xfail_if_glibc_heap_bug(logs: str) -> None:
+    """Same guard every restore-then-continue e2e carries on this
+    container (see test_e2e_distributed): a RESTORED gloo worker can
+    abort inside glibc on jax 0.4.x CPU collectives — the runtime's
+    heap bug, not an operator defect."""
+    if ("malloc_consolidate" in logs
+            or "corrupted double-linked list" in logs
+            or "malloc(): invalid" in logs
+            or "double free or corruption" in logs
+            or "free(): invalid" in logs):
+        pytest.xfail("glibc heap corruption in restored gloo worker "
+                     "(jax 0.4.x CPU collectives)")
+
+
+@pytest.mark.integration
+def test_nan_divergence_restores_and_succeeds(tmp_path):
+    """The observe→act loop end to end (ISSUE 10): chaos poisons step
+    10's gradients with NaN in a REAL 2-process FSDP gang that commits
+    a local checkpoint tier every 2 steps. The reconciler's health
+    monitor — fed by the live per-host heartbeats over the same
+    Service-DNS plumbing a cluster uses — must raise TrainingDiverged
+    (+ Warning Event naming the first bad step), gang-restart with the
+    restore ceiling at the last HEALTHY observed step, and the
+    restarted gang must restore STRICTLY before the NaN step and train
+    to Succeeded, with the discarded steps visible in goodput."""
+    NAN_STEP = 10
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    resolver = LocalServiceResolver()
+    local_root = tmp_path / "node-local"
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            "KTPU_PROGRAM": "k8s_tpu.programs.llama_train:main",
+            "KTPU_PROGRAM_ARGS": (
+                "--steps=40 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp --seq_len=32 --step_sleep=0.3"
+            ),
+            # the nan-grad chaos fault, subprocess arm: poison step 10's
+            # grads (fires only on a from-scratch run, so the restarted
+            # gang replays the step clean — the transient-fault model)
+            "KTPU_CHAOS_NAN_GRAD": str(NAN_STEP),
+            # this container's escape hatch (train/checkpoint.py):
+            # orbax's background save thread is heap-unsafe next to
+            # gloo CPU collectives on jax 0.4.x — observed here as a
+            # restored gang silently training on corrupted params
+            "KTPU_SYNC_CHECKPOINT": "1",
+        },
+    )
+    kubelet = LocalKubelet(client, executor, resolver=resolver)
+    kubelet.start()
+
+    j = S.TpuJob()
+    j.metadata.name = "nanjob"
+    j.metadata.namespace = "default"
+    # headroom beyond the one divergence restart: on this container a
+    # finishing worker's teardown can race the coordination service
+    # (peer dies with a retryable 134 — the documented restored-worker
+    # pattern), and each such race costs a restart from the latest
+    # healthy checkpoint
+    j.spec.max_gang_restarts = 8
+    j.spec.replica_specs = [
+        S.TpuReplicaSpec(replica_type="WORKER", replicas=2)]
+    # local tier every 2 steps + a demoted persistent tier: the
+    # persistent manager's orbax consensus poll is ALSO what lets the
+    # diverged gang honor the teardown SIGTERM promptly on this jax
+    # line (the raw signal is owned by jax's preemption notifier on
+    # distributed runs) — the production pairing docs/OBSERVABILITY.md
+    # recommends for onDivergence: restart
+    j.spec.checkpoint_policy = S.CheckpointPolicySpec(
+        local_dir=str(local_root), local_interval_steps=2,
+        persistent_dir=str(tmp_path / "persist"),
+        persistent_interval_steps=50)
+    j.spec.observability = S.ObservabilitySpec(
+        obs_port=8790, on_divergence="restart",
+        straggler_profile_seconds=0.0)
+    jc.create(j)
+    tj = TrainingJob(client, jc, j)
+
+    def fetch():
+        rid = tj.job.spec.runtime_id
+        if not rid:
+            return None
+        out = {}
+        for i in range(2):
+            port = resolver.port_for(f"nanjob-worker-{rid}-{i}", 8790)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    payload = json.loads(r.read())
+                hb = payload.get("obs")
+                if isinstance(hb, dict):
+                    out[i] = hb
+            except Exception:
+                pass
+        return out or None
+
+    tj.worker_stats_fetcher = fetch
+    tj.start(S.ControllerConfig(), reconcile_interval=0.2)
+    try:
+        # 1. the divergence verdict must arrive while the job runs
+        deadline = time.monotonic() + 240
+        cond = None
+        while time.monotonic() < deadline:
+            cond = next((c for c in tj.status.conditions
+                         if c.type == "TrainingDiverged"), None)
+            if cond is not None:
+                break
+            if tj.finished:
+                _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+                raise AssertionError(
+                    "job finished before any divergence verdict\n"
+                    + _all_logs(tmp_path))
+            time.sleep(0.1)
+        assert cond is not None, _all_logs(tmp_path)
+        # the condition names the first bad step and the verdict
+        # stamped a restore ceiling strictly before it
+        assert f"step {NAN_STEP}" in cond.reason \
+            or "non-finite" in cond.reason, cond.reason
+        evs = [e for e in client.events.list("default")
+               if e.reason == "TrainingDiverged"]
+        assert evs, "no TrainingDiverged Event"
+        ceiling = tj.restore_ceiling
+        assert ceiling is not None and ceiling < NAN_STEP, ceiling
+        # operator-side goodput: discarded steps counted
+        from k8s_tpu.controller import metrics as M
+
+        assert M.OBS_DIVERGED_STEPS.get({"job": tj.fullname}) > 0
+        assert M.OBS_DIVERGENCE_RESTARTS.get({"job": tj.fullname}) >= 1
+
+        # 2. the job must still SUCCEED via the restore
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not tj.finished:
+            time.sleep(0.3)
+        if not (tj.finished
+                and tj.status.state == S.TpuJobState.SUCCEEDED):
+            _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+        assert tj.finished and \
+            tj.status.state == S.TpuJobState.SUCCEEDED, (
+                json.dumps(tj.status.to_dict(), indent=1),
+                _all_logs(tmp_path))
+
+        # 3. the restore landed STRICTLY before the NaN step, from the
+        # local tier, and the goodput accounting shows the discard
+        rid = tj.job.spec.runtime_id
+        logs = "\n".join(_worker_log(tmp_path, "nanjob", rid, i)
+                         for i in (0, 1))
+        from k8s_tpu.obs.events import events_of
+
+        assert events_of(logs, "chaos_nan_grad"), logs
+        restores = events_of(logs, "ckpt_restore")
+        assert restores, "no ckpt_restore event:\n" + logs
+        for r in restores:
+            assert r["step"] < NAN_STEP, r
+            assert r["source"] in ("local", "local+peer"), r
+        assert any(r["lost_steps"] > 0 for r in restores), restores
+        # step_health events bracket the divergence: a non-finite block
+        # at/after the NaN step, healthy blocks after the restore, and
+        # the final step completed
+        health = events_of(logs, "step_health")
+        assert any(h["step"] >= NAN_STEP
+                   and h["nonfinite_grads"] > 0 for h in health), health
+        assert health[-1]["nonfinite_grads"] == 0.0, health[-1]
+        assert '"step": 40' in logs
+        # the operator saw recovery and cleared the ceiling
+        assert tj.restore_ceiling is None
+        assert any(c.type == "TrainingRecovered"
+                   for c in tj.status.conditions), tj.status.to_dict()
     finally:
         tj.stop()
         tj.join(timeout=10)

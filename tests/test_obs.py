@@ -878,3 +878,794 @@ class TestStartObsServer:
             assert ev is not None
         finally:
             blocker.close()
+
+
+# ---------------------------------------------------------------------------
+# training-health monitor decision logic (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _hb(step, loss, grad_norm=1.0, nonfinite=0.0, ratio=0.01):
+    return {"step": step, "loss": loss, "grad_norm": grad_norm,
+            "nonfinite_grads": nonfinite, "update_ratio": ratio}
+
+
+class TestHealthMonitor:
+    def test_healthy_run_never_trips(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 20):
+            v = mon.observe(_hb(s, 2.0 * 0.95 ** s))
+            assert not v.diverged and v.new_warning is None
+        assert v.last_healthy_step == 19
+
+    def test_nan_one_shot_trip_and_no_reraise(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 5):
+            mon.observe(_hb(s, 1.0))
+        v = mon.observe(_hb(5, float("nan"),
+                            grad_norm=float("nan"), nonfinite=32.0))
+        assert v.new_divergence and v.diverged
+        assert v.first_bad_step == 5 and v.last_healthy_step == 4
+        assert "nan" in v.reason.lower() or "non-finite" in v.reason
+        # the episode stays active but never re-raises (no flapping)
+        v2 = mon.observe(_hb(6, float("nan"), nonfinite=32.0))
+        assert v2.diverged and not v2.new_divergence
+        assert v2.first_bad_step == 5
+
+    def test_nonfinite_grads_alone_trip(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        mon.observe(_hb(1, 1.0))
+        # finite loss, poisoned grads (the accumulated-grad case)
+        v = mon.observe(_hb(2, 0.9, nonfinite=4.0))
+        assert v.new_divergence and v.first_bad_step == 2
+
+    def test_unchanged_step_never_counts(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        mon.observe(_hb(3, 1.0))
+        v = mon.observe(_hb(3, float("nan"), nonfinite=1.0))
+        # same step re-polled (fast reconcile ticks): not a fresh
+        # observation, no verdict may be derived from it
+        assert not v.fresh and not v.new_divergence and not v.diverged
+
+    def test_restart_step_regression_resets_episode(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 8):
+            mon.observe(_hb(s, 1.0))
+        v = mon.observe(_hb(8, float("nan"), nonfinite=8.0))
+        assert v.new_divergence
+        # the gang restored to step 6 and replays: the monitor must
+        # clear the episode and judge the recovered run afresh
+        v = mon.observe(_hb(7, 1.0))
+        assert v.restarted and not v.diverged
+        v = mon.observe(_hb(8, 1.0))
+        assert not v.diverged and v.last_healthy_step == 8
+
+    def test_spike_needs_consecutive_fresh_observations(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor(spike_factor=3.0, spike_steps=2)
+        for s in range(1, 5):
+            v = mon.observe(_hb(s, 1.0))
+            assert v.new_warning is None
+        v = mon.observe(_hb(5, 10.0))
+        assert v.new_warning is None  # streak 1 of 2
+        v = mon.observe(_hb(6, 10.0))
+        assert v.new_warning == "loss_spike" and "3" in v.reason
+        # active, not re-raised
+        v = mon.observe(_hb(7, 10.0))
+        assert v.warning == "loss_spike" and v.new_warning is None
+
+    def test_spike_under_threshold_never_fires(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor(spike_factor=3.0, spike_steps=2)
+        for s in range(1, 6):
+            mon.observe(_hb(s, 1.0))
+        for s in range(6, 12):
+            v = mon.observe(_hb(s, 2.5))  # < 3x EMA
+            assert v.new_warning is None
+
+    def test_spike_clears_with_hysteresis(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor(spike_factor=3.0, spike_steps=2,
+                            clear_after=3)
+        for s in range(1, 5):
+            mon.observe(_hb(s, 1.0))
+        mon.observe(_hb(5, 10.0))
+        v = mon.observe(_hb(6, 10.0))
+        assert v.new_warning == "loss_spike"
+        # post-verdict the EMA tracks the new level; once the loss is
+        # back within band the warning clears after clear_after clean
+        # fresh observations — and only then
+        cleared = []
+        for s in range(7, 20):
+            v = mon.observe(_hb(s, 1.0))
+            if v.warning_cleared:
+                cleared.append(s)
+                break
+        assert cleared, "warning never cleared"
+        assert v.warning is None
+
+    def test_plateau_window(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor(plateau_window=5, plateau_rel=1e-3)
+        verdicts = [mon.observe(_hb(s, 1.0)) for s in range(1, 6)]
+        assert verdicts[-1].new_warning == "plateau"
+        assert all(v.new_warning is None for v in verdicts[:-1])
+
+    def test_plateau_off_by_default(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 40):
+            v = mon.observe(_hb(s, 1.0))
+            assert v.new_warning is None
+
+    def test_min_window_on_injected_clock(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        now = [0.0]
+        mon = HealthMonitor(spike_factor=3.0, spike_steps=2,
+                            min_window_s=10.0, clock=lambda: now[0])
+        for s in range(1, 5):
+            mon.observe(_hb(s, 1.0))
+        # the whole spike streak lands in one clock instant (a burst of
+        # heartbeats after a stall): the time window must gate it
+        mon.observe(_hb(5, 10.0))
+        v = mon.observe(_hb(6, 10.0))
+        assert v.new_warning is None
+        now[0] += 11.0
+        v = mon.observe(_hb(7, 10.0))
+        assert v.new_warning == "loss_spike"
+
+
+# ---------------------------------------------------------------------------
+# nan-grad chaos hooks
+# ---------------------------------------------------------------------------
+
+
+class TestNanGradChaos:
+    def setup_method(self):
+        from k8s_tpu.obs import health as H
+
+        H._NAN_ARMED["step"] = None
+
+    teardown_method = setup_method
+
+    def test_arm_and_consume_exact_step(self):
+        from k8s_tpu.obs.health import arm_nan_grad, consume_nan_grad, \
+            nan_grad_armed
+
+        arm_nan_grad(7)
+        assert nan_grad_armed() == 7
+        assert not consume_nan_grad(6)
+        assert consume_nan_grad(7)
+        # one-shot: spent after firing
+        assert nan_grad_armed() is None
+        assert not consume_nan_grad(7)
+
+    def test_arm_next_step_sentinel(self):
+        from k8s_tpu.obs.health import arm_nan_grad, consume_nan_grad
+
+        arm_nan_grad(-1)
+        assert consume_nan_grad(42)
+        assert not consume_nan_grad(43)
+
+    def test_env_arm(self):
+        from k8s_tpu.obs.health import consume_nan_grad, nan_grad_armed
+
+        env = {"KTPU_CHAOS_NAN_GRAD": "9"}
+        assert nan_grad_armed(env) == 9
+        assert not consume_nan_grad(8, env)
+        assert consume_nan_grad(9, env)
+
+    def test_chaos_matrix_level3_includes_nan_grad(self):
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.runtime.chaos import ChaosMonkey, NanGradFault
+
+        monkey = ChaosMonkey.from_level(
+            KubeClient(InMemoryCluster()), level=3, seed=1)
+        assert any(isinstance(i, NanGradFault) for i in monkey.injectors)
+        from k8s_tpu.obs.health import nan_grad_armed
+
+        NanGradFault(rate=1.0, seed=0).fire()
+        assert nan_grad_armed() == -1
+
+
+# ---------------------------------------------------------------------------
+# HBM gauges + on-demand profiling
+# ---------------------------------------------------------------------------
+
+
+class TestHbmAndProfile:
+    def test_device_memory_stats_never_raises(self):
+        from k8s_tpu.obs.health import device_memory_stats
+
+        stats = device_memory_stats()  # CPU backend: empty, not a crash
+        assert isinstance(stats, list)
+
+    def test_hbm_block_aggregates_and_exports_gauges(self):
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.obs.health import hbm_block
+
+        stats = [
+            {"device": 0, "bytes_in_use": 100, "peak_bytes_in_use": 900,
+             "bytes_limit": 1000},
+            {"device": 1, "bytes_in_use": 200, "peak_bytes_in_use": 500,
+             "bytes_limit": 1000},
+        ]
+        block = hbm_block(stats=stats, task="test")
+        assert block["bytes_in_use"] == 300
+        assert block["bytes_limit"] == 2000
+        assert block["peak_bytes_in_use"] == 900
+        assert block["peak_fraction"] == pytest.approx(0.9)
+        assert M.OBS_HBM_IN_USE.get(
+            {"device": "0", "task": "test"}) == 100.0
+        assert M.OBS_HBM_PEAK.get(
+            {"device": "1", "task": "test"}) == 500.0
+        assert M.OBS_HBM_LIMIT.get(
+            {"device": "0", "task": "test"}) == 1000.0
+
+    def test_hbm_block_empty_is_none(self):
+        from k8s_tpu.obs.health import hbm_block
+
+        assert hbm_block(stats=[]) is None
+
+    def test_capture_profile_writes_trace(self, tmp_path):
+        from k8s_tpu.obs.health import capture_profile
+
+        result = capture_profile(str(tmp_path), 0.2)
+        assert result["ok"], result
+        assert os.path.isdir(result["dir"])
+        files = [os.path.join(r, f)
+                 for r, _, fs in os.walk(result["dir"]) for f in fs]
+        assert files, "profiler wrote no trace files"
+
+    def test_capture_profile_no_dir_is_error_not_crash(self):
+        from k8s_tpu.obs.health import capture_profile
+
+        result = capture_profile("", 0.2)
+        assert not result["ok"] and "dir" in result["error"]
+
+    def test_debug_profile_route(self):
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.controller.health import HealthServer
+
+        calls = []
+
+        def profiler(seconds):
+            calls.append(seconds)
+            return {"ok": True, "dir": "/scratch/p", "seconds": seconds}
+
+        srv = HealthServer(port=0, registry=M.Registry(),
+                           host="127.0.0.1", profiler=profiler).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/profile"
+                    f"?seconds=0.5", timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["ok"] and body["dir"] == "/scratch/p"
+            assert calls == [0.5]
+        finally:
+            srv.stop()
+
+    def test_debug_profile_404_without_hook(self):
+        import urllib.error
+
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.controller.health import HealthServer
+
+        srv = HealthServer(port=0, registry=M.Registry(),
+                           host="127.0.0.1").start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/profile",
+                    timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_debug_profile_failure_is_503(self):
+        import urllib.error
+
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.controller.health import HealthServer
+
+        srv = HealthServer(
+            port=0, registry=M.Registry(), host="127.0.0.1",
+            profiler=lambda s: {"ok": False, "error": "busy"}).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/profile",
+                    timeout=5)
+            assert ei.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_obs_server_serves_hbm_and_profile(self, capsys, monkeypatch,
+                                               tmp_path):
+        from k8s_tpu.programs.common import start_obs_server
+
+        monkeypatch.setenv("KTPU_OBS_ADVERTISE", "127.0.0.1:0")
+        monkeypatch.setenv("KTPU_FLIGHT_DIR", str(tmp_path))
+
+        class Rdzv:
+            process_id = 0
+            replica_type = "worker"
+
+        srv = start_obs_server(Rdzv(), Tracer(trace_id="t-prof"))
+        assert srv is not None
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/profile"
+                    f"?seconds=0.2", timeout=10) as r:
+                body = json.loads(r.read())
+            assert body["ok"], body
+            assert body["dir"].startswith(str(tmp_path))
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-step health block (make_train_step(health=True))
+# ---------------------------------------------------------------------------
+
+
+class TestInStepHealth:
+    def _setup(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+        from k8s_tpu.train import create_sharded_state, make_train_step
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4)(x)
+
+        mesh = build_mesh(MeshConfig(data=-1))
+        rules = LogicalRules(LogicalRules.DP)
+        x = jnp.ones((8, 4))
+        state = create_sharded_state(
+            M(), optax.adamw(1e-2), mesh, rules,
+            jax.random.PRNGKey(0), x)
+
+        def loss_fn(state, params, b, rng):
+            y = state.apply_fn({"params": params}, b["x"])
+            loss = jnp.mean(jnp.square(y))
+            scale = b.get("chaos_scale")
+            return (loss if scale is None else loss * scale), {}
+
+        step = make_train_step(loss_fn, mesh, rules, health=True)
+        return jax, state, step, x
+
+    def test_health_metrics_present_and_finite(self):
+        import math
+
+        jax, state, step, x = self._setup()
+        state, m = step(state, {"x": x}, jax.random.PRNGKey(1))
+        for k in ("grad_norm", "nonfinite_grads", "update_ratio"):
+            assert k in m, k
+        assert float(m["nonfinite_grads"]) == 0.0
+        assert math.isfinite(float(m["grad_norm"])) \
+            and float(m["grad_norm"]) > 0
+        assert 0 < float(m["update_ratio"]) < 1
+
+    def test_health_off_keeps_metrics_clean(self):
+        import flax.linen as nn  # noqa: F401
+
+        jax, state, _, x = self._setup()
+        from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+        from k8s_tpu.train import make_train_step
+
+        mesh = build_mesh(MeshConfig(data=-1))
+        rules = LogicalRules(LogicalRules.DP)
+
+        def loss_fn(state, params, b, rng):
+            import jax.numpy as jnp
+
+            return jnp.mean(jnp.square(
+                state.apply_fn({"params": params}, b["x"]))), {}
+
+        step = make_train_step(loss_fn, mesh, rules)  # default off
+        _, m = step(state, {"x": x}, jax.random.PRNGKey(1))
+        assert "grad_norm" not in m
+
+    def test_nan_poison_surfaces_in_health_block(self):
+        import numpy as np
+
+        jax, state, step, x = self._setup()
+        state, m = step(
+            state, {"x": x, "chaos_scale": np.float32("nan")},
+            jax.random.PRNGKey(1))
+        assert float(m["nonfinite_grads"]) > 0
+        assert float(m["grad_norm"]) != float(m["grad_norm"])  # NaN
+
+    def test_note_health_rides_heartbeat_and_ring(self):
+        tr = Tracer(trace_id="t-health")
+        with tr.step(4) as st:
+            with st.phase("step_compute"):
+                pass
+        tr.note_health(4, {"loss": 1.5, "grad_norm": 2.0,
+                           "nonfinite_grads": 0.0, "update_ratio": 0.01})
+        hb = tr.heartbeat()
+        assert hb["health"]["step"] == 4
+        assert hb["health"]["loss"] == 1.5
+        kinds = [e["kind"] for e in tr.recorder.snapshot()]
+        assert "health" in kinds
+        # the NEXT step's heartbeat refresh must not drop the health
+        # block (it refreshes at log points only)
+        with tr.step(5) as st:
+            with st.phase("step_compute"):
+                pass
+        assert tr.heartbeat()["health"]["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# reconciler observe -> act (divergence policy, memory pressure)
+# ---------------------------------------------------------------------------
+
+
+def _health_stats(step, health, hosts=(0, 1), hbm=None):
+    out = {}
+    for h in hosts:
+        hb = {"step": step, "step_time_s": 0.2, "age_s": 0.1,
+              "phases_s": {"step_compute": 0.2}, "health": health}
+        if hbm is not None:
+            hb["hbm"] = hbm
+        out[h] = hb
+    return out
+
+
+class TestHealthReconcile:
+    def _job(self, on_divergence="restart", max_gang_restarts=3):
+        from k8s_tpu import spec as S
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "nanjob"
+        j.metadata.namespace = "default"
+        j.spec.max_gang_restarts = max_gang_restarts
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=2)
+        ]
+        j.spec.observability = S.ObservabilitySpec(
+            obs_port=8790, on_divergence=on_divergence,
+            straggler_profile_seconds=0.0)
+        jc.create(j)
+        return client, TrainingJob(client, jc, j)
+
+    def test_divergence_restart_policy(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.controller import metrics as M
+
+        client, tj = self._job("restart")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 8):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 0
+        base_diverged = M.OBS_DIVERGED_STEPS.get({"job": tj.fullname})
+        feed["stats"] = _health_stats(
+            8, _hb(8, float("nan"), grad_norm=float("nan"),
+                   nonfinite=16.0))
+        tj.reconcile(cfg)
+        # observe -> act: TrainingDiverged condition + Warning Event
+        # naming the first bad step, a gang restart, and the restore
+        # ceiling stamped at the last HEALTHY step
+        conds = {c.type: c for c in tj.status.conditions}
+        assert "TrainingDiverged" in conds
+        assert "step 8" in conds["TrainingDiverged"].reason
+        assert "GangRestart" in conds
+        assert tj.status.gang_restarts == 1
+        assert tj.restore_ceiling == 7
+        evs = [e for e in client.events.list("default")
+               if e.reason == "TrainingDiverged"]
+        assert evs and "8" in evs[0].message
+        # goodput: one step (8 - 7) discarded at verdict time
+        assert M.OBS_DIVERGED_STEPS.get(
+            {"job": tj.fullname}) == base_diverged + 1.0
+        assert M.OBS_DIVERGENCE_RESTARTS.get({"job": tj.fullname}) >= 1.0
+        # the restarted gang's worker env carries the planner ceiling
+        env = tj.replicas[0].rendezvous(0).to_env()
+        assert env["KTPU_CKPT_RESTORE_MAX_STEP"] == "7"
+        # job must NOT be terminal — the restart recovers it
+        assert not tj.finished
+
+    def test_recovery_clears_ceiling(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job("restart")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 6):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        feed["stats"] = _health_stats(
+            6, _hb(6, float("nan"), nonfinite=4.0))
+        tj.reconcile(cfg)
+        assert tj.restore_ceiling == 5
+        # restored gang replays from 4 and trains past the ceiling
+        for s in (4, 5):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        assert tj.restore_ceiling == 5  # not yet past it
+        feed["stats"] = _health_stats(6, _hb(6, 1.0))
+        tj.reconcile(cfg)
+        assert tj.restore_ceiling is None
+        assert any(c.type == "TrainingRecovered"
+                   for c in tj.status.conditions)
+        env = tj.replicas[0].rendezvous(0).to_env()
+        assert "KTPU_CKPT_RESTORE_MAX_STEP" not in env
+
+    def test_divergence_halt_policy(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job("halt")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 4):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        feed["stats"] = _health_stats(
+            4, _hb(4, float("nan"), nonfinite=2.0))
+        tj.reconcile(cfg)
+        assert tj.finished
+        assert tj.status.state == S.TpuJobState.FAILED
+        assert "diverged" in tj.status.reason
+        assert tj.status.gang_restarts == 0
+
+    def test_divergence_none_policy_observes_only(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job("none")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 4):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        feed["stats"] = _health_stats(
+            4, _hb(4, float("nan"), nonfinite=2.0))
+        tj.reconcile(cfg)
+        assert any(c.type == "TrainingDiverged"
+                   for c in tj.status.conditions)
+        assert tj.status.gang_restarts == 0
+        assert tj.restore_ceiling is None
+        assert not tj.finished
+
+    def test_restart_budget_exhausted_fails_job(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job("restart", max_gang_restarts=0)
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        tj.reconcile(cfg)
+        from k8s_tpu.controller import metrics as M
+
+        base = M.OBS_DIVERGENCE_RESTARTS.get({"job": tj.fullname})
+        feed["stats"] = _health_stats(
+            2, _hb(2, float("nan"), nonfinite=2.0))
+        tj.reconcile(cfg)
+        assert tj.finished and tj.status.state == S.TpuJobState.FAILED
+        assert "budget exhausted" in tj.status.reason
+        # the alive-but-poisoned gang must be torn down, not left
+        # burning the reservation under a Failed job
+        assert client.jobs.list("default") == []
+        # and no restart was counted for the restart that never ran
+        assert M.OBS_DIVERGENCE_RESTARTS.get({"job": tj.fullname}) == base
+
+    def test_numerics_warning_condition(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.controller import metrics as M
+
+        client, tj = self._job("restart")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 6):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        for s in (6, 7):
+            feed["stats"] = _health_stats(s, _hb(s, 25.0))
+            tj.reconcile(cfg)
+        assert any(c.type == "NumericsWarning"
+                   for c in tj.status.conditions)
+        assert M.OBS_NUMERICS_WARNINGS.get(
+            {"job": tj.fullname, "kind": "loss_spike"}) >= 1.0
+        # a warning is NOT a divergence: no restart, no ceiling
+        assert tj.status.gang_restarts == 0
+        assert tj.restore_ceiling is None
+
+    def test_memory_pressure_event_once_per_episode(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.controller import metrics as M
+
+        client, tj = self._job("none")
+        cfg = S.ControllerConfig()
+        hot = {"bytes_in_use": 900, "peak_bytes_in_use": 950,
+               "bytes_limit": 1000, "peak_fraction": 0.95}
+        feed = {"stats": _health_stats(1, _hb(1, 1.0), hbm=hot)}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        tj.reconcile(cfg)
+        evs = [e for e in client.events.list("default")
+               if e.reason == "MemoryPressure"]
+        # both hosts crossed the 0.9 default in one tick
+        assert len(evs) == 2 and "95%" in evs[0].message
+        assert M.OBS_MEMORY_PRESSURE.get(
+            {"job": tj.fullname, "host": "0"}) == 1.0
+        # continued pressure: no flapping
+        feed["stats"] = _health_stats(2, _hb(2, 1.0), hbm=hot)
+        tj.reconcile(cfg)
+        assert len([e for e in client.events.list("default")
+                    if e.reason == "MemoryPressure"]) == 2
+        # pressure drops, then returns -> a NEW episode may fire
+        cool = dict(hot, peak_fraction=0.5)
+        feed["stats"] = _health_stats(3, _hb(3, 1.0), hbm=cool)
+        tj.reconcile(cfg)
+        feed["stats"] = _health_stats(4, _hb(4, 1.0), hbm=hot)
+        tj.reconcile(cfg)
+        assert len([e for e in client.events.list("default")
+                    if e.reason == "MemoryPressure"]) == 4
+
+    def test_spec_validation(self):
+        from k8s_tpu import spec as S
+        from k8s_tpu.spec.tpu_job import ValidationError
+
+        ob = S.ObservabilitySpec(on_divergence="restart",
+                                 memory_pressure_fraction=0.8)
+        ob.validate()
+        with pytest.raises(ValidationError):
+            S.ObservabilitySpec(on_divergence="panic").validate()
+        with pytest.raises(ValidationError):
+            S.ObservabilitySpec(memory_pressure_fraction=1.5).validate()
+        with pytest.raises(ValidationError):
+            S.ObservabilitySpec(memory_pressure_fraction=0.0).validate()
+        with pytest.raises(ValidationError):
+            S.ObservabilitySpec(
+                straggler_profile_seconds=-1.0).validate()
+
+    def test_spec_roundtrip_new_fields(self):
+        from k8s_tpu import spec as S
+
+        ob = S.ObservabilitySpec(
+            obs_port=8790, on_divergence="halt",
+            memory_pressure_fraction=0.85,
+            straggler_profile_seconds=3.0)
+        d = ob.to_dict()
+        assert d["onDivergence"] == "halt"
+        assert d["memoryPressureFraction"] == 0.85
+        back = S.ObservabilitySpec.from_dict(d)
+        assert back.on_divergence == "halt"
+        assert back.memory_pressure_fraction == 0.85
+        assert back.straggler_profile_seconds == 3.0
+
+    def test_straggler_autoprofile_uses_injected_trigger(self):
+        from k8s_tpu import spec as S
+
+        client, tj = self._job("none")
+        tj.job.spec.observability.straggler_profile_seconds = 1.0
+        cfg = S.ControllerConfig()
+        captured = []
+        done = threading.Event()
+
+        def trigger(host, seconds):
+            captured.append((host, seconds))
+            done.set()
+            return {"ok": True, "dir": "/scratch/p", "seconds": seconds}
+
+        tj.profile_trigger = trigger
+        tj.job.spec.observability.straggler_steps = 2
+        step = [0]
+
+        def fetch():
+            step[0] += 1
+            return _table(step[0], {0: 0.2, 1: 0.9})
+
+        tj.worker_stats_fetcher = fetch
+        tj.reconcile(cfg)
+        tj.reconcile(cfg)  # second fresh observation -> verdict
+        assert done.wait(5), "profile trigger never fired"
+        assert captured == [(1, 1.0)]
+        cond = next(c for c in tj.status.conditions
+                    if c.type == "StragglerDetected")
+        assert "profile" in cond.reason
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            evs = [e for e in client.events.list("default")
+                   if e.reason == "StragglerProfile"]
+            if evs:
+                break
+            time.sleep(0.05)
+        assert evs and "/scratch/p" in evs[0].message
+
+
+class TestHealthMonitorReset:
+    def test_reset_floor_ignores_stale_then_retrips_on_recurrence(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 10):
+            mon.observe(_hb(s, 1.0))
+        v = mon.observe(_hb(10, float("nan"), nonfinite=8.0))
+        assert v.new_divergence
+        # the caller acted (restart); floor = progress at verdict time
+        mon.reset(10)
+        # the dying gang's stale heartbeat must NOT re-trip
+        v = mon.observe(_hb(10, float("nan"), nonfinite=8.0))
+        assert not v.fresh and not v.new_divergence
+        # a RECURRING fault past the floor raises a NEW verdict —
+        # ceiling still the best-known healthy step
+        v = mon.observe(_hb(11, float("nan"), nonfinite=8.0))
+        assert v.new_divergence and v.last_healthy_step == 9
+
+    def test_reset_then_healthy_replay_recovers(self):
+        from k8s_tpu.obs.health import HealthMonitor
+
+        mon = HealthMonitor()
+        for s in range(1, 10):
+            mon.observe(_hb(s, 1.0))
+        assert mon.observe(_hb(10, float("nan"),
+                               nonfinite=8.0)).new_divergence
+        mon.reset(10)
+        v = mon.observe(_hb(11, 0.9))
+        assert v.fresh and not v.diverged and v.last_healthy_step == 11
+
+
+class TestHealthReconcileRecurrence:
+    def test_recurring_divergence_restarts_again_not_never(self):
+        from k8s_tpu import spec as S
+
+        helper = TestHealthReconcile()
+        client, tj = helper._job("restart")
+        cfg = S.ControllerConfig()
+        feed = {"stats": _health_stats(1, _hb(1, 1.0))}
+        tj.worker_stats_fetcher = lambda: feed["stats"]
+        for s in range(1, 4):
+            feed["stats"] = _health_stats(s, _hb(s, 1.0))
+            tj.reconcile(cfg)
+        feed["stats"] = _health_stats(
+            4, _hb(4, float("nan"), nonfinite=2.0))
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        # stale heartbeat from the torn-down gang: no double restart
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 1
+        # the restored gang replays PAST the old progress still NaN
+        # (persistent fault): a fresh verdict must restart again —
+        # bounded by the budget, never silently ignored
+        feed["stats"] = _health_stats(
+            5, _hb(5, float("nan"), nonfinite=2.0))
+        tj.reconcile(cfg)
+        assert tj.status.gang_restarts == 2
+        assert sum(1 for c in tj.status.conditions
+                   if c.type == "TrainingDiverged") == 2

@@ -514,6 +514,15 @@ class TestServingFrontend:
                 health = json.loads(r.read())
             assert health["ok"] and health["served"] == 4, health
             assert health["stats"]["prefills"] == 4
+            # bytes-accounted prefix cache rides the stats block (0
+            # here: no prefix caching configured) and /metrics serves
+            # the engine-side ktpu_serving_* series per replica
+            assert health["stats"]["prefix_cache_bytes"] == 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/metrics",
+                    timeout=10) as r:
+                exposition = r.read().decode()
+            assert "ktpu_serving_prefix_cache_bytes" in exposition
 
             # malformed request is the caller's 400, not a server crash
             code, body = self._post(fe.port, {"prompt": "nope"})

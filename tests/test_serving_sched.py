@@ -396,6 +396,10 @@ class TestSharedPrefixReuse:
         assert cstats["prefix_hits"] == 2
         assert cstats["prefix_tokens_saved"] == 16
         assert cstats["prefill_tokens"] < bstats["prefill_tokens"]
+        # bytes accounting (ISSUE 10 satellite): one captured snapshot
+        # holds real device bytes; the uncached run holds none
+        assert cstats["prefix_cache_bytes"] > 0
+        assert bstats["prefix_cache_bytes"] == 0
 
     def test_lru_eviction_bounds_device_memory(self, fixture):
         model, _, params = fixture
@@ -411,6 +415,13 @@ class TestSharedPrefixReuse:
         assert len(eng._prefix_cache) == 2
         assert eng.stats["prefix_captures"] == 3
         assert eng.stats["prefix_hits"] == 3  # one per prefix revisit
+        # eviction releases its bytes: the gauge tracks EXACTLY the
+        # two retained snapshots (uniform stage => uniform size)
+        assert len(eng._prefix_bytes) == 2
+        per_snap = set(eng._prefix_bytes.values())
+        assert len(per_snap) == 1 and min(per_snap) > 0
+        assert eng.stats["prefix_cache_bytes"] == sum(
+            eng._prefix_bytes.values())
         eng.close()
 
     def test_short_prompt_and_legacy_path_bypass_cache(self, fixture):
