@@ -153,6 +153,22 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_obs.xml"],
             args.artifacts_dir, cases,
         )
+        # cluster-scheduler gate (ISSUE 11): the slice-inventory
+        # ledger, the decision core's full table (quota, priority,
+        # gang atomicity, checkpoint-cost victim selection, no-flap),
+        # the spec.scheduling round trip, the controller's
+        # queue→admit→preempt→resume flow, and the 100-job scale
+        # matrices with zero oversubscription. Always on and fast: a
+        # placement regression (a double-owned slice, a preemption
+        # that loses a checkpoint) fails in seconds, mirroring the
+        # obs/ckpt-tiers stages.
+        ok = ok and stage(
+            "sched",
+            [py, "-m", "pytest", "tests/test_sched.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_sched.xml"],
+            args.artifacts_dir, cases,
+        )
         # metrics-lint: every ktpu_* series registered in code must be
         # cataloged in docs/OBSERVABILITY.md and vice versa — doc drift
         # on the metrics inventory fails CI, not a reader at 3am
@@ -203,6 +219,7 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_router.py",
                       "--ignore=tests/test_ckpt_tiers.py",
                       "--ignore=tests/test_obs.py",
+                      "--ignore=tests/test_sched.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

@@ -100,6 +100,10 @@ class GoodputStats:
     restore_sources: Dict[str, int] = field(default_factory=dict)
     lost_steps_total: int = 0
     lost_steps_last: int = -1  # -1: no restore yet / progress unknown
+    # newest step ANY tier committed this process generation (-1 = no
+    # save yet): rides the obs heartbeat so the cluster scheduler can
+    # price a preemption as progress-past-last-save (docs/SCHEDULER.md)
+    last_saved_step: int = -1
     peer_shards_fetched: int = 0
     local_saves: int = 0
     local_save_failures: int = 0
@@ -123,6 +127,7 @@ class GoodputStats:
             "restore_sources": dict(self.restore_sources),
             "lost_steps_total": self.lost_steps_total,
             "lost_steps_last": self.lost_steps_last,
+            "last_saved_step": self.last_saved_step,
             "lost_steps_per_restart": round(self.lost_steps_per_restart(), 3),
             "peer_shards_fetched": self.peer_shards_fetched,
             "local_saves": self.local_saves,
@@ -251,6 +256,9 @@ class MultiTierCheckpointManager:
                 if self.persistent.save(step, state, force=force):
                     self.stats.persistent_saves += 1
                     wrote = True
+            if wrote:
+                self.stats.last_saved_step = max(
+                    self.stats.last_saved_step, step)
         finally:
             self.stats.save_seconds_total += time.monotonic() - t0
             self._update_gauges()
@@ -276,6 +284,14 @@ class MultiTierCheckpointManager:
         tree, plan = self.planner.restore(state_template)
         self.last_restore_plan = plan
         if plan.source != SOURCE_NONE:
+            if plan.step is not None:
+                # the restored step IS a committed checkpoint: seed the
+                # save marker so a freshly-restarted job isn't priced
+                # as if all its (replayed) progress were unsaved —
+                # that would invert the scheduler's cheapest-victim
+                # rule against exactly the jobs that just restored
+                self.stats.last_saved_step = max(
+                    self.stats.last_saved_step, int(plan.step))
             self.stats.restores += 1
             self.stats.restore_sources[plan.source] = (
                 self.stats.restore_sources.get(plan.source, 0) + 1

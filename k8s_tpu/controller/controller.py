@@ -22,6 +22,13 @@ from k8s_tpu.api.crd_client import TpuJobClient
 from k8s_tpu import utils
 from k8s_tpu.controller.watchdog import PanicTimer
 from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
+from k8s_tpu.sched import (
+    ClusterScheduler,
+    JobRequest,
+    Preemption,
+    SliceInventory,
+    footprint_of,
+)
 from k8s_tpu.spec import ControllerConfig, TpuJob, TpuJobPhase
 from k8s_tpu.trainer.training import TrainingJob
 
@@ -47,6 +54,7 @@ class Controller:
         namespace: Optional[str] = None,
         reconcile_interval: float = 8.0,
         watchdog_deadline: float = WATCHDOG_DEADLINE,
+        sched_interval: float = 1.0,
     ):
         self.client = client
         self.job_client = job_client
@@ -59,6 +67,32 @@ class Controller:
         self._thread: Optional[threading.Thread] = None
         self._owns_informer = False
         self._informer_sampler = None
+        # Cluster scheduler (docs/SCHEDULER.md): ON iff the controller
+        # config declares an accelerator fleet. With it on, jobs enter
+        # QUEUED and a reconciler only spawns on admission; with it off
+        # (the default empty fleet) every path below is byte-for-byte
+        # today's start-immediately behavior.
+        self.sched_interval = sched_interval
+        self.scheduler: Optional[ClusterScheduler] = None
+        if self.config.fleet:
+            self.scheduler = ClusterScheduler(
+                SliceInventory(self.config.fleet),
+                quotas=self.config.scheduler_quotas,
+                cost_fn=self._preemption_cost,
+                preemption_cooldown=self.config.scheduler_cooldown_seconds,
+            )
+        self._sched_lock = threading.RLock()
+        self._sched_thread: Optional[threading.Thread] = None
+        # O(100) hygiene: one shared semaphore bounds concurrent
+        # reconcile ticks across every TrainingJob thread (0 = off)
+        n = self.config.max_concurrent_reconciles
+        self._reconcile_limiter = (
+            threading.BoundedSemaphore(n) if n and n > 0 else None)
+        # test/e2e seam: build a per-job worker-stats fetcher (the
+        # heartbeat source preemption pricing reads) for reconcilers
+        # the CONTROLLER spawns — outside a cluster there is no
+        # Service DNS for the default HTTP fetcher to resolve
+        self.worker_stats_fetcher_factory = None
 
     # ------------------------------------------------------------ bootstrap
 
@@ -107,7 +141,9 @@ class Controller:
         except errors.AlreadyExistsError:
             pass
         utils.retry(0.5, 120, self.job_client.crd_established)
-        return self.find_all_jobs()
+        rv = self.find_all_jobs()
+        self._ensure_sched_loop()
+        return rv
 
     def find_all_jobs(self) -> int:
         """Adopt pre-existing TpuJobs (reference findAllTfJobs,
@@ -125,9 +161,60 @@ class Controller:
     # ------------------------------------------------------------ dispatch
 
     def _start_job(self, job: TpuJob) -> None:
+        """Entry for a newly-seen job (watch ADDED / startup adoption).
+        Without a scheduler this spawns the reconciler immediately
+        (reference behavior). With one (``config.fleet`` non-empty) the
+        scheduler is consulted first: NONE-phase jobs park in QUEUED
+        until admitted; already-materialized jobs (operator restart)
+        are adopted straight into the ledger — a restart must never
+        re-queue a gang that is physically running."""
+        if self.scheduler is None:
+            self._spawn_reconciler(job)
+            return
+        phase = job.status.phase
+        if phase == TpuJobPhase.QUEUED:
+            # re-adopted queued job (operator restart): back in line,
+            # original status already says Queued
+            self.scheduler.submit(self._request_for(job))
+        elif phase == TpuJobPhase.NONE:
+            self._submit_queued(job)
+        elif phase in (TpuJobPhase.CREATING, TpuJobPhase.RUNNING,
+                       TpuJobPhase.CLEANUP):
+            self.scheduler.adopt_running(self._request_for(job))
+            self._spawn_reconciler(job)
+        else:  # terminal phases: reconciler handles bookkeeping, no charge
+            self._spawn_reconciler(job)
+        self._sched_tick()
+
+    def _spawn_reconciler(self, job: TpuJob) -> bool:
         from k8s_tpu.controller import metrics
 
+        old = self.jobs.get(job.key)
+        if old is not None:
+            # re-admission after preemption: the previous reconciler
+            # exited when it parked the job in QUEUED — quiesce it
+            # before the fresh one takes the key (two reconcilers on
+            # one job would race every status write)
+            old.stop()
+            old.join(timeout=10)
+            if old.is_alive():
+                # still tearing down (e.g. deletes stuck behind an
+                # apiserver brown-out): spawning now would put two
+                # reconcilers on one job — refuse; the caller re-queues
+                log.error("job %s: previous reconciler still alive "
+                          "after stop; deferring respawn", job.key)
+                return False
         tj = TrainingJob(self.client, self.job_client, job)
+        tj.reconcile_limiter = self._reconcile_limiter
+        if self.scheduler is not None:
+            tj.on_terminal = self._on_job_terminal
+        if self.worker_stats_fetcher_factory is not None:
+            try:
+                tj.worker_stats_fetcher = \
+                    self.worker_stats_fetcher_factory(tj)
+            except Exception as e:
+                log.warning("job %s: stats fetcher factory: %s",
+                            job.key, e)
         self.jobs[job.key] = tj
         tj.start(self.config, self.reconcile_interval)
         metrics.JOBS_STARTED.inc()
@@ -138,6 +225,228 @@ class Controller:
             "Started",
             f"reconciler started for {job.key}",
         )
+        return True
+
+    # ------------------------------------------------------------ scheduler
+
+    def _request_for(self, job: TpuJob) -> JobRequest:
+        s = job.spec.scheduling
+        priority = 0
+        queue = "default"
+        preemptible = True
+        if s is not None:
+            try:
+                priority = int(s.priority)
+            except (TypeError, ValueError):
+                priority = 0  # validation rejects it properly at setup
+            queue = s.queue or "default"
+            preemptible = bool(s.preemptible)
+        return JobRequest(
+            key=job.key, footprint=footprint_of(job.spec),
+            priority=priority, queue=queue, preemptible=preemptible,
+        )
+
+    def _preemption_cost(self, key: str) -> int:
+        """The scheduler's eviction pricing: steps the victim has run
+        past its last checkpoint, read from the reconciler's freshest
+        heartbeat sweep (PR 9's goodput block). Unknown ⇒ 0."""
+        tj = self.jobs.get(key)
+        return tj.preemption_cost() if tj is not None else 0
+
+    def _submit_queued(self, job: TpuJob) -> None:
+        """First sighting of a fresh job under the scheduler: park it
+        in QUEUED (no resources exist yet — ``_start_job`` only spawns
+        a reconciler on admission) and persist the gate so users see
+        WHY nothing is running."""
+        req = self._request_for(job)
+        if (req.key in self.scheduler.pending_keys()
+                or self.scheduler.is_running(req.key)):
+            return  # watch replay — already in line
+        # persist the gate BEFORE enqueueing: the background sched loop
+        # may admit the instant submit() returns, and the admitted
+        # reconciler's runtime_id+CREATING write must never be
+        # overwritten by a stale pre-admission Queued snapshot (the
+        # status write is last-write-wins, not CAS)
+        job.status.phase = TpuJobPhase.QUEUED
+        job.status.append_condition(
+            "Queued",
+            reason=f"queue '{req.queue}' priority {req.priority}: "
+                   f"awaiting {req.footprint}")
+        try:
+            job = self.job_client.update(job)
+        except Exception as e:
+            # the gate is still effective (no reconciler spawns); only
+            # the user-visible phase write is retried by the next event
+            log.warning("job %s: queued status write: %s", job.key, e)
+        self.scheduler.submit(req)
+        self.client.record_event(
+            job.metadata.namespace,
+            {"kind": "TpuJob", "name": job.metadata.name},
+            "Queued",
+            f"queued by the cluster scheduler (queue '{req.queue}', "
+            f"priority {req.priority}, {req.footprint})",
+        )
+
+    def _ensure_sched_loop(self) -> None:
+        if self.scheduler is None or self._sched_thread is not None:
+            return
+        self._sched_thread = threading.Thread(
+            target=self._sched_loop, daemon=True, name="cluster-sched")
+        self._sched_thread.start()
+
+    def _sched_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(self.sched_interval):
+                return
+            try:
+                self._sched_tick()
+            except Exception as e:  # a tick bug must not kill the loop
+                log.error("scheduler tick failed: %s", e)
+
+    def _sched_tick(self) -> None:
+        """One scheduling round: let the pure core decide (briefly
+        under the lock), then act OUTSIDE it — preempt flushes,
+        reconciler spawns, and gauge export all do I/O or joins, and
+        holding the lock through them would convoy the watch pump,
+        force_preempt, and every reconciler's terminal callback behind
+        one apiserver brown-out. Acting lock-free is safe: each
+        decision in ``result`` belongs to exactly this caller (tick()
+        already moved the jobs, so a concurrent tick cannot re-decide
+        them)."""
+        sched = self.scheduler
+        if sched is None:
+            return
+        with self._sched_lock:
+            result = sched.tick()
+        for p in result.preempted:
+            self._apply_preemption(p)
+        for req in result.admitted:
+            self._admit_job(req)
+        self._export_sched_metrics()
+
+    def _admit_job(self, req: JobRequest) -> None:
+        from k8s_tpu.controller import metrics
+
+        ns, name = req.key.split("/", 1)
+        try:
+            job = self.job_client.get(ns, name)
+        except Exception as e:
+            log.warning("admitted job %s unreadable (%s); released",
+                        req.key, e)
+            self.scheduler.remove(req.key)
+            return
+        if job.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED):
+            # raced a terminal transition (or a preempt raced the
+            # finish): never charge the fleet for a finished job
+            self.scheduler.remove(req.key)
+            return
+        fresh = self._request_for(job)
+        if fresh.footprint != req.footprint:
+            # the spec changed between the decision and this fetch (a
+            # queued-edit racing the tick): the charge no longer
+            # matches what the reconciler would materialize — release
+            # and re-queue under the real footprint; the next tick
+            # re-decides against the honest ledger
+            log.warning("job %s: footprint changed at admission "
+                        "(%s -> %s); re-queued", req.key,
+                        req.footprint, fresh.footprint)
+            fresh.seq = req.seq  # keep its place in line
+            self.scheduler.reinstate(fresh)
+            return
+        metrics.SCHED_ADMITTED.inc({"queue": req.queue})
+        job.status.append_condition(
+            "Admitted",
+            reason=f"admitted by the cluster scheduler "
+                   f"({req.footprint} charged to queue '{req.queue}')")
+        self.client.record_event(
+            ns, {"kind": "TpuJob", "name": name},
+            "Admitted",
+            f"admitted (queue '{req.queue}', priority {req.priority}, "
+            f"{req.footprint})",
+        )
+        if not self._spawn_reconciler(job):
+            # the previous reconciler is still winding down: give the
+            # slices back and re-queue AT ITS ORIGINAL position; a
+            # later tick retries cleanly
+            self.scheduler.reinstate(req)
+
+    def _apply_preemption(self, p: Preemption) -> None:
+        """Act on an eviction verdict: goodput + Events naming BOTH
+        parties, then drive the victim's reconciler through the
+        checkpoint-safe preempt flush (condition, SIGTERM-flush
+        teardown, park in QUEUED)."""
+        from k8s_tpu.controller import metrics
+
+        metrics.SCHED_PREEMPTED.inc({"queue": p.queue})
+        if p.cost > 0:
+            metrics.SCHED_PREEMPT_LOST_STEPS.inc(
+                {"job": p.victim}, by=float(p.cost))
+        vns, vname = p.victim.split("/", 1)
+        pns, pname = p.preemptor.split("/", 1)
+        self.client.record_event(
+            pns, {"kind": "TpuJob", "name": pname},
+            "Preempting",
+            f"preempting lower-priority {p.victim} "
+            f"(~{p.cost} steps since its last checkpoint at stake)",
+        )
+        tj = self.jobs.get(p.victim)
+        if tj is None:
+            # adopted-queued edge: no reconciler exists; the scheduler
+            # already re-queued it, the ledger is consistent
+            log.warning("preemption victim %s has no reconciler",
+                        p.victim)
+            return
+        tj.preempt(
+            f"preempted by higher-priority job {p.preemptor} "
+            f"(~{p.cost} steps since the last checkpoint discarded at "
+            f"worst; the preempt flush preserves them when healthy)")
+
+    def force_preempt(self, key: str, reason: str = "") -> bool:
+        """Evict one running job through the full preemption path
+        without a competing preemptor — the ``sched-preempt`` chaos
+        fault's surface (and an operator escape hatch). Returns False
+        when the job is not running under the scheduler."""
+        from k8s_tpu.controller import metrics
+
+        sched = self.scheduler
+        if sched is None:
+            return False
+        tj = self.jobs.get(key)
+        cost = tj.preemption_cost() if tj is not None else 0
+        if not sched.requeue(key):  # atomic: running → queued+cooldown
+            return False
+        metrics.SCHED_PREEMPTED.inc({"queue": "chaos"})
+        if cost > 0:
+            metrics.SCHED_PREEMPT_LOST_STEPS.inc(
+                {"job": key}, by=float(cost))
+        if tj is not None:
+            tj.preempt(reason or "forced preemption")
+        self._export_sched_metrics()
+        return True
+
+    def _on_job_terminal(self, tj: TrainingJob) -> None:
+        """Reconciler callback at the terminal transition: free the
+        slices and immediately re-run the decision core so the next
+        queued job starts this tick, not next interval."""
+        if self.scheduler is None:
+            return
+        self.scheduler.remove(tj.job.key)
+        self._sched_tick()
+
+    def _export_sched_metrics(self) -> None:
+        from k8s_tpu.controller import metrics
+
+        stats = self.scheduler.stats()
+        metrics.SCHED_QUEUE_DEPTH.clear()
+        for q, d in stats["queue_depth"].items():
+            metrics.SCHED_QUEUE_DEPTH.set(float(d), {"queue": q})
+        metrics.SCHED_QUOTA_USED.clear()
+        for q, chips in stats["quota_used_chips"].items():
+            metrics.SCHED_QUOTA_USED.set(float(chips), {"queue": q})
+        metrics.SCHED_SLICES_FREE.clear()
+        for accel, pool in stats["pools"].items():
+            metrics.SCHED_SLICES_FREE.set(
+                float(pool["free"]), {"accelerator": accel})
 
     def handle_event(self, ev_type: str, job: TpuJob) -> None:
         """Reference handleTfJobEvent (controller.go:123-170)."""
@@ -153,16 +462,42 @@ class Controller:
                 return
             self._start_job(job)
         elif ev_type == "DELETED":
+            was_scheduled = False
+            if self.scheduler is not None:
+                # frees the slices (or drops the queue entry) whether a
+                # reconciler exists or not — a QUEUED job has none
+                was_scheduled = self.scheduler.remove(key)
             tj = self.jobs.pop(key, None)
             metrics.LIVE_JOBS.set(len(self.jobs))
             if tj is None:
-                log.warning("unsafe state: %s deleted but not tracked", key)
+                if not was_scheduled:
+                    log.warning("unsafe state: %s deleted but not tracked",
+                                key)
+                self._sched_tick()
                 return
-            tj.delete()
+            if tj.is_alive():
+                tj.delete()
+            else:
+                # a preempted/queued job's reconciler has exited — its
+                # event queue drains nowhere, so the teardown of what
+                # survives the queue (per-index Services, TensorBoard,
+                # launcher ConfigMap) must run inline or it leaks
+                try:
+                    tj.delete_resources()
+                except Exception as e:
+                    log.error("job %s: queued-job delete: %s", key, e)
+            self._sched_tick()
         elif ev_type == "MODIFIED":
             tj = self.jobs.get(key)
-            if tj is not None:
+            if tj is not None and tj.is_alive():
                 tj.update(job)
+            elif self.scheduler is not None:
+                # spec edited while QUEUED (no reconciler polices
+                # immutability yet): the ledger must charge what the
+                # reconciler will materialize on admission, or the
+                # stale footprint breaks zero-oversubscription
+                if self.scheduler.update_pending(self._request_for(job)):
+                    self._sched_tick()
 
     # ------------------------------------------------------------ run loop
 
@@ -232,6 +567,9 @@ class Controller:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=5)
+            self._sched_thread = None
         # stop reconcilers only after the pump thread is down: run() /
         # find_all_jobs may still be adding jobs concurrently, and a job
         # added after an early stop loop would leak its thread. Join so

@@ -284,6 +284,38 @@ OBS_HBM_LIMIT = REGISTRY.gauge(
     "ktpu_obs_hbm_bytes_limit",
     "Device HBM capacity visible to the allocator, by device",
 )
+# Cluster scheduler (k8s_tpu/sched, docs/SCHEDULER.md): the resource
+# market's own telemetry — queue pressure, admission/preemption flow,
+# quota burn, and the goodput priced into eviction decisions.
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "ktpu_sched_queue_depth",
+    "Jobs waiting for admission (incl. re-queued preemption victims), "
+    "by queue",
+)
+SCHED_ADMITTED = REGISTRY.counter(
+    "ktpu_sched_admitted_total",
+    "Jobs admitted by the cluster scheduler, by queue",
+)
+SCHED_PREEMPTED = REGISTRY.counter(
+    "ktpu_sched_preempted_total",
+    "Running jobs preempted for a higher-priority job, by the victim's "
+    "queue",
+)
+SCHED_QUOTA_USED = REGISTRY.gauge(
+    "ktpu_sched_quota_used_chips",
+    "Chips currently admitted against each queue's quota, by queue",
+)
+SCHED_SLICES_FREE = REGISTRY.gauge(
+    "ktpu_sched_slices_free",
+    "Unassigned slices in the fleet inventory, by accelerator",
+)
+SCHED_PREEMPT_LOST_STEPS = REGISTRY.counter(
+    "ktpu_sched_preempt_lost_steps_total",
+    "Steps at stake at each preemption decision (victim progress past "
+    "its last checkpoint — the cost the scheduler priced; the preempt "
+    "flush usually reduces the realized loss, visible in "
+    "ktpu_ckpt_lost_steps_total), by victim job",
+)
 # Serving: device bytes held by the shared-prefix KV snapshot LRU
 # (docs/SERVING.md "Fleet") — the count-bounded cache finally gets
 # bytes accounting so fleet capacity planning has real numbers.

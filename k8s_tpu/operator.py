@@ -141,6 +141,10 @@ def main(argv=None) -> int:
                 client, args.chaos_level, seed=args.chaos_seed,
                 interval=args.chaos_interval, faulty=faulty,
                 lease_namespace=namespace,
+                # forced preemptions (sched-preempt) only make sense
+                # when this controller runs the cluster scheduler
+                scheduler=(controller if controller.scheduler is not None
+                           else None),
             ).start()
         controller.start()
         while not stop.is_set() and not lost.is_set():

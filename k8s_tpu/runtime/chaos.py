@@ -45,6 +45,13 @@ nan-grad            one train step's gradients poisoned with NaN (armed
                     health monitor raises TrainingDiverged and the
                     onDivergence policy restores from the last
                     HEALTHY checkpoint (never the NaN step)
+sched-preempt       one running admitted job forced through the cluster
+                    scheduler's full preemption path (as if a higher-
+                    priority job had arrived): checkpoint-safe preempt
+                    flush → teardown → re-queue with cooldown →
+                    re-admission when capacity returns — the victim
+                    loses steps, never its checkpoint
+                    (docs/SCHEDULER.md)
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -551,6 +558,43 @@ class NanGradFault(FaultInjector):
         return "next-step"
 
 
+class SchedPreemptFault(FaultInjector):
+    """Force one running admitted job through the cluster scheduler's
+    FULL preemption path (``sched-preempt``): the victim's reconciler
+    drives the checkpoint-safe preempt flush (SIGTERM → forced
+    two-tier save, health-gated) and tears the gang down, the job
+    re-queues with its cooldown, and re-admission resumes it from the
+    flushed step — exactly what a higher-priority arrival does, minus
+    the arrival. ``controller`` is any object with the
+    :meth:`k8s_tpu.controller.controller.Controller.force_preempt`
+    surface and a ``scheduler`` attribute; without a scheduler (no
+    fleet configured) the fault is a no-op."""
+
+    name = "sched-preempt"
+
+    def __init__(self, controller, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.controller = controller
+
+    def fire(self) -> Optional[str]:
+        sched = getattr(self.controller, "scheduler", None)
+        if sched is None:
+            return None
+        keys = sched.running_keys(preemptible_only=True)
+        if not keys:
+            return None
+        victim = self.rng.choice(keys)
+        if not self.controller.force_preempt(
+                victim,
+                reason="chaos sched-preempt (simulated higher-priority "
+                       "arrival)"):
+            return None
+        self.injected += 1
+        log.info("chaos[%s]: preempted %s", self.name, victim)
+        return victim
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -640,6 +684,7 @@ class ChaosMonkey:
         lease_namespace: str = "default",
         ckpt_root: Optional[str] = None,
         fleet=None,
+        scheduler=None,
     ) -> "ChaosMonkey":
         """``--chaos-level`` profiles. Levels are cumulative:
 
@@ -655,7 +700,9 @@ class ChaosMonkey:
           local-tier loss (the k8s_tpu/ckpt recovery matrix); when
           ``fleet`` names a serving fleet (the LocalFleet fault
           surface) — replica crashes and stats flakes (the router
-          recovery matrix)
+          recovery matrix); when ``scheduler`` names a scheduler-
+          running Controller — forced preemptions through the
+          checkpoint-safe flush-requeue-resume path (sched-preempt)
         """
         rng = random.Random(seed)
 
@@ -688,6 +735,9 @@ class ChaosMonkey:
                     RouterReplicaLossFault(fleet, rate=0.15, seed=s()),
                     RouterStatsFlakeFault(fleet, rate=0.3, seed=s()),
                 ]
+            if scheduler is not None:
+                inj.append(
+                    SchedPreemptFault(scheduler, rate=0.15, seed=s()))
         return cls(client, level=level, interval=interval, seed=s(),
                    injectors=inj)
 

@@ -1,0 +1,22 @@
+"""Cluster-scale scheduler (docs/SCHEDULER.md).
+
+The layer the Controller consults before any reconciler materializes
+resources: a slice inventory model derived from the controller-config
+accelerator fleet (:mod:`k8s_tpu.sched.inventory`) and a pure,
+clock-injected decision core (:mod:`k8s_tpu.sched.scheduler`)
+implementing per-queue quota admission, priority ordering, gang
+bin-packing onto slices, and checkpoint-cost-aware preemption.
+"""
+
+from k8s_tpu.sched.inventory import (  # noqa: F401
+    Footprint,
+    OversubscriptionError,
+    SliceInventory,
+    footprint_of,
+)
+from k8s_tpu.sched.scheduler import (  # noqa: F401
+    ClusterScheduler,
+    JobRequest,
+    Preemption,
+    TickResult,
+)

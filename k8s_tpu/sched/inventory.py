@@ -1,0 +1,196 @@
+"""Slice inventory: the cluster's TPU capacity model.
+
+The reference design doc targets O(100) concurrent jobs per cluster
+(PAPER.md, tf_job_design_doc.md:24-26) but placed every job's pods
+independently — two jobs could both believe they owned the last free
+slice. This module gives the operator ONE ledger of truth:
+
+- capacity comes from the controller-config ``fleet:`` block
+  (accelerator type → number of slices of that shape the cluster owns);
+- every admitted job is charged its **gang footprint**, derived from
+  ``spec.tpu`` through the existing :mod:`k8s_tpu.spec.topology`
+  lookup. A training gang charges ``numSlices`` WHOLE slices
+  atomically (a slice is all-or-nothing — there is no partial gang);
+  a serving fleet charges one single-host slice per replica over its
+  full autoscale range (``maxReplicas``), so a scale-up can never
+  discover mid-flight that the chips it was promised are gone.
+
+The inventory enforces the zero-oversubscription invariant at the
+charge site — :class:`OversubscriptionError` is a scheduler bug, not a
+recoverable condition — and keeps a high-water mark per accelerator so
+tests can assert the invariant held across a whole run, not just at
+the end.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from k8s_tpu.spec import topology as topo
+
+
+class OversubscriptionError(RuntimeError):
+    """A charge would exceed fleet capacity (scheduler invariant bug)."""
+
+
+@dataclass(frozen=True)
+class Footprint:
+    """What one admitted job costs the fleet.
+
+    ``slices`` whole slices of ``accelerator`` are charged atomically;
+    ``chips`` (= slices × chips/slice) is the quota currency — queues
+    meter chips so one v5p-512 counts 64× a v5e-8 wherever quotas mix
+    shapes. ``per_replica`` marks serving fleets (each replica is an
+    independent single-host slice, charged over the autoscale range)."""
+
+    accelerator: str = ""
+    slices: int = 0
+    chips: int = 0
+    per_replica: bool = False
+
+    @property
+    def empty(self) -> bool:
+        """Zero-footprint jobs (no ``tpu:`` block — CPU smoke jobs,
+        control-plane-only workloads) bypass the inventory entirely."""
+        return self.slices <= 0 or not self.accelerator
+
+    def __str__(self) -> str:
+        if self.empty:
+            return "no accelerator footprint"
+        kind = "replica-slice" if self.per_replica else "slice"
+        return (f"{self.slices} × {self.accelerator} {kind}"
+                f"{'s' if self.slices != 1 else ''} ({self.chips} chips)")
+
+
+def footprint_of(spec) -> Footprint:
+    """Derive a job spec's gang footprint via the ``spec.topology``
+    lookup. Unknown accelerators yield an EMPTY footprint on purpose:
+    the spec will fail validation in the reconciler with the readable
+    error, instead of queueing forever behind capacity that cannot
+    exist."""
+    tpu = getattr(spec, "tpu", None)
+    if tpu is None or not tpu.accelerator:
+        return Footprint()
+    t = topo.lookup(tpu.accelerator)
+    if t is None:
+        return Footprint()
+    serving = getattr(spec, "serving", None)
+    if serving is not None:
+        # per-replica economics over the WHOLE autoscale range: the
+        # slices an SLO scale-up may claim are reserved at admission
+        n = max(serving.replicas, serving.bounds()[1])
+        return Footprint(tpu.accelerator, slices=n, chips=n * t.chips,
+                         per_replica=True)
+    n = max(1, tpu.num_slices)
+    return Footprint(tpu.accelerator, slices=n, chips=n * t.chips)
+
+
+class SliceInventory:
+    """The fleet ledger: capacity per accelerator type, charges per job.
+
+    Thread-safe (the scheduler mutates it under its own lock, but
+    metrics exporters and tests read it from other threads)."""
+
+    def __init__(self, fleet: Dict[str, int]):
+        self._capacity: Dict[str, int] = {
+            a: int(n) for a, n in (fleet or {}).items() if int(n) > 0
+        }
+        self._used: Dict[str, int] = {a: 0 for a in self._capacity}
+        self._holders: Dict[str, Footprint] = {}
+        self._lock = threading.RLock()
+        # per-accelerator high-water mark: lets a scale test assert the
+        # zero-oversubscription invariant held over the WHOLE run
+        self.max_used: Dict[str, int] = {a: 0 for a in self._capacity}
+
+    # ------------------------------------------------------------- reads
+
+    def knows(self, accelerator: str) -> bool:
+        with self._lock:
+            return accelerator in self._capacity
+
+    def capacity(self, accelerator: str) -> int:
+        with self._lock:
+            return self._capacity.get(accelerator, 0)
+
+    def used(self, accelerator: str) -> int:
+        with self._lock:
+            return self._used.get(accelerator, 0)
+
+    def available(self, accelerator: str) -> int:
+        with self._lock:
+            return (self._capacity.get(accelerator, 0)
+                    - self._used.get(accelerator, 0))
+
+    def fits(self, fp: Footprint) -> bool:
+        if fp.empty:
+            return True
+        with self._lock:
+            return (fp.accelerator in self._capacity
+                    and self.available(fp.accelerator) >= fp.slices)
+
+    def holder(self, key: str) -> Optional[Footprint]:
+        with self._lock:
+            return self._holders.get(key)
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-pool view for metrics/tests. ``free`` is clamped at 0:
+        a pool driven over capacity by adoption or a config shrink has
+        zero UNASSIGNED slices, not a negative number — the
+        ktpu_sched_slices_free gauge must stay sane; ``available()``
+        (the decision input) stays unclamped so admission still sees
+        the deficit."""
+        with self._lock:
+            return {
+                a: {"capacity": c, "used": self._used.get(a, 0),
+                    "free": max(0, c - self._used.get(a, 0))}
+                for a, c in self._capacity.items()
+            }
+
+    # ------------------------------------------------------------- writes
+
+    def charge(self, key: str, fp: Footprint, force: bool = False) -> None:
+        """Charge ``key``'s whole footprint atomically. ``force`` is the
+        adoption path ONLY (an operator restart re-adopting a gang that
+        is already physically running must never kill it over a ledger
+        it cannot have corrupted) — everywhere else an over-capacity
+        charge raises, because admitting past capacity is exactly the
+        two-jobs-own-one-slice bug this subsystem exists to end."""
+        if fp.empty:
+            return
+        with self._lock:
+            if key in self._holders:
+                raise ValueError(f"{key} is already charged")
+            if not force and not self.fits(fp):
+                raise OversubscriptionError(
+                    f"charging {key} ({fp}) would oversubscribe "
+                    f"{fp.accelerator}: used {self.used(fp.accelerator)}"
+                    f"/{self.capacity(fp.accelerator)} slices")
+            self._used[fp.accelerator] = (
+                self._used.get(fp.accelerator, 0) + fp.slices)
+            self._capacity.setdefault(fp.accelerator, 0)
+            self._holders[key] = fp
+            self.max_used[fp.accelerator] = max(
+                self.max_used.get(fp.accelerator, 0),
+                self._used[fp.accelerator])
+
+    def release(self, key: str) -> Optional[Footprint]:
+        with self._lock:
+            fp = self._holders.pop(key, None)
+            if fp is not None:
+                self._used[fp.accelerator] = max(
+                    0, self._used.get(fp.accelerator, 0) - fp.slices)
+            return fp
+
+    def set_capacity(self, accelerator: str, slices: int) -> None:
+        """Resize one pool (node-pool scale events). Shrinking below
+        current usage never retro-preempts — running gangs keep their
+        slices and the pool simply admits nothing until it drains back
+        under the new capacity (the no-flap rule: inventory flaps must
+        not translate into admission/preemption churn)."""
+        with self._lock:
+            if slices <= 0:
+                self._capacity.pop(accelerator, None)
+            else:
+                self._capacity[accelerator] = int(slices)

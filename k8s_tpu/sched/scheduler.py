@@ -1,0 +1,364 @@
+"""Cluster scheduler decision core (docs/SCHEDULER.md).
+
+Pure decision logic in the StragglerDetector/SloAutoscaler idiom: an
+injected clock, no I/O, no threads — the Controller feeds it job
+requests and drives :meth:`ClusterScheduler.tick`; every verdict is
+returned as data for the operator to act on (spawn a reconciler, drive
+a preempt flush, export gauges). That is what makes the whole decision
+table unit-testable on a fake clock, including the O(100)-job scale
+matrix.
+
+Decision rules, in order, per tick (full table in docs/SCHEDULER.md):
+
+1. pending jobs are scanned by (priority desc, submit order) —
+   priority orders admission, FIFO breaks ties;
+2. a re-queued preemption victim in its cooldown window is skipped
+   (no-flap: a victim must not be re-admitted into the churn that just
+   evicted it);
+3. per-queue quota is metered in CHIPS: a queue at quota blocks only
+   its own jobs, never the other queues;
+4. a job whose whole gang footprint fits is admitted — slices charge
+   atomically, a partial gang is never placed;
+5. a job that does not fit may PREEMPT: victims must be preemptible,
+   strictly lower priority, on the same accelerator; they are chosen
+   by (priority asc, checkpoint cost asc) — cost = steps at risk since
+   the victim's last healthy checkpoint, read from the goodput
+   telemetry — and only taken if the freed slices actually fit the
+   preemptor (never preempt uselessly);
+6. a capacity-blocked job RESERVES its accelerator for the rest of the
+   scan: nothing behind it in the order may backfill onto that pool
+   (starvation protection for big gangs — head-of-line reservation).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from k8s_tpu.sched.inventory import Footprint, SliceInventory
+
+log = logging.getLogger(__name__)
+
+DEFAULT_QUEUE = "default"
+# Re-admission hold-off after a preemption: long enough for the
+# victim's preempt flush + teardown to land before its next placement
+# is even considered (no-flap), short enough that a freed slice is
+# never idle for long. Overridable per scheduler (tests run it at 0).
+DEFAULT_PREEMPTION_COOLDOWN = 5.0
+
+
+@dataclass
+class JobRequest:
+    """One job as the scheduler sees it (derived from spec.scheduling
+    + the footprint lookup; the scheduler never reads a CRD)."""
+
+    key: str
+    footprint: Footprint = field(default_factory=Footprint)
+    priority: int = 0
+    queue: str = DEFAULT_QUEUE
+    preemptible: bool = True
+    seq: int = 0  # submit order, assigned by the scheduler
+
+    def sort_key(self):
+        return (-self.priority, self.seq)
+
+
+@dataclass(frozen=True)
+class Preemption:
+    """One eviction verdict: ``victim`` loses its slices to
+    ``preemptor``; ``cost`` is the victim's priced checkpoint cost
+    (steps at risk since its last save) at decision time."""
+
+    victim: str
+    preemptor: str
+    queue: str  # the VICTIM's queue
+    cost: int = 0
+
+
+@dataclass
+class TickResult:
+    admitted: List[JobRequest] = field(default_factory=list)
+    preempted: List[Preemption] = field(default_factory=list)
+    # key → human-readable reason the job stayed queued this tick
+    blocked: Dict[str, str] = field(default_factory=dict)
+
+
+class ClusterScheduler:
+    """Quota + priority + bin-packing + preemption over one inventory.
+
+    ``quotas`` meters chips per queue (absent queue = unlimited).
+    ``cost_fn(key) -> int`` prices a running job's eviction (steps at
+    risk since its last healthy checkpoint — the operator wires it to
+    the goodput telemetry; defaults to 0 = cheapest)."""
+
+    def __init__(
+        self,
+        inventory: SliceInventory,
+        quotas: Optional[Dict[str, int]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        cost_fn: Optional[Callable[[str], int]] = None,
+        preemption_cooldown: float = DEFAULT_PREEMPTION_COOLDOWN,
+    ):
+        self.inventory = inventory
+        self.quotas = dict(quotas or {})
+        self.clock = clock
+        self.cost_fn = cost_fn
+        self.preemption_cooldown = preemption_cooldown
+        self._pending: Dict[str, JobRequest] = {}
+        self._running: Dict[str, JobRequest] = {}
+        self._holdoff: Dict[str, float] = {}
+        self._seq = 0
+        import threading
+
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, req: JobRequest) -> bool:
+        """Enqueue a job (idempotent: a key already pending or running
+        is left untouched — watch replays must not re-order the queue).
+        Returns True when the request was newly enqueued."""
+        with self._lock:
+            if req.key in self._pending or req.key in self._running:
+                return False
+            self._seq += 1
+            req.seq = self._seq
+            self._pending[req.key] = req
+            return True
+
+    def update_pending(self, req: JobRequest) -> bool:
+        """Replace a PENDING job's terms in place (spec edited while
+        queued — no reconciler exists to police immutability yet, and
+        the ledger must charge what the reconciler will actually
+        materialize on admission). Queue position (seq) and any
+        cooldown are preserved. Running jobs are left alone: their
+        charge reflects placed reality."""
+        with self._lock:
+            cur = self._pending.get(req.key)
+            if cur is None:
+                return False
+            req.seq = cur.seq
+            self._pending[req.key] = req
+            return True
+
+    def adopt_running(self, req: JobRequest) -> None:
+        """Adoption path (operator restart): the gang is already
+        physically running, so it is charged FORCE — the ledger must
+        reflect reality even if a config shrink made reality exceed
+        capacity (logged; the pool admits nothing until it drains)."""
+        with self._lock:
+            if req.key in self._running:
+                return
+            self._pending.pop(req.key, None)
+            self._seq += 1
+            req.seq = self._seq
+            if not self.inventory.fits(req.footprint):
+                log.warning(
+                    "adopting %s (%s) over capacity — fleet shrank "
+                    "under a running gang; pool blocked until it drains",
+                    req.key, req.footprint)
+            self.inventory.charge(req.key, req.footprint, force=True)
+            self._running[req.key] = req
+
+    def remove(self, key: str) -> bool:
+        """The job is gone (terminal or deleted): drop it from wherever
+        it is and free its slices."""
+        with self._lock:
+            self._holdoff.pop(key, None)
+            if self._pending.pop(key, None) is not None:
+                return True
+            if self._running.pop(key, None) is not None:
+                self.inventory.release(key)
+                return True
+            return False
+
+    def reinstate(self, req: JobRequest) -> None:
+        """Return a just-admitted job to the queue WITHOUT losing its
+        submit order — the operator could not act on the admission
+        (previous reconciler still winding down, or the footprint
+        changed under the decision). Slices are released; ``req.seq``
+        is preserved so the job keeps its head-of-line position (the
+        no-flap contract ``requeue`` honors for preemption victims);
+        no cooldown — nothing was torn down."""
+        with self._lock:
+            if self._running.pop(req.key, None) is not None:
+                self.inventory.release(req.key)
+            if req.seq <= 0:
+                self._seq += 1
+                req.seq = self._seq
+            self._pending[req.key] = req
+
+    def requeue(self, key: str, cooldown: Optional[float] = None) -> bool:
+        """Move a RUNNING job back to the queue (the preemption /
+        chaos-eviction path): slices freed, original submit order kept
+        (a victim re-enters ahead of later arrivals at its priority),
+        re-admission held off for the cooldown window."""
+        with self._lock:
+            req = self._running.pop(key, None)
+            if req is None:
+                return False
+            self.inventory.release(key)
+            self._pending[key] = req
+            cd = self.preemption_cooldown if cooldown is None else cooldown
+            self._holdoff[key] = self.clock() + cd
+            return True
+
+    # ------------------------------------------------------------- reads
+
+    def running_keys(self, preemptible_only: bool = False) -> List[str]:
+        with self._lock:
+            return sorted(
+                k for k, r in self._running.items()
+                if (not preemptible_only
+                    or (r.preemptible and not r.footprint.empty)))
+
+    def pending_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pending)
+
+    def is_running(self, key: str) -> bool:
+        with self._lock:
+            return key in self._running
+
+    def running_request(self, key: str) -> Optional[JobRequest]:
+        with self._lock:
+            return self._running.get(key)
+
+    def queue_used_chips(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for r in self._running.values():
+                out[r.queue] = out.get(r.queue, 0) + r.footprint.chips
+            return out
+
+    def stats(self) -> Dict[str, Dict]:
+        """The gauge feed (ktpu_sched_*): queue depths, quota usage,
+        free slices per pool."""
+        with self._lock:
+            depth: Dict[str, int] = {}
+            for r in self._pending.values():
+                depth[r.queue] = depth.get(r.queue, 0) + 1
+            return {
+                "queue_depth": depth,
+                "quota_used_chips": self.queue_used_chips(),
+                "pools": self.inventory.snapshot(),
+                "running": len(self._running),
+                "pending": len(self._pending),
+            }
+
+    # ------------------------------------------------------------- decide
+
+    def tick(self) -> TickResult:
+        """One scheduling round over the pending queue. Deterministic:
+        same submissions + same clock ⇒ same decisions, in the same
+        order (the O(100) scale test replays a whole run twice and
+        compares decision logs)."""
+        with self._lock:
+            now = self.clock()
+            result = TickResult()
+            reserved: Dict[str, str] = {}  # accelerator → blocked job key
+            quota_used = self.queue_used_chips()
+            for req in sorted(self._pending.values(),
+                              key=JobRequest.sort_key):
+                fp = req.footprint
+                hold = self._holdoff.get(req.key, 0.0)
+                if now < hold:
+                    result.blocked[req.key] = (
+                        f"preemption cooldown ({hold - now:.1f}s left)")
+                    continue
+                if fp.empty:
+                    self._admit(req, result, quota_used)
+                    continue
+                quota = self.quotas.get(req.queue)
+                used = quota_used.get(req.queue, 0)
+                if quota is not None and used + fp.chips > quota:
+                    result.blocked[req.key] = (
+                        f"queue '{req.queue}' quota: {used}+{fp.chips} "
+                        f"> {quota} chips")
+                    continue
+                if not self.inventory.knows(fp.accelerator):
+                    result.blocked[req.key] = (
+                        f"fleet has no '{fp.accelerator}' pool")
+                    continue
+                if fp.accelerator in reserved:
+                    result.blocked[req.key] = (
+                        f"held behind higher-priority "
+                        f"{reserved[fp.accelerator]} waiting on "
+                        f"{fp.accelerator}")
+                    continue
+                if self.inventory.fits(fp):
+                    self._admit(req, result, quota_used)
+                    continue
+                victims = self._select_victims(req)
+                if victims is None:
+                    result.blocked[req.key] = (
+                        f"capacity: {fp} > "
+                        f"{self.inventory.available(fp.accelerator)} "
+                        f"free {fp.accelerator} slices")
+                    # head-of-line reservation: nothing behind this job
+                    # may backfill onto the pool it is waiting for
+                    reserved[fp.accelerator] = req.key
+                    continue
+                for victim, cost in victims:
+                    self._running.pop(victim.key, None)
+                    self.inventory.release(victim.key)
+                    self._pending[victim.key] = victim
+                    self._holdoff[victim.key] = (
+                        now + self.preemption_cooldown)
+                    quota_used[victim.queue] = max(
+                        0, quota_used.get(victim.queue, 0)
+                        - victim.footprint.chips)
+                    result.preempted.append(Preemption(
+                        victim=victim.key, preemptor=req.key,
+                        queue=victim.queue, cost=cost))
+                self._admit(req, result, quota_used)
+            return result
+
+    def _admit(self, req: JobRequest, result: TickResult,
+               quota_used: Dict[str, int]) -> None:
+        self._pending.pop(req.key, None)
+        self._holdoff.pop(req.key, None)
+        self.inventory.charge(req.key, req.footprint)  # raises on bug
+        self._running[req.key] = req
+        quota_used[req.queue] = (
+            quota_used.get(req.queue, 0) + req.footprint.chips)
+        result.admitted.append(req)
+
+    def _select_victims(self, req: JobRequest):
+        """Pick the cheapest sufficient victim set for ``req``:
+        candidates are preemptible, STRICTLY lower priority, on the
+        same pool; ordered by (priority asc, checkpoint cost asc,
+        newest first) so the least important work with the least
+        un-checkpointed progress is evicted first. Returns
+        ``[(victim, cost), ...]`` or None when even evicting every
+        candidate would not fit the gang — in which case nobody is
+        evicted at all (an eviction that cannot place the preemptor is
+        pure loss)."""
+        fp = req.footprint
+        cands = []
+        for r in self._running.values():
+            if (not r.preemptible or r.footprint.empty
+                    or r.footprint.accelerator != fp.accelerator
+                    or r.priority >= req.priority):
+                continue
+            cost = 0
+            if self.cost_fn is not None:
+                try:
+                    cost = max(0, int(self.cost_fn(r.key)))
+                except Exception:  # pricing must never break placement
+                    cost = 0
+            cands.append((r, cost))
+        cands.sort(key=lambda rc: (rc[0].priority, rc[1], -rc[0].seq))
+        freed = 0
+        chosen = []
+        available = self.inventory.available(fp.accelerator)
+        for r, cost in cands:
+            if available + freed >= fp.slices:
+                break
+            chosen.append((r, cost))
+            freed += r.footprint.slices
+        if available + freed < fp.slices:
+            return None
+        return chosen

@@ -29,6 +29,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     ReplicaState,
     ReplicaStatus,
     RestartBackoffSpec,
+    SchedulingSpec,
     ServingSpec,
     TensorBoardSpec,
     TerminationPolicySpec,

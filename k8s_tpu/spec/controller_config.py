@@ -52,6 +52,22 @@ class ControllerConfig(K8sObject):
     use_native_supervisor: bool = False
     supervisor_path: str = "/opt/ktpu/native/build/ktpu_supervisor"
     health_port: int = 8080
+    # Cluster scheduler (docs/SCHEDULER.md): the accelerator fleet this
+    # operator owns, accelerator type → number of slices of that shape.
+    # NON-EMPTY turns the scheduler ON: jobs enter a Queued phase and a
+    # reconciler only spawns on admission. Empty (default) preserves
+    # per-job placement exactly as before.
+    fleet: Dict[str, int] = field(default_factory=dict)
+    # Per-queue admission quota in CHIPS (spec.scheduling.queue →
+    # chips); a queue missing from the map is unlimited.
+    scheduler_quotas: Dict[str, int] = field(default_factory=dict)
+    # Re-admission hold-off after a preemption (no-flap window for the
+    # victim's flush + teardown to land).
+    scheduler_cooldown_seconds: float = 5.0
+    # O(100) reconciler hygiene: bound CONCURRENT reconcile ticks
+    # across all TrainingJob threads with a shared worker-pool
+    # semaphore. 0 (default) = unbounded, today's behavior at small N.
+    max_concurrent_reconciles: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -69,4 +85,13 @@ class ControllerConfig(K8sObject):
             use_native_supervisor=raw.get("useNativeSupervisor", False),
             supervisor_path=raw.get("supervisorPath", cls.supervisor_path),
             health_port=raw.get("healthPort", cls.health_port),
+            fleet={str(k): int(v)
+                   for k, v in (raw.get("fleet") or {}).items()},
+            scheduler_quotas={
+                str(k): int(v)
+                for k, v in (raw.get("schedulerQuotas") or {}).items()},
+            scheduler_cooldown_seconds=float(
+                raw.get("schedulerCooldownSeconds", 5.0)),
+            max_concurrent_reconciles=int(
+                raw.get("maxConcurrentReconciles", 0)),
         )

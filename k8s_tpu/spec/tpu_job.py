@@ -299,6 +299,60 @@ class TrainingSpec(K8sObject):
 
 @register_type
 @dataclass
+class SchedulingSpec(K8sObject):
+    """Cluster-scheduler block (docs/SCHEDULER.md): how this job bids
+    in the resource market the operator runs when the controller config
+    declares a ``fleet:``.
+
+    ``priority``: higher admits first; a strictly-higher-priority job
+    that cannot fit may preempt lower-priority preemptible jobs (the
+    victim is driven through the checkpoint-safe preempt flush and
+    re-queued — it loses steps, never its checkpoint).
+    ``queue``: the quota bucket this job's chips are metered against
+    (controller-config ``schedulerQuotas``); DNS-label-shaped.
+    ``preemptible: false`` exempts the job from victim selection — it
+    can still be queued behind capacity, it just never loses a slice
+    it holds.
+
+    The block round-trips through the operator env like
+    ``checkpointPolicy`` (``KTPU_SCHED_*``), so a program can see the
+    terms it runs under (e.g. preemptible jobs checkpointing more
+    aggressively)."""
+
+    priority: int = 0
+    queue: str = "default"
+    preemptible: bool = True
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not isinstance(self.priority, int) or isinstance(
+                self.priority, bool):
+            raise ValidationError("scheduling: priority must be an integer")
+        if abs(self.priority) > 1_000_000:
+            raise ValidationError(
+                "scheduling: priority must be within ±1000000")
+        import re
+
+        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?",
+                            self.queue or ""):
+            raise ValidationError(
+                f"scheduling: queue {self.queue!r} must be a DNS label "
+                "(lowercase alphanumerics and '-')")
+        if not isinstance(self.preemptible, bool):
+            raise ValidationError(
+                "scheduling: preemptible must be a boolean")
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher/program contract, mirroring checkpointPolicy."""
+        return {
+            "KTPU_SCHED_QUEUE": self.queue,
+            "KTPU_SCHED_PRIORITY": str(self.priority),
+            "KTPU_SCHED_PREEMPTIBLE": "1" if self.preemptible else "0",
+        }
+
+
+@register_type
+@dataclass
 class ServingSpec(K8sObject):
     """Serving-fleet block (docs/SERVING.md "Fleet"): the operator
     materializes ``replicas`` INDEPENDENT engine pods (each its own
@@ -504,6 +558,10 @@ class TpuJobSpec(K8sObject):
     # endpoint, flight recorder, straggler detection. None → trace id
     # stamping only.
     observability: Optional[ObservabilitySpec] = None
+    # Cluster-scheduler terms (docs/SCHEDULER.md): priority / quota
+    # queue / preemptibility. None → priority 0 in the default queue,
+    # preemptible (the market's most modest bid).
+    scheduling: Optional[SchedulingSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -561,6 +619,8 @@ class TpuJobSpec(K8sObject):
             self.checkpoint_policy.validate()
         if self.training is not None:
             self.training.validate()
+        if self.scheduling is not None:
+            self.scheduling.validate()
         if self.observability is not None:
             self.observability.validate()
             if self.serving is not None:
@@ -663,6 +723,8 @@ class TpuJobSpec(K8sObject):
             )
         if self.restart_backoff is None:
             self.restart_backoff = RestartBackoffSpec()
+        if self.scheduling is not None and not self.scheduling.queue:
+            self.scheduling.queue = "default"
 
     # -- accelerator config (reference ConfigureAccelerators, tf_job.go:179-233)
 
@@ -796,6 +858,11 @@ def _default_launcher_template(image: str) -> PodTemplateSpec:
 
 class TpuJobPhase:
     NONE = ""
+    # Gated by the cluster scheduler (docs/SCHEDULER.md): the job is
+    # accepted but holds no resources — no reconciler runs until the
+    # scheduler admits it. Also the phase a preemption victim returns
+    # to after its checkpoint flush + teardown.
+    QUEUED = "Queued"
     CREATING = "Creating"
     RUNNING = "Running"
     CLEANUP = "CleanUp"

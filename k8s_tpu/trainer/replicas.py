@@ -117,6 +117,11 @@ class RendezvousSpec:
     # KTPU_FLIGHT_*, and KTPU_OBS_ADVERTISE (per-index Service DNS the
     # host's obs endpoint binds/advertises, same plumbing as serving)
     obs_env: Optional[Dict[str, str]] = None
+    # scheduler terms (spec.scheduling, docs/SCHEDULER.md):
+    # KTPU_SCHED_QUEUE/_PRIORITY/_PREEMPTIBLE — the same spec→env→
+    # program round trip as checkpointPolicy, so a program can see the
+    # terms it runs under
+    sched_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -145,6 +150,8 @@ class RendezvousSpec:
             env.update(self.serving_env)
         if self.obs_env:
             env.update(self.obs_env)
+        if self.sched_env:
+            env.update(self.sched_env)
         return env
 
 
@@ -461,6 +468,7 @@ class TpuReplicaSet:
                 if job.job.spec.training is not None else None
             ),
             obs_env=self._obs_env(index),
+            sched_env=self._sched_env(),
         )
 
     def _serving_rendezvous(self, index: int) -> RendezvousSpec:
@@ -514,7 +522,14 @@ class TpuReplicaSet:
             cluster=self.job.cluster_spec(),
             serving_env=env,
             obs_env=self._obs_env(index),
+            sched_env=self._sched_env(),
         )
+
+    def _sched_env(self) -> Optional[Dict[str, str]]:
+        """spec.scheduling → KTPU_SCHED_* (docs/SCHEDULER.md), the same
+        spec→env→program round trip as checkpointPolicy."""
+        sched = self.job.job.spec.scheduling
+        return sched.to_env() if sched is not None else None
 
     def _obs_env(self, index: int) -> Dict[str, str]:
         """The observability contract (docs/OBSERVABILITY.md): EVERY

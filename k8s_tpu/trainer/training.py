@@ -48,6 +48,7 @@ REJECTION_REPORT_INTERVAL = 300.0
 
 _EVENT_DELETE = "delete"
 _EVENT_MODIFY = "modify"
+_EVENT_PREEMPT = "preempt"
 
 
 def is_retryable_termination_state(s: ContainerStateTerminated) -> bool:
@@ -122,6 +123,24 @@ class TrainingJob:
         # (clock_time, delay_armed_for_the_NEXT_restart) per restart —
         # what the soak asserts spacing from
         self.restart_history: List[Tuple[float, float]] = []
+        # Cluster-scheduler hooks (docs/SCHEDULER.md): the controller
+        # sets on_terminal so a finishing job frees its slices the tick
+        # it finishes; reconcile_limiter is the shared worker-pool
+        # semaphore bounding concurrent reconcile ticks at O(100) jobs
+        # (None = unbounded, today's behavior); _last_worker_stats is
+        # the freshest heartbeat sweep, kept so preemption_cost() can
+        # price this job's eviction without a new fetch.
+        self.on_terminal: Optional[Callable[["TrainingJob"], None]] = None
+        self.reconcile_limiter = None
+        self._preempt_reason: Optional[str] = None
+        self._last_worker_stats: Optional[Dict[int, dict]] = None
+        # rv of the snapshot this reconciler was built from: watch
+        # MODIFIED events at or below it carry no new information and
+        # must not be diffed as user edits (see _handle_modify)
+        try:
+            self._spawn_rv = int(job.metadata.resource_version or 0)
+        except (TypeError, ValueError):
+            self._spawn_rv = 0
 
     # ------------------------------------------------------------ identity
 
@@ -156,8 +175,13 @@ class TrainingJob:
     # ------------------------------------------------------------ setup
 
     def setup(self, config: ControllerConfig) -> None:
-        """Reference setup() (training.go:245-301)."""
-        if self.status.phase != TpuJobPhase.NONE:
+        """Reference setup() (training.go:245-301). ``QUEUED`` runs the
+        same first-time path as ``NONE``: it is how a scheduler-admitted
+        job (fresh, or a re-admitted preemption victim) materializes —
+        a persisted ``runtime_id`` survives, so the victim's per-index
+        Services (and therefore its peers' checkpoint/obs DNS) are
+        stable across the preempt → re-admit cycle."""
+        if self.status.phase not in (TpuJobPhase.NONE, TpuJobPhase.QUEUED):
             # Adopted mid-flight (operator restart / HA failover,
             # reference findAllTfJobs controller.go:172-201): the CRD
             # already carries phase + runtime_id, but THIS process has
@@ -502,6 +526,13 @@ class TrainingJob:
                     payload = _json.loads(r.read())
                 hb = payload.get("obs")
                 if isinstance(hb, dict):
+                    # the ckpt goodput block is a SIBLING of the
+                    # heartbeat in the healthz payload — graft it on so
+                    # the scheduler's preemption pricing (progress past
+                    # ckpt.last_saved_step) sees it (docs/SCHEDULER.md)
+                    ck = payload.get("ckpt")
+                    if isinstance(ck, dict) and "ckpt" not in hb:
+                        hb = {**hb, "ckpt": ck}
                     out[i] = hb
             except Exception:
                 pass
@@ -532,6 +563,9 @@ class TrainingJob:
         stats = fetch()
         if not stats:
             return None
+        # freshest sweep kept for the cluster scheduler's preemption
+        # pricing (preemption_cost reads step + ckpt.last_saved_step)
+        self._last_worker_stats = stats
         try:
             self._maybe_detect_stragglers(stats)
         except Exception as e:
@@ -906,7 +940,7 @@ class TrainingJob:
 
         metrics.RECONCILES.inc()
         was_terminal = self.status.phase in (TpuJobPhase.DONE, TpuJobPhase.FAILED)
-        if self.status.phase == TpuJobPhase.NONE:
+        if self.status.phase in (TpuJobPhase.NONE, TpuJobPhase.QUEUED):
             self.setup(config)
             # Persist runtime_id + CREATING *before* any resource exists,
             # so a crash during create_resources() can't orphan resources
@@ -1018,6 +1052,15 @@ class TrainingJob:
                 f"job reached {self.status.state}",
                 etype="Normal" if self.status.state == TpuJobState.SUCCEEDED else "Warning",
             )
+            if self.on_terminal is not None:
+                # frees the slices in the cluster scheduler the same
+                # tick the job finishes (best-effort: a callback bug
+                # must not wedge the terminal transition)
+                try:
+                    self.on_terminal(self)
+                except Exception as e:
+                    log.error("job %s: on_terminal callback: %s",
+                              self.fullname, e)
 
         self.update_crd_status()
 
@@ -1039,6 +1082,69 @@ class TrainingJob:
     def update(self, new_job: TpuJob) -> None:
         self.send(_EVENT_MODIFY, new_job)
 
+    def preempt(self, reason: str = "") -> None:
+        """Cluster-scheduler eviction (docs/SCHEDULER.md): queues the
+        preempt event; the run loop drives the checkpoint-safe
+        teardown and parks the job back in QUEUED."""
+        self._preempt_reason = reason
+        self.send(_EVENT_PREEMPT)
+
+    def preemption_cost(self) -> int:
+        """Price this job's eviction for the scheduler: gang progress
+        past the last checkpointed step, read from the freshest
+        heartbeat sweep (the ``ckpt`` goodput block riding along). No
+        checkpointing observed ⇒ every completed step is at stake; no
+        heartbeat at all ⇒ 0 (unknown progress is priced cheap — the
+        job is young or unobservable, either way the eviction discards
+        little we can *prove*)."""
+        stats = self._last_worker_stats or {}
+        best, saved = -1, -1
+        for hb in stats.values():
+            if not isinstance(hb, dict):
+                continue
+            try:
+                best = max(best, int(hb.get("step", 0) or 0))
+            except (TypeError, ValueError):
+                pass
+            ck = hb.get("ckpt")
+            if isinstance(ck, dict):
+                try:
+                    saved = max(saved, int(ck.get("last_saved_step", -1)))
+                except (TypeError, ValueError):
+                    pass
+        if best < 0:
+            return 0
+        if saved < 0:
+            return best
+        return max(0, best - saved)
+
+    def _handle_preempt(self) -> None:
+        """The victim side of a preemption: condition + Warning Event
+        naming the preemptor, then the checkpoint-safe teardown —
+        deleting the gang's Jobs/Pods SIGTERMs every process, and the
+        launcher's preemption handler + ``maybe_preempt_exit`` flush a
+        forced two-tier save (gated by the health check, so a NaN step
+        is never flushed) inside the grace period. Per-index Services
+        stay, so the re-admitted gang keeps its DNS. The job parks in
+        QUEUED with its checkpoint on disk: it loses steps, never its
+        checkpoint."""
+        if self.finished:
+            return  # raced a terminal transition; nothing to evict
+        reason = (self._preempt_reason
+                  or "preempted by the cluster scheduler")
+        self.status.append_condition("Preempted", reason=reason)
+        log.warning("job %s: preempted: %s", self.fullname, reason)
+        self._record_event("Preempted", reason, etype="Warning")
+        for r in self.replicas:
+            try:
+                r.delete_compute()
+            except Exception as e:
+                log.error("job %s: preemption teardown: %s",
+                          self.fullname, e)
+        self.status.phase = TpuJobPhase.QUEUED
+        self.status.state = TpuJobState.RUNNING
+        self.update_crd_status()
+
     # ------------------------------------------------------------ run loop
 
     def start(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
@@ -1055,6 +1161,12 @@ class TrainingJob:
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        """True while the reconciler thread runs. False for a
+        preempted/queued job whose loop has exited — its events would
+        go nowhere, so callers must act inline instead."""
+        return self._thread is not None and self._thread.is_alive()
 
     def run(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
         """Reference run loop (training.go:412-456): select over
@@ -1080,12 +1192,27 @@ class TrainingJob:
                 except Exception as e:
                     log.error("job %s: deleteResources error: %s", self.fullname, e)
                 return
+            if typ == _EVENT_PREEMPT:
+                # checkpoint-safe eviction: flush-teardown, park in
+                # QUEUED, and EXIT the reconciler — the controller
+                # spawns a fresh one on re-admission
+                self._handle_preempt()
+                return
             if typ == _EVENT_MODIFY and _new is not None:
                 self._handle_modify(_new)
 
     def _safe_reconcile(self, config: ControllerConfig) -> None:
+        sem = self.reconcile_limiter
         try:
-            self.reconcile(config)
+            if sem is not None:
+                # O(100) hygiene: concurrent reconcile ticks share a
+                # bounded worker pool — each job keeps its thread (and
+                # its event queue stays responsive), but only N ticks
+                # touch the apiserver/informer at once
+                with sem:
+                    self.reconcile(config)
+            else:
+                self.reconcile(config)
         except Exception as e:
             log.error("job %s: reconcile tick failed (%s); next tick retries",
                       self.fullname, e)
@@ -1107,8 +1234,24 @@ class TrainingJob:
           is the next-strongest enforcement.
 
         Self-inflicted MODIFIED events (our own status writes) diff as
-        empty and fall through without noise.
+        empty and fall through without noise. STALE events — a replayed
+        write from before our latest round-trip, e.g. the controller's
+        own Queued-phase write landing after the admitted reconciler
+        already defaulted the spec — are dropped on resourceVersion:
+        diffing against a snapshot older than what we wrote would
+        misread our own defaulting as a user edit and churn a spurious
+        SpecChangeRejected.
         """
+        try:
+            ours = int(self.job.metadata.resource_version or 0)
+            theirs = int(new_job.metadata.resource_version or 0)
+            # <= spawn rv: the very snapshot (or older) this reconciler
+            # was built from; < ours: predates our latest round-trip
+            if theirs and (theirs <= self._spawn_rv
+                           or (ours and theirs < ours)):
+                return
+        except (TypeError, ValueError):
+            pass  # non-numeric RVs (a real apiserver): fall through
         old_d = self.job.spec.to_dict()
         new_d = new_job.spec.to_dict()
         if new_d.get("maxGangRestarts") != old_d.get("maxGangRestarts"):

@@ -817,3 +817,129 @@ class TestRestartBackoffSpec:
         j.spec.set_defaults()
         with pytest.raises(ValidationError, match="restartBackoff"):
             j.spec.validate()
+
+
+# ---------------------------------------------------------------------------
+# sched-preempt fault (docs/SCHEDULER.md)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedPreemptFault:
+    """The ``sched-preempt`` chaos fault: a running admitted job is
+    forced through the cluster scheduler's FULL preemption path —
+    Preempted condition, teardown, re-queue with cooldown,
+    re-admission once it expires."""
+
+    def _world(self, executor):
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.kubelet import LocalKubelet
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        config = S.ControllerConfig(
+            fleet={"cpu-1": 2}, scheduler_cooldown_seconds=0.2)
+        controller = Controller(client, jc, config,
+                                reconcile_interval=0.02,
+                                sched_interval=0.03)
+        kubelet = LocalKubelet(client, executor)
+        return client, jc, controller, kubelet
+
+    @staticmethod
+    def _job(name):
+        j = S.TpuJob()
+        j.metadata.name = name
+        j.metadata.namespace = "default"
+        j.spec.tpu = S.TpuSpec(accelerator="cpu-1")
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=None)]
+        j.spec.scheduling = S.SchedulingSpec(priority=0)
+        return j
+
+    def test_fault_drives_full_preempt_requeue_resume(self):
+        from k8s_tpu.runtime.chaos import SchedPreemptFault
+        from k8s_tpu.runtime.kubelet import SimulatedExecutor
+
+        runs = {}
+        lock = threading.Lock()
+
+        class FirstRunBlocks:
+            def execute(self, pod, env, stop):
+                base = pod.metadata.name.split("-worker-")[0]
+                with lock:
+                    runs[base] = runs.get(base, 0) + 1
+                    first = runs[base] == 1
+                if first:
+                    stop.wait(60)
+                    return 143
+                return 0
+
+        client, jc, controller, kubelet = self._world(FirstRunBlocks())
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(self._job("victim"))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if controller.scheduler.running_keys(
+                        preemptible_only=True):
+                    break
+                time.sleep(0.02)
+            fault = SchedPreemptFault(controller, rate=1.0, seed=7)
+            assert fault.fire() == "default/victim"
+            # the victim lands back in Queued with the condition...
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                job = jc.get("default", "victim")
+                if any(c.type == "Preempted"
+                       for c in job.status.conditions):
+                    break
+                time.sleep(0.02)
+            assert any(c.type == "Preempted"
+                       for c in job.status.conditions), (
+                job.status.to_dict())
+            # ...and resumes after the cooldown: second incarnation
+            # succeeds on the same runtime_id
+            job = controller.wait_for_job("default", "victim",
+                                          timeout=30)
+            assert job.status.state == S.TpuJobState.SUCCEEDED
+            with lock:
+                assert runs.get("victim", 0) >= 2
+            assert controller.scheduler.inventory.used("cpu-1") == 0
+        finally:
+            controller.stop()
+            kubelet.stop()
+
+    def test_fault_is_noop_without_scheduler_or_jobs(self):
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.chaos import SchedPreemptFault
+
+        cluster = InMemoryCluster()
+        controller = Controller(KubeClient(cluster),
+                                TpuJobClient(cluster),
+                                S.ControllerConfig())  # no fleet
+        fault = SchedPreemptFault(controller, rate=1.0, seed=1)
+        assert fault.fire() is None
+        controller2 = Controller(KubeClient(cluster),
+                                 TpuJobClient(cluster),
+                                 S.ControllerConfig(fleet={"cpu-1": 1}))
+        fault2 = SchedPreemptFault(controller2, rate=1.0, seed=1)
+        assert fault2.fire() is None  # nothing running yet
+
+    def test_level_3_with_scheduler_adds_sched_preempt(self):
+        from k8s_tpu.controller.controller import Controller
+
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        controller = Controller(client, TpuJobClient(faulty),
+                                S.ControllerConfig(fleet={"cpu-1": 1}))
+        m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty,
+                                   scheduler=controller)
+        assert "sched-preempt" in sorted(i.name for i in m.injectors)
+        m2 = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty)
+        assert "sched-preempt" not in sorted(
+            i.name for i in m2.injectors)
+        ckpt_mod.arm_save_faults(0)
+        from k8s_tpu.obs import trace as obs_trace
+
+        obs_trace.arm_slow_host(0.0, steps=0)

@@ -1,0 +1,278 @@
+"""Cluster-scheduler flagship e2e (docs/SCHEDULER.md) over REAL
+subprocess trainers: two jobs contend for ONE cpu-1 slice under the
+scheduler-running controller.
+
+The low-priority job trains with a multi-tier checkpoint policy and an
+obs heartbeat (so the scheduler can PRICE its eviction). When the
+high-priority job arrives mid-interval, the scheduler preempts: the
+victim's pod is SIGTERMed, the launcher's preemption handler +
+``maybe_preempt_exit`` flush a forced two-tier checkpoint at the
+current step inside the grace window, and the job parks in QUEUED —
+with ``ktpu_sched_preempt_lost_steps_total`` carrying the steps that
+were at stake (> 0: the decision landed mid-checkpoint-interval). The
+preemptor runs to Succeeded on the freed slice; the victim is then
+re-admitted and resumes FROM ITS FLUSHED STEP (strictly newer than any
+periodic save), trains to completion, and the inventory high-water
+mark proves the slice was never double-owned.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.objects import Container, EnvVar, PodSpec, PodTemplateSpec
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.obs.events import events_of
+from k8s_tpu.runtime.kubelet import (
+    LocalKubelet,
+    LocalServiceResolver,
+    SubprocessExecutor,
+)
+from k8s_tpu import spec as S
+
+OBS_PORT = 8790
+LOCAL_EVERY = 10  # checkpoint interval: the window eviction cost lives in
+
+
+def _worker_log(tmp_path, name, rid, idx=0):
+    import glob
+
+    pats = glob.glob(
+        str(tmp_path / "logs" / f"{name}-worker-{rid}-{idx}-pod-*.log"))
+    return "\n".join(open(p).read() for p in sorted(pats))
+
+
+def _all_logs(tmp_path):
+    import glob
+
+    return "\n".join(
+        f"--- {p} ---\n" + open(p).read()
+        for p in glob.glob(str(tmp_path / "logs" / "*.log")))
+
+
+def _xfail_if_glibc_heap_bug(logs: str) -> None:
+    """Same guard every restore-then-continue e2e carries on this
+    container (see test_e2e_distributed)."""
+    if ("malloc_consolidate" in logs
+            or "corrupted double-linked list" in logs
+            or "malloc(): invalid" in logs
+            or "double free or corruption" in logs
+            or "free(): invalid" in logs):
+        pytest.xfail("glibc heap corruption in restored worker "
+                     "(jax 0.4.x CPU collectives)")
+
+
+def _train_job(name, tmp_path, priority, steps, step_sleep,
+               checkpoint=False, obs=False):
+    j = S.TpuJob()
+    j.metadata.name = name
+    j.metadata.namespace = "default"
+    j.spec.max_gang_restarts = 4
+    j.spec.tpu = S.TpuSpec(accelerator="cpu-1")  # 1 host, 1 chip
+    j.spec.scheduling = S.SchedulingSpec(priority=priority)
+    args = (f"--steps={steps} --batch_size=4 --log_every=1 "
+            f"--strategy=fsdp --seq_len=32 --step_sleep={step_sleep}")
+    j.spec.replica_specs = [S.TpuReplicaSpec(
+        replica_type="WORKER",
+        template=PodTemplateSpec(spec=PodSpec(containers=[Container(
+            name="jax", image="i",
+            command=["python", "-m", "k8s_tpu.launcher.spmd_launcher"],
+            env=[
+                EnvVar(name="KTPU_PROGRAM",
+                       value="k8s_tpu.programs.llama_train:main"),
+                EnvVar(name="KTPU_PROGRAM_ARGS", value=args),
+            ],
+        )])),
+    )]
+    if checkpoint:
+        j.spec.checkpoint_policy = S.CheckpointPolicySpec(
+            local_dir=str(tmp_path / f"{name}-local"),
+            local_interval_steps=LOCAL_EVERY,
+            persistent_dir=str(tmp_path / f"{name}-persist"),
+            persistent_interval_steps=100)  # periodic tier never fires
+    if obs:
+        j.spec.observability = S.ObservabilitySpec(
+            obs_port=OBS_PORT, straggler_profile_seconds=0.0)
+    return j
+
+
+@pytest.mark.integration
+def test_two_jobs_contend_preempt_flush_resume(tmp_path):
+    from k8s_tpu.controller import metrics as M
+
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    resolver = LocalServiceResolver()
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            # this container's escape hatch (train/checkpoint.py):
+            # orbax's background save thread is heap-unsafe on this
+            # jax 0.4.x runtime
+            "KTPU_SYNC_CHECKPOINT": "1",
+        },
+    )
+    kubelet = LocalKubelet(client, executor, resolver=resolver)
+    config = S.ControllerConfig(
+        fleet={"cpu-1": 1},              # ONE slice: they must contend
+        scheduler_cooldown_seconds=1.0)
+    controller = Controller(client, jc, config,
+                            reconcile_interval=0.2, sched_interval=0.1)
+
+    def fetcher_factory(tj):
+        # the test-side stand-in for cluster DNS only: heartbeats come
+        # over real HTTP from the real trainer subprocess
+        def fetch():
+            rid = tj.job.spec.runtime_id
+            obs = tj.job.spec.observability
+            if not rid or obs is None or not obs.obs_port:
+                return None
+            port = resolver.port_for(
+                f"{tj.name}-worker-{rid}-0", obs.obs_port)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    payload = json.loads(r.read())
+                hb = payload.get("obs")
+                if isinstance(hb, dict):
+                    # the ckpt goodput block rides the healthz top
+                    # level; graft it onto the heartbeat the pricing
+                    # reads (same shape the operator's default HTTP
+                    # fetcher sees)
+                    if isinstance(payload.get("ckpt"), dict):
+                        hb = {**hb, "ckpt": payload["ckpt"]}
+                    return {0: hb}
+            except Exception:
+                pass
+            return None
+        return fetch
+
+    controller.worker_stats_fetcher_factory = fetcher_factory
+    kubelet.start()
+    controller.start()
+    pre_preempted = M.SCHED_PREEMPTED.get({"queue": "default"})
+    try:
+        # ---- phase 1: the low-priority job owns the slice ----------
+        jc.create(_train_job("lowpri", tmp_path, priority=0, steps=40,
+                             step_sleep=0.25, checkpoint=True, obs=True))
+        low_tj = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            low_tj = controller.jobs.get("default/lowpri")
+            if low_tj is not None:
+                break
+            time.sleep(0.05)
+        assert low_tj is not None, "lowpri never admitted"
+
+        # wait until it is mid-checkpoint-interval with progress the
+        # scheduler can SEE: past the first periodic save, well before
+        # the end, and with a priced eviction cost > 0 off the live
+        # heartbeat (cost dips to 0 only at an exact save boundary)
+        deadline = time.monotonic() + 240
+        cost = 0
+        while time.monotonic() < deadline:
+            stats = low_tj._last_worker_stats or {}
+            step = max([int(h.get("step", 0) or 0)
+                        for h in stats.values()] + [0])
+            cost = low_tj.preemption_cost()
+            if LOCAL_EVERY + 2 <= step <= 30 and cost > 0:
+                break
+            assert not low_tj.finished, (
+                "lowpri finished before contention\n" + _all_logs(tmp_path))
+            time.sleep(0.1)
+        assert cost > 0, _all_logs(tmp_path)
+
+        # ---- phase 2: high-priority arrival preempts ---------------
+        jc.create(_train_job("highpri", tmp_path, priority=10, steps=5,
+                             step_sleep=0.05))
+        deadline = time.monotonic() + 120
+        low = None
+        while time.monotonic() < deadline:
+            low = jc.get("default", "lowpri")
+            if low.status.phase == S.TpuJobPhase.QUEUED:
+                break
+            time.sleep(0.1)
+        assert low is not None and \
+            low.status.phase == S.TpuJobPhase.QUEUED, _all_logs(tmp_path)
+        cond = next(c for c in low.status.conditions
+                    if c.type == "Preempted")
+        assert "default/highpri" in cond.reason  # names the preemptor
+        evs = {e.reason for e in client.events.list("default")}
+        assert {"Preempted", "Preempting", "Queued", "Admitted"} <= evs
+        # the scheduler priced the eviction: steps at stake > 0 and
+        # bounded by the checkpoint interval
+        lost = M.SCHED_PREEMPT_LOST_STEPS.get({"job": "default/lowpri"})
+        assert 0 < lost <= LOCAL_EVERY + 2, lost
+        assert M.SCHED_PREEMPTED.get({"queue": "default"}) \
+            == pre_preempted + 1
+
+        # the victim's preempt flush landed a checkpoint on its way out
+        rid_low = low.spec.runtime_id
+        deadline = time.monotonic() + 60
+        flushes = []
+        while time.monotonic() < deadline:
+            log_low = _worker_log(tmp_path, "lowpri", rid_low)
+            flushes = events_of(log_low, "preempt_checkpoint")
+            if flushes:
+                break
+            time.sleep(0.2)
+        assert events_of(log_low, "preempt_requested"), log_low
+        assert flushes, ("no preempt_checkpoint event:\n"
+                         + _all_logs(tmp_path))
+        flush_step = flushes[-1]["step"]
+        assert flush_step > LOCAL_EVERY  # strictly newer than periodic
+        # the flush committed to the LOCAL tier on the victim's way out
+        # (checked now, while the job is queued — the resumed run's own
+        # periodic saves will rotate it out of retention later)
+        from k8s_tpu.ckpt import LocalTier
+
+        local = LocalTier(str(tmp_path / "lowpri-local"), host_id=0)
+        assert flush_step in local.committed_steps(), (
+            flush_step, local.committed_steps())
+
+        # ---- phase 3: the preemptor runs to Succeeded --------------
+        high = controller.wait_for_job("default", "highpri", timeout=240)
+        if high.status.state != S.TpuJobState.SUCCEEDED:
+            _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+        assert high.status.state == S.TpuJobState.SUCCEEDED, (
+            _all_logs(tmp_path))
+
+        # ---- phase 4: the victim resumes from its flushed step -----
+        low = controller.wait_for_job("default", "lowpri", timeout=300)
+        if low.status.state != S.TpuJobState.SUCCEEDED:
+            _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+        assert low.status.state == S.TpuJobState.SUCCEEDED, (
+            json.dumps(low.status.to_dict(), indent=1)
+            + _all_logs(tmp_path))
+        log_low = _worker_log(tmp_path, "lowpri", rid_low)
+        restores = events_of(log_low, "ckpt_restore")
+        assert restores, "no ckpt_restore event:\n" + log_low
+        # resumed from the FLUSHED step (not the older periodic save):
+        # bounded loss — the flush preserved everything past step 10.
+        # The flush is two-tier, and at EQUAL steps the planner prefers
+        # the durable tier by design, so any source is legitimate here;
+        # the local tier's own commit is asserted on disk below.
+        assert restores[0]["step"] == flush_step, (restores, flush_step)
+        assert 0 <= restores[0]["lost_steps"] <= 2, restores
+        assert '"step": 40' in log_low  # trained to completion
+        assert any(c.type == "Admitted"
+                   for c in low.status.conditions)  # re-admission landed
+        assert low.status.gang_restarts == 0  # policy, never a fault
+
+        # ---- the ledger: one slice, never double-owned -------------
+        inv = controller.scheduler.inventory
+        assert inv.max_used["cpu-1"] == 1
+        assert inv.used("cpu-1") == 0
+    finally:
+        controller.stop()
+        kubelet.stop()
