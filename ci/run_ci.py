@@ -169,6 +169,21 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_sched.xml"],
             args.artifacts_dir, cases,
         )
+        # elastic-resize gate (ISSUE 12): the resize decision core's
+        # full matrix (dead-heartbeat / inventory shrink triggers, grow
+        # hold, clamps, cooldown, health-gated restore ceiling, budget
+        # exhaustion), the atomic ledger recharge, the spec.elastic
+        # round trip, and the controller's shrink→grow reconciler flow.
+        # Always on and fast, mirroring the sched/obs/ckpt-tiers
+        # stages: a resize regression (a double-charged shrink, a grow
+        # that restores a NaN step) fails in seconds.
+        ok = ok and stage(
+            "resize",
+            [py, "-m", "pytest", "tests/test_resize.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_resize.xml"],
+            args.artifacts_dir, cases,
+        )
         # metrics-lint: every ktpu_* series registered in code must be
         # cataloged in docs/OBSERVABILITY.md and vice versa — doc drift
         # on the metrics inventory fails CI, not a reader at 3am
@@ -220,6 +235,7 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_ckpt_tiers.py",
                       "--ignore=tests/test_obs.py",
                       "--ignore=tests/test_sched.py",
+                      "--ignore=tests/test_resize.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

@@ -45,6 +45,16 @@ REQUEUE_POLICY = BackoffPolicy(
 )
 
 
+def _dp_footprint(fp, dp: int):
+    """A gang footprint rescaled to ``dp`` slices, preserving its own
+    chips-per-slice ratio — the ONE way an elastic gang's charge is
+    derived at any width (pricing, re-admission, resize re-charge), so
+    the ledger can never see two inconsistent derivations of the same
+    job (docs/ELASTIC.md)."""
+    per_slice = fp.chips // max(1, fp.slices)
+    return type(fp)(fp.accelerator, slices=dp, chips=dp * per_slice)
+
+
 class Controller:
     def __init__(
         self,
@@ -81,6 +91,10 @@ class Controller:
                 cost_fn=self._preemption_cost,
                 preemption_cooldown=self.config.scheduler_cooldown_seconds,
             )
+            # capacity-return tick (docs/ELASTIC.md): a freed slice
+            # nudges every elastic gang's reconciler so grow decisions
+            # land within a tick, not a polling interval
+            self.scheduler.inventory.on_capacity(self._on_capacity_return)
         self._sched_lock = threading.RLock()
         self._sched_thread: Optional[threading.Thread] = None
         # O(100) hygiene: one shared semaphore bounds concurrent
@@ -208,6 +222,11 @@ class Controller:
         tj.reconcile_limiter = self._reconcile_limiter
         if self.scheduler is not None:
             tj.on_terminal = self._on_job_terminal
+            # elastic resize (docs/ELASTIC.md): the reconciler's
+            # inventory view + the atomic ledger re-charge
+            tj.capacity_fn = (
+                lambda key=job.key: self._attainable_slices(key))
+            tj.on_resize = self._on_job_resize
         if self.worker_stats_fetcher_factory is not None:
             try:
                 tj.worker_stats_fetcher = \
@@ -241,8 +260,17 @@ class Controller:
                 priority = 0  # validation rejects it properly at setup
             queue = s.queue or "default"
             preemptible = bool(s.preemptible)
+        fp = footprint_of(job.spec)
+        dp = getattr(job.status, "dp_degree", 0) or 0
+        if (dp > 0 and job.spec.elastic is not None
+                and job.spec.serving is None and not fp.empty):
+            # a resized elastic gang is priced at its CURRENT width
+            # (status.dp_degree), not the spec's original numSlices —
+            # re-admission/adoption must charge what the reconciler
+            # will actually materialize (docs/ELASTIC.md)
+            fp = _dp_footprint(fp, dp)
         return JobRequest(
-            key=job.key, footprint=footprint_of(job.spec),
+            key=job.key, footprint=fp,
             priority=priority, queue=queue, preemptible=preemptible,
         )
 
@@ -252,6 +280,71 @@ class Controller:
         heartbeat sweep (PR 9's goodput block). Unknown ⇒ 0."""
         tj = self.jobs.get(key)
         return tj.preemption_cost() if tj is not None else 0
+
+    # -------------------------------------------------------- elastic
+
+    def _attainable_slices(self, key: str) -> Optional[int]:
+        """Slices job ``key`` could hold right now = its current charge
+        + the pool's (unclamped) headroom — the elastic resizer's
+        inventory view (docs/ELASTIC.md). A pool driven UNDER its usage
+        by a permanent loss yields attainable < held: the shrink
+        trigger. None when the job holds no accelerator charge."""
+        sched = self.scheduler
+        if sched is None:
+            return None
+        held_fp = sched.inventory.holder(key)
+        if held_fp is None or held_fp.empty:
+            return None
+        free = (sched.inventory.capacity(held_fp.accelerator)
+                - sched.inventory.used(held_fp.accelerator))
+        return max(0, held_fp.slices + free)
+
+    def _on_job_resize(self, tj: TrainingJob, old_dp: int,
+                       new_dp: int, trigger: str = "") -> bool:
+        """The reconciler's ledger re-charge at a resize verdict: swap
+        the job's charge for the reshaped footprint ATOMICALLY (shrink
+        frees slices, grow re-charges them — the high-water mark never
+        sees both shapes). An INVENTORY-triggered shrink must re-verify
+        the pool deficit inside the ledger's critical section: two
+        elastic gangs sharing a pool both observe one revoked slice,
+        and without the check both would surrender a slice for it
+        (dead-host shrinks carry their own evidence and skip it). A
+        shrink immediately re-runs the decision core: the freed slices
+        may admit a queued job this tick."""
+        sched = self.scheduler
+        if sched is None:
+            return True  # no ledger to keep consistent
+        key = tj.job.key
+        req = sched.running_request(key)
+        if req is None or req.footprint.empty:
+            return True  # zero-footprint / unscheduled: nothing charged
+        # scale the RUNNING charge, not a fresh topology lookup: the
+        # charge's own slices/chips ratio is consistent by construction
+        new_fp = _dp_footprint(req.footprint, new_dp)
+        if not sched.resize_running(
+                key, new_fp,
+                require_pool_deficit=(new_dp < old_dp
+                                      and trigger == "inventory")):
+            return False
+        self._export_sched_metrics()
+        if new_dp < old_dp:
+            self._sched_tick()
+        return True
+
+    def _on_capacity_return(self, accelerator: str) -> None:
+        """Inventory capacity-return listener: wake every running
+        elastic gang's reconciler so the grow hold starts counting NOW
+        (best-effort — the periodic tick remains the backstop)."""
+        for tj in list(self.jobs.values()):
+            try:
+                if tj.job.spec.elastic is None or not tj.is_alive():
+                    continue
+                fp = (self.scheduler.inventory.holder(tj.job.key)
+                      if self.scheduler is not None else None)
+                if fp is not None and fp.accelerator == accelerator:
+                    tj.nudge()
+            except Exception:  # a nudge must never break the ledger path
+                pass
 
     def _submit_queued(self, job: TpuJob) -> None:
         """First sighting of a fresh job under the scheduler: park it

@@ -316,6 +316,25 @@ SCHED_PREEMPT_LOST_STEPS = REGISTRY.counter(
     "flush usually reduces the realized loss, visible in "
     "ktpu_ckpt_lost_steps_total), by victim job",
 )
+# Elastic gang resize (k8s_tpu/resize, docs/ELASTIC.md): the
+# re-partitioning loop's own telemetry — how often gangs change shape,
+# what each shrink put at stake, and the live DP degree per job.
+RESIZE_TOTAL = REGISTRY.counter(
+    "ktpu_resize_total",
+    "Elastic gang resizes performed, by job and direction "
+    "(shrink / grow)",
+)
+RESIZE_LOST_STEPS = REGISTRY.counter(
+    "ktpu_resize_lost_steps_total",
+    "Steps at stake at each shrink decision (gang progress past its "
+    "last checkpoint — the flush usually reduces the realized loss, "
+    "visible in ktpu_ckpt_lost_steps_total), by job",
+)
+RESIZE_DP = REGISTRY.gauge(
+    "ktpu_resize_dp_degree",
+    "Current data-parallel degree (slices) of each elastic gang after "
+    "its last resize",
+)
 # Serving: device bytes held by the shared-prefix KV snapshot LRU
 # (docs/SERVING.md "Fleet") — the count-bounded cache finally gets
 # bytes accounting so fleet capacity planning has real numbers.

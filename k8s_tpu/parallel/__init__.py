@@ -10,6 +10,7 @@ stage axes) over ICI+DCN and letting XLA insert collectives.
 from k8s_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     build_mesh,
+    data_parallel_degree,
     mesh_for_topology,
 )
 from k8s_tpu.parallel.ulysses import (  # noqa: F401

@@ -88,6 +88,20 @@ def build_mesh(
     return Mesh(dev_array, AXES)
 
 
+def data_parallel_degree(mesh) -> int:
+    """The mesh's ``data`` axis size — the DP degree the elastic-resize
+    loop (docs/ELASTIC.md) reasons in. Re-deriving a mesh for a resized
+    world is just ``build_mesh`` over the new device set: every layout
+    downstream (``logical_sharding``, ``zero1_shardings``) is a pure
+    function of the mesh, so the new world's shardings need no state
+    from the old one — the cross-degree checkpoint math lives in
+    ``ckpt.local.union_covering_plan`` instead."""
+    try:
+        return int(dict(mesh.shape).get("data", 1) or 1)
+    except Exception:
+        return 1
+
+
 def mesh_for_topology(accelerator: str, num_slices: int = 1, **axis_sizes):
     """Mesh sized from a named TPU topology (spec layer vocabulary),
     e.g. ``mesh_for_topology("v5p-16", tensor=4)``."""

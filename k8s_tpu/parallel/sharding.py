@@ -241,6 +241,14 @@ def zero1_partition_spec(
     (odd-shaped leaves simply stay in their existing layout — ZeRO is
     best-effort per leaf, never a constraint violation).
 
+    Elastic-resize contract (docs/ELASTIC.md): this derivation is a
+    pure function of (leaf shape, mesh), so a resized gang simply
+    re-runs it against the new world's mesh — a DP=2 checkpoint whose
+    zero1 tiles no longer match the DP=1 template is rebuilt shard by
+    shard from the union of peer manifests at restore time
+    (``ckpt.local.union_covering_plan``), never by any layout state
+    carried across the resize.
+
     Only rank >= 2 leaves shard: norm scales and biases are a rounding
     error of the moment bytes, and constraining their gradients
     propagates the 1-D data sharding backward through the broadcasts

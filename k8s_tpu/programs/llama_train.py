@@ -159,8 +159,12 @@ def main(rdzv) -> None:
     ) in ("1", "true")
     if rdzv.process_id <= 0:
         # machine-readable proof the MEGASCALE env shaped the mesh
-        # (multi-slice e2e asserts data axis == num_slices)
+        # (multi-slice e2e asserts data axis == num_slices; the elastic
+        # e2e asserts dp tracks the resized world across shrink/grow)
+        from k8s_tpu.parallel import data_parallel_degree
+
         print(json.dumps({"event": "mesh", "num_slices": num_slices,
+                          "dp": data_parallel_degree(mesh),
                           "shape": dict(mesh.shape), "zero1": zero1}),
               flush=True)
     rules = LogicalRules(getattr(LogicalRules, STRATEGIES[strategy]))

@@ -1,0 +1,18 @@
+"""Elastic gang resize (docs/ELASTIC.md).
+
+The layer between "a pod died permanently" and "training continues at
+DP−1": a pure, clock-injected decision core
+(:mod:`k8s_tpu.resize.elastic`) the reconciler feeds with the PR-9
+observe→act signals — per-host heartbeat freshness (dead-host
+detection), the scheduler inventory's attainable-slice view (shrink
+when a slice is gone for good), and the capacity-return tick (grow
+back when the fleet frees slices). Verdicts are data; the operator
+acts on them by driving the ``Resizing`` TpuJob transition
+(flush-teardown → re-plan the restore at the new DP degree → re-admit
+the reshaped footprint through the scheduler ledger).
+"""
+
+from k8s_tpu.resize.elastic import (  # noqa: F401
+    ElasticResizer,
+    ResizeVerdict,
+)

@@ -52,6 +52,12 @@ sched-preempt       one running admitted job forced through the cluster
                     re-admission when capacity returns — the victim
                     loses steps, never its checkpoint
                     (docs/SCHEDULER.md)
+permanent-pod-loss  one elastic gang worker killed AND its slice marked
+                    unschedulable in the scheduler inventory — restore-
+                    in-place can never place again, so only the elastic
+                    resize path (shrink to the surviving slices'
+                    DP degree, grow back when the fault heals the
+                    capacity) can save the job (docs/ELASTIC.md)
 ==================  =====================================================
 
 Every injector is seeded-RNG-driven and individually rate-controlled;
@@ -595,6 +601,110 @@ class SchedPreemptFault(FaultInjector):
         return victim
 
 
+class PermanentPodLossFault(FaultInjector):
+    """Permanent capacity loss (``permanent-pod-loss``): kill one gang
+    worker of a running ELASTIC job with an abrupt retryable exit AND
+    shrink its accelerator pool in the scheduler inventory by one
+    slice — the node is gone for good, not rebooting. A same-shape
+    gang restart can then never place (the inventory's attainable view
+    is below the gang's DP degree), so only the elastic resize path
+    saves the job: shrink to the survivors, train on, and — once
+    ``heal_after_ticks`` chaos rounds pass and the fault returns the
+    capacity — grow back (docs/ELASTIC.md).
+
+    Only fires on jobs that CAN shrink (an elastic block with
+    ``current DP > minDpDegree``); otherwise a no-op — a fault whose
+    only possible outcome is Failed exercises nothing this class is
+    for. ``controller`` is a scheduler-running Controller (the
+    ``sched-preempt`` contract)."""
+
+    name = "permanent-pod-loss"
+
+    def __init__(self, controller, rate: float = 1.0,
+                 seed: Optional[int] = None, heal_after_ticks: int = 3):
+        super().__init__(rate, seed)
+        self.controller = controller
+        self.heal_after_ticks = heal_after_ticks
+        # accelerator -> [ticks_left, slices_to_return]
+        self._pending_heal: Dict[str, List[int]] = {}
+
+    def _heal_tick(self) -> None:
+        """Return stolen capacity after the grace ticks — the grow half
+        of the cycle (a soak must exercise shrink AND grow, and a fault
+        that only drains the pool would starve every later round)."""
+        inv = self.controller.scheduler.inventory
+        for accel in list(self._pending_heal):
+            entry = self._pending_heal[accel]
+            entry[0] -= 1
+            if entry[0] <= 0:
+                inv.set_capacity(accel, inv.capacity(accel) + entry[1])
+                log.info("chaos[%s]: healed %d %s slice(s)",
+                         self.name, entry[1], accel)
+                del self._pending_heal[accel]
+
+    def maybe_fire(self) -> Optional[str]:
+        if getattr(self.controller, "scheduler", None) is not None:
+            self._heal_tick()
+        return super().maybe_fire()
+
+    def fire(self) -> Optional[str]:
+        sched = getattr(self.controller, "scheduler", None)
+        if sched is None:
+            return None
+        inv = sched.inventory
+        candidates = []
+        for tj in list(self.controller.jobs.values()):
+            spec = tj.job.spec
+            if (spec.elastic is None or spec.tpu is None
+                    or not spec.elastic.resize_on_permanent_loss
+                    or not tj.is_alive() or tj.finished):
+                continue
+            lo = spec.elastic.bounds(max(1, spec.tpu.num_slices))[0]
+            if tj.current_dp() <= lo:
+                continue  # already at the floor: only Failed could follow
+            if inv.capacity(spec.tpu.accelerator) <= 1:
+                continue  # never drain a pool to zero
+            candidates.append(tj)
+        if not candidates:
+            return None
+        tj = self.rng.choice(candidates)
+        accel = tj.job.spec.tpu.accelerator
+        # kill one running worker pod of THIS job (abrupt — SIGKILL
+        # semantics, exit 137)
+        from k8s_tpu.trainer import labels as L
+
+        pods = [
+            p for p in self.controller.client.pods.list(
+                tj.job.metadata.namespace,
+                {L.JOB_NAME_LABEL: tj.job.metadata.name,
+                 L.JOB_TYPE_LABEL: "WORKER"})
+            if p.status.phase == "Running"
+        ]
+        if not pods:
+            return None
+        victim = self.rng.choice(pods)
+        victim.status.phase = "Failed"
+        for cs in victim.status.container_statuses:
+            cs.state = ContainerState(
+                terminated=ContainerStateTerminated(
+                    exit_code=137, reason="Killed"))
+        try:
+            self.controller.client.pods.update(victim)
+        except errors.NotFoundError:
+            return None
+        # ...and take its slice out of the fleet: the node is gone, a
+        # same-shape restore can never place again
+        inv.set_capacity(accel, inv.capacity(accel) - 1)
+        self._pending_heal.setdefault(
+            accel, [self.heal_after_ticks, 0])[1] += 1
+        self._pending_heal[accel][0] = self.heal_after_ticks
+        self.injected += 1
+        log.info("chaos[%s]: killed %s and revoked one %s slice "
+                 "(heals in %d ticks)", self.name,
+                 victim.metadata.name, accel, self.heal_after_ticks)
+        return f"{victim.metadata.name} (-1 {accel} slice)"
+
+
 class LeaseLossFault(FaultInjector):
     """Steal the leader-election lock: overwrite the lease annotation
     with a chaos holder so the real leader's CAS renew conflicts and it
@@ -703,6 +813,8 @@ class ChaosMonkey:
           recovery matrix); when ``scheduler`` names a scheduler-
           running Controller — forced preemptions through the
           checkpoint-safe flush-requeue-resume path (sched-preempt)
+          and permanent slice loss driving the elastic shrink/grow
+          cycle (permanent-pod-loss)
         """
         rng = random.Random(seed)
 
@@ -738,6 +850,8 @@ class ChaosMonkey:
             if scheduler is not None:
                 inj.append(
                     SchedPreemptFault(scheduler, rate=0.15, seed=s()))
+                inj.append(
+                    PermanentPodLossFault(scheduler, rate=0.1, seed=s()))
         return cls(client, level=level, interval=interval, seed=s(),
                    injectors=inj)
 

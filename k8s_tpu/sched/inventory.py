@@ -103,6 +103,12 @@ class SliceInventory:
         # per-accelerator high-water mark: lets a scale test assert the
         # zero-oversubscription invariant held over the WHOLE run
         self.max_used: Dict[str, int] = {a: 0 for a in self._capacity}
+        # capacity-return listeners (docs/ELASTIC.md): called with the
+        # accelerator name whenever free slices INCREASE (a release, an
+        # elastic shrink, a pool grow) — the elastic-resize grow tick.
+        # Called OUTSIDE the lock: a listener that re-enters the
+        # inventory (or nudges a reconciler) must never deadlock it.
+        self._capacity_listeners: list = []
 
     # ------------------------------------------------------------- reads
 
@@ -181,16 +187,73 @@ class SliceInventory:
             if fp is not None:
                 self._used[fp.accelerator] = max(
                     0, self._used.get(fp.accelerator, 0) - fp.slices)
-            return fp
+        if fp is not None and not fp.empty:
+            self._notify_capacity(fp.accelerator)
+        return fp
+
+    def recharge(self, key: str, fp: Footprint) -> None:
+        """Atomically replace ``key``'s charge with ``fp`` — the
+        elastic-resize ledger move (docs/ELASTIC.md): a shrink frees
+        slices and a grow re-charges them in ONE critical section, so
+        no observer (and no high-water mark) ever sees the job owning
+        both shapes at once, and a grow that would oversubscribe raises
+        WITHOUT losing the old charge (the gang still physically holds
+        its current slices)."""
+        freed = False
+        with self._lock:
+            old = self._holders.pop(key, None)
+            if old is not None:
+                self._used[old.accelerator] = max(
+                    0, self._used.get(old.accelerator, 0) - old.slices)
+            try:
+                self.charge(key, fp)
+            except Exception:
+                if old is not None:  # restore the old charge untouched
+                    self._used[old.accelerator] = (
+                        self._used.get(old.accelerator, 0) + old.slices)
+                    self._holders[key] = old
+                raise
+            freed = (old is not None and not old.empty
+                     and (fp.empty or fp.slices < old.slices
+                          or fp.accelerator != old.accelerator))
+        if freed:
+            self._notify_capacity(old.accelerator)
 
     def set_capacity(self, accelerator: str, slices: int) -> None:
-        """Resize one pool (node-pool scale events). Shrinking below
-        current usage never retro-preempts — running gangs keep their
-        slices and the pool simply admits nothing until it drains back
-        under the new capacity (the no-flap rule: inventory flaps must
-        not translate into admission/preemption churn)."""
+        """Resize one pool (node-pool scale events, the
+        permanent-pod-loss chaos fault). Shrinking below current usage
+        never retro-preempts — running gangs keep their slices and the
+        pool simply admits nothing until it drains back under the new
+        capacity (the no-flap rule: inventory flaps must not translate
+        into admission/preemption churn). Growing the pool notifies the
+        capacity-return listeners (the elastic grow tick)."""
+        grew = False
         with self._lock:
             if slices <= 0:
                 self._capacity.pop(accelerator, None)
             else:
+                grew = int(slices) > self._capacity.get(accelerator, 0)
                 self._capacity[accelerator] = int(slices)
+        if grew:
+            self._notify_capacity(accelerator)
+
+    # --------------------------------------------------------- listeners
+
+    def on_capacity(self, fn) -> None:
+        """Subscribe to capacity-return ticks: ``fn(accelerator)`` runs
+        (outside the inventory lock, best-effort) whenever free slices
+        increase. The elastic-resize grow path rides this so a freed
+        slice reaches a shrunken gang within a reconcile tick instead
+        of a polling interval."""
+        self._capacity_listeners.append(fn)
+
+    def _notify_capacity(self, accelerator: str) -> None:
+        for fn in list(self._capacity_listeners):
+            try:
+                fn(accelerator)
+            except Exception:  # a listener bug must never break the ledger
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "capacity listener failed for %s", accelerator,
+                    exc_info=True)

@@ -190,6 +190,41 @@ class ClusterScheduler:
                 req.seq = self._seq
             self._pending[req.key] = req
 
+    def resize_running(self, key: str, new_fp: Footprint,
+                       require_pool_deficit: bool = False) -> bool:
+        """Re-admit a RUNNING job's reshaped footprint in place — the
+        elastic-resize ledger move (docs/ELASTIC.md): the inventory
+        swap is atomic (shrink frees slices, grow re-charges them, the
+        high-water mark never sees both shapes at once), and the
+        running request's terms are updated so later decisions (quota
+        pricing, victim selection) see the real shape. Returns False —
+        changing nothing — when the job is not running here, the grown
+        footprint does not fit, or ``require_pool_deficit`` is set and
+        the pool is no longer over-subscribed (an inventory-triggered
+        shrink whose deficit another gang's shrink already absorbed:
+        N gangs sharing a pool must surrender exactly ONE slice per
+        revoked slice, not one each); the caller keeps the old shape
+        and re-decides against the fresh inventory next tick."""
+        with self._lock:
+            req = self._running.get(key)
+            if req is None:
+                return False
+            if (require_pool_deficit
+                    and self.inventory.available(new_fp.accelerator) >= 0):
+                log.info(
+                    "inventory-triggered shrink of %s refused: pool "
+                    "'%s' deficit already absorbed", key,
+                    new_fp.accelerator)
+                return False
+            try:
+                self.inventory.recharge(key, new_fp)
+            except Exception as e:
+                log.warning("resize of %s to %s refused: %s",
+                            key, new_fp, e)
+                return False
+            req.footprint = new_fp
+            return True
+
     def requeue(self, key: str, cooldown: Optional[float] = None) -> bool:
         """Move a RUNNING job back to the queue (the preemption /
         chaos-eviction path): slices freed, original submit order kept

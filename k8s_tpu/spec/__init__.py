@@ -25,6 +25,7 @@ from k8s_tpu.spec.tpu_job import (  # noqa: F401
     VALID_REPLICA_TYPES,
     CheckpointPolicySpec,
     ChiefSpec,
+    ElasticSpec,
     ObservabilitySpec,
     ReplicaState,
     ReplicaStatus,

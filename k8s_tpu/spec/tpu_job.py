@@ -353,6 +353,107 @@ class SchedulingSpec(K8sObject):
 
 @register_type
 @dataclass
+class ElasticSpec(K8sObject):
+    """Elastic gang resize (docs/ELASTIC.md): let the operator survive
+    PERMANENT capacity loss by re-partitioning the gang to a different
+    data-parallel degree instead of restoring the same shape forever.
+
+    ``minDpDegree``/``maxDpDegree`` bound the legal DP degrees (in
+    SLICES — the gang's worker count at degree k is ``num_hosts × k``);
+    0 on ``maxDpDegree`` defaults to ``tpu.numSlices``. The spec's own
+    ``numSlices`` is the preferred width and must sit inside the range.
+    ``resizeOnPermanentLoss: false`` keeps the observe side (the
+    resizer still watches) but never shrinks — growth back to capacity
+    remains available for gangs resized by an operator escape hatch.
+
+    The window knobs are the no-flap story: ``deadAfterSeconds`` is how
+    long a host must be heartbeat-silent (while peers answer) before
+    its slice is presumed permanently lost, ``growHoldSeconds`` how
+    long returned capacity must hold before growing back, and
+    ``cooldownSeconds`` the minimum spacing between resizes. Each
+    resize is budget-counted against ``maxGangRestarts`` like a
+    divergence restart, and the restore is health-gated: a NaN step is
+    never the resize restore point (the last-healthy ceiling rides
+    ``KTPU_CKPT_RESTORE_MAX_STEP`` exactly as in the divergence path).
+
+    The block round-trips through the operator env like
+    ``checkpointPolicy`` (``KTPU_ELASTIC_*``), so a program can see the
+    terms it runs under (e.g. checkpointing more aggressively when its
+    world may be re-partitioned under it)."""
+
+    min_dp_degree: int = 1
+    max_dp_degree: int = 0  # 0 → tpu.numSlices
+    resize_on_permanent_loss: bool = True
+    dead_after_seconds: float = 10.0
+    grow_hold_seconds: float = 10.0
+    cooldown_seconds: float = 30.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def bounds(self, num_slices: int) -> "tuple[int, int]":
+        lo = self.min_dp_degree or 1
+        hi = self.max_dp_degree or num_slices
+        return lo, hi
+
+    def validate(self) -> None:
+        for name in ("min_dp_degree", "max_dp_degree"):
+            val = getattr(self, name)
+            if not isinstance(val, int) or isinstance(val, bool):
+                raise ValidationError(f"elastic: {name} must be an integer")
+        if self.min_dp_degree < 1:
+            raise ValidationError("elastic: minDpDegree must be >= 1")
+        if self.max_dp_degree and self.max_dp_degree < self.min_dp_degree:
+            raise ValidationError(
+                f"elastic: need minDpDegree <= maxDpDegree, got "
+                f"min={self.min_dp_degree} max={self.max_dp_degree}")
+        if not isinstance(self.resize_on_permanent_loss, bool):
+            raise ValidationError(
+                "elastic: resizeOnPermanentLoss must be a boolean")
+        for name in ("dead_after_seconds", "grow_hold_seconds",
+                     "cooldown_seconds"):
+            try:
+                val = float(getattr(self, name))
+            except (TypeError, ValueError):
+                raise ValidationError(f"elastic: {name} must be a number")
+            if val < 0:
+                raise ValidationError(f"elastic: {name} must be >= 0")
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher/program contract, mirroring checkpointPolicy
+        (parsed back by :meth:`from_env`)."""
+        return {
+            "KTPU_ELASTIC_MIN_DP": str(self.min_dp_degree),
+            "KTPU_ELASTIC_MAX_DP": str(self.max_dp_degree),
+            "KTPU_ELASTIC_RESIZE":
+                "1" if self.resize_on_permanent_loss else "0",
+        }
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["ElasticSpec"]:
+        """Rebuild the terms from the operator-injected env (the same
+        round trip CheckpointPolicy.from_env provides); None when the
+        job carries no elastic contract."""
+        import os
+
+        env = env if env is not None else os.environ
+        if "KTPU_ELASTIC_MIN_DP" not in env:
+            return None
+
+        def num(name, default):
+            try:
+                return int(env.get(name, default) or default)
+            except ValueError:
+                return default
+
+        return cls(
+            min_dp_degree=num("KTPU_ELASTIC_MIN_DP", 1),
+            max_dp_degree=num("KTPU_ELASTIC_MAX_DP", 0),
+            resize_on_permanent_loss=env.get(
+                "KTPU_ELASTIC_RESIZE", "1") in ("1", "true"),
+        )
+
+
+@register_type
+@dataclass
 class ServingSpec(K8sObject):
     """Serving-fleet block (docs/SERVING.md "Fleet"): the operator
     materializes ``replicas`` INDEPENDENT engine pods (each its own
@@ -562,6 +663,10 @@ class TpuJobSpec(K8sObject):
     # queue / preemptibility. None → priority 0 in the default queue,
     # preemptible (the market's most modest bid).
     scheduling: Optional[SchedulingSpec] = None
+    # Elastic gang resize (docs/ELASTIC.md): survive permanent slice
+    # loss by re-partitioning to a smaller DP degree (and growing back
+    # when capacity returns). None → fixed shape, today's behavior.
+    elastic: Optional[ElasticSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # -- normalization ------------------------------------------------------
@@ -646,6 +751,19 @@ class TpuJobSpec(K8sObject):
                     raise ValidationError(
                         f"serving: WORKER replicas {w.replicas} outside "
                         f"[minReplicas, maxReplicas] = [{lo}, {hi}]")
+        if self.elastic is not None:
+            self.elastic.validate()
+            if self.serving is not None:
+                # a serving fleet already scales per-replica through the
+                # SLO autoscaler; "DP degree" is a gang concept
+                raise ValidationError(
+                    "elastic: gang resize is a training-gang feature; "
+                    "serving fleets scale via spec.serving "
+                    "minReplicas/maxReplicas instead")
+            if self.tpu is None or not self.tpu.accelerator:
+                raise ValidationError(
+                    "elastic: resize needs a tpu block — the DP degree "
+                    "is counted in slices of spec.tpu.accelerator")
         if self.tpu is not None and self.tpu.accelerator:
             t = self.tpu.topology()
             if t is None:
@@ -654,6 +772,13 @@ class TpuJobSpec(K8sObject):
                 )
             if self.tpu.num_slices < 1:
                 raise ValidationError("tpu.numSlices must be >= 1")
+            if self.elastic is not None:
+                lo, hi = self.elastic.bounds(self.tpu.num_slices)
+                if not 1 <= lo <= self.tpu.num_slices <= hi:
+                    raise ValidationError(
+                        f"elastic: need minDpDegree <= tpu.numSlices <= "
+                        f"maxDpDegree, got [{lo}, {hi}] around "
+                        f"numSlices={self.tpu.num_slices}")
             if self.serving is not None:
                 # a serving WORKER is one independent engine, not a
                 # gang member — each replica gets one whole (single-
@@ -665,12 +790,25 @@ class TpuJobSpec(K8sObject):
                         "fleet replicas must be single-host engines")
             else:
                 expected = t.num_hosts * self.tpu.num_slices
+                allowed = {expected}
+                if self.elastic is not None:
+                    # a resized gang persists its current width in the
+                    # spec (the serving-autoscaler precedent): any
+                    # whole-slice multiple inside the elastic range is
+                    # a legal shape — divisibility against the topology
+                    # stays exact, a partial slice never validates
+                    lo, hi = self.elastic.bounds(self.tpu.num_slices)
+                    allowed = {t.num_hosts * k for k in range(lo, hi + 1)}
                 for r in self.replica_specs:
-                    if r.replica_type == WORKER and r.replicas not in (None, expected):
+                    if r.replica_type == WORKER and r.replicas is not None \
+                            and r.replicas not in allowed:
                         raise ValidationError(
                             f"WORKER replicas must equal num_hosts×num_slices = {expected} "
                             f"for accelerator {self.tpu.accelerator} (a slice is a gang; "
-                            f"got {r.replicas})"
+                            f"got {r.replicas}"
+                            + (f"; elastic allows {sorted(allowed)}"
+                               if self.elastic is not None else "")
+                            + ")"
                         )
 
     # -- defaulting (reference SetDefaults(), tf_job.go:236-301) ------------
@@ -725,6 +863,12 @@ class TpuJobSpec(K8sObject):
             self.restart_backoff = RestartBackoffSpec()
         if self.scheduling is not None and not self.scheduling.queue:
             self.scheduling.queue = "default"
+        if self.elastic is not None and self.tpu is not None:
+            # normalize the DP bounds once (the serving-bounds pattern)
+            # so everything downstream reads concrete numbers
+            lo, hi = self.elastic.bounds(self.tpu.num_slices)
+            self.elastic.min_dp_degree = lo
+            self.elastic.max_dp_degree = hi
 
     # -- accelerator config (reference ConfigureAccelerators, tf_job.go:179-233)
 
@@ -865,6 +1009,11 @@ class TpuJobPhase:
     QUEUED = "Queued"
     CREATING = "Creating"
     RUNNING = "Running"
+    # Elastic gang resize in flight (docs/ELASTIC.md): the old gang is
+    # flush-torn-down and the next tick materializes the new DP
+    # degree's footprint — a first-class transition, not a restart
+    # that happens to change shape.
+    RESIZING = "Resizing"
     CLEANUP = "CleanUp"
     FAILED = "Failed"
     DONE = "Done"
@@ -916,6 +1065,11 @@ class TpuJobStatus(K8sObject):
     # serving fleets: the CURRENT autoscaled engine-replica count
     # (0 = not a serving job / not yet reconciled)
     serving_replicas: int = 0
+    # elastic gangs: the CURRENT data-parallel degree in slices
+    # (0 = never resized — the spec's tpu.numSlices is the shape).
+    # Persisted so adoption/re-admission materializes the resized
+    # width, not the original one (docs/ELASTIC.md).
+    dp_degree: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def is_failed(self) -> bool:

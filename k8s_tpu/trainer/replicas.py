@@ -122,6 +122,12 @@ class RendezvousSpec:
     # program round trip as checkpointPolicy, so a program can see the
     # terms it runs under
     sched_env: Optional[Dict[str, str]] = None
+    # elastic-resize terms (spec.elastic, docs/ELASTIC.md):
+    # KTPU_ELASTIC_MIN_DP/_MAX_DP/_RESIZE — the same round trip, so a
+    # program can see its world may be re-partitioned under it (e.g.
+    # checkpointing more aggressively); the CURRENT degree already
+    # rides KTPU_NUM_PROCESSES / MEGASCALE_NUM_SLICES
+    elastic_env: Optional[Dict[str, str]] = None
 
     def to_env(self) -> Dict[str, str]:
         env = {
@@ -152,6 +158,8 @@ class RendezvousSpec:
             env.update(self.obs_env)
         if self.sched_env:
             env.update(self.sched_env)
+        if self.elastic_env:
+            env.update(self.elastic_env)
         return env
 
 
@@ -256,11 +264,22 @@ class TpuReplicaSet:
         ``maxReplicas`` range up front: stable DNS over the full scale
         range means the router's baked peer list survives scale events
         (its poller marks not-yet-scaled indices down and picks them
-        up the moment their pods answer)."""
+        up the moment their pods answer). Elastic gangs get the same
+        treatment over the ``maxDpDegree`` range (docs/ELASTIC.md):
+        resize events never churn DNS, so the checkpoint peer wire and
+        the obs endpoints keep their addresses across shrink/grow."""
         n = self.spec.replicas or 0
         serving = self.job.job.spec.serving
         if serving is not None and self.spec.replica_type == WORKER:
             return max(n, serving.bounds()[1])
+        elastic = self.job.job.spec.elastic
+        tpu = self.job.job.spec.tpu
+        if (elastic is not None and tpu is not None
+                and self.spec.replica_type == WORKER):
+            t = tpu.topology()
+            if t is not None:
+                hi = elastic.bounds(max(1, tpu.num_slices))[1]
+                return max(n, t.num_hosts * hi)
         return n
 
     # ------------------------------------------------------------- create
@@ -433,6 +452,13 @@ class TpuReplicaSet:
         num_processes = max(1, len(workers))
         tpu = job.job.spec.tpu
         num_slices = tpu.num_slices if tpu else 1
+        if job.job.spec.elastic is not None:
+            # elastic gangs rendezvous at their CURRENT DP degree (the
+            # last resize's target), not the spec's original width —
+            # the mesh the launcher builds must match the world size
+            cd = getattr(job, "current_dp", None)
+            if callable(cd):
+                num_slices = cd()
         hosts_per_slice = max(1, num_processes // max(1, num_slices))
         if self.spec.replica_type == WORKER:
             process_id = index
@@ -469,6 +495,11 @@ class TpuReplicaSet:
             ),
             obs_env=self._obs_env(index),
             sched_env=self._sched_env(),
+            elastic_env=(
+                job.job.spec.elastic.to_env()
+                if job.job.spec.elastic is not None
+                and self.spec.replica_type == WORKER else None
+            ),
         )
 
     def _serving_rendezvous(self, index: int) -> RendezvousSpec:

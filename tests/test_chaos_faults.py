@@ -943,3 +943,213 @@ class TestSchedPreemptFault:
         from k8s_tpu.obs import trace as obs_trace
 
         obs_trace.arm_slow_host(0.0, steps=0)
+
+
+# ---------------------------------------------------------------------------
+# permanent-pod-loss fault (docs/ELASTIC.md)
+# ---------------------------------------------------------------------------
+
+
+class _PuppetPods:
+    """Pods run until finished by name prefix (teardown stop → 143) —
+    the chaos fault needs a RUNNING pod to kill, and the test then
+    releases the victim's executor so the kubelet reports the external
+    kill (the same surface the resize reconciler tests use)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.live = []
+
+    def execute(self, pod, env, stop):
+        ev = threading.Event()
+        code = [143]
+        entry = (pod.metadata.name, ev, code)
+        with self.lock:
+            self.live.append(entry)
+        try:
+            while not stop.is_set() and not ev.is_set():
+                ev.wait(0.02)
+            return code[0] if ev.is_set() else 143
+        finally:
+            with self.lock:
+                self.live.remove(entry)
+
+    def live_count(self, prefix):
+        with self.lock:
+            return sum(1 for n, ev, _ in self.live
+                       if n.startswith(prefix) and not ev.is_set())
+
+    def finish(self, prefix, code):
+        n = 0
+        with self.lock:
+            for name, ev, c in self.live:
+                if name.startswith(prefix) and not ev.is_set():
+                    c[0] = code
+                    ev.set()
+                    n += 1
+        return n
+
+
+class TestPermanentPodLossFault:
+    """The ``permanent-pod-loss`` chaos fault: one elastic gang worker
+    dies AND its slice leaves the inventory — restore-in-place can
+    never place, only the elastic shrink saves the job; the fault's
+    heal ticks return the capacity and drive the grow half."""
+
+    @staticmethod
+    def _elastic_job(name):
+        j = S.TpuJob()
+        j.metadata.name = name
+        j.metadata.namespace = "default"
+        j.spec.max_gang_restarts = 4
+        j.spec.tpu = S.TpuSpec(accelerator="cpu-1", num_slices=2)
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER", replicas=None)]
+        j.spec.elastic = S.ElasticSpec(
+            min_dp_degree=1, max_dp_degree=2,
+            grow_hold_seconds=0.2, cooldown_seconds=0.2)
+        return j
+
+    def test_fault_drives_shrink_then_heal_drives_grow(self):
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.chaos import PermanentPodLossFault
+        from k8s_tpu.runtime.kubelet import LocalKubelet
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        controller = Controller(
+            client, jc,
+            S.ControllerConfig(fleet={"cpu-1": 2},
+                               scheduler_cooldown_seconds=0.2),
+            reconcile_interval=0.05, sched_interval=0.05)
+
+        def fetcher_factory(tj):
+            tick = {"n": 0}
+
+            def fetch():
+                tick["n"] += 1
+                w = tj.job.spec.replica_spec("WORKER")
+                return {i: {"step": tick["n"]}
+                        for i in range(w.replicas or 0)} or None
+            return fetch
+
+        controller.worker_stats_fetcher_factory = fetcher_factory
+        ex = _PuppetPods()
+        kubelet = LocalKubelet(client, ex)
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(self._elastic_job("chaosel"))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if (jc.get("default", "chaosel").status.phase
+                        == S.TpuJobPhase.RUNNING):
+                    break
+                time.sleep(0.02)
+            rid = jc.get("default", "chaosel").spec.runtime_id
+            inv = controller.scheduler.inventory
+
+            fault = PermanentPodLossFault(controller, rate=1.0, seed=3,
+                                          heal_after_ticks=2)
+            fired = fault.fire()
+            assert fired is not None and "-1 cpu-1 slice" in fired
+            assert inv.capacity("cpu-1") == 1  # slice revoked
+            victim_pod = fired.split(" ")[0]
+            # the killed process exits; the kubelet reports the
+            # external 137 and the reconciler must resize, not restart
+            ex.finish(victim_pod, 143)
+            deadline = time.monotonic() + 20
+            job = None
+            while time.monotonic() < deadline:
+                job = jc.get("default", "chaosel")
+                if job.status.dp_degree == 1:
+                    break
+                time.sleep(0.02)
+            assert job is not None and job.status.dp_degree == 1, (
+                job.status.to_dict())
+            assert any(c.type == "GangResized"
+                       for c in job.status.conditions)
+
+            # heal ticks return the capacity → the gang grows back
+            fault.rate = 0.0  # heal without re-firing
+            for _ in range(3):
+                fault.maybe_fire()
+            assert inv.capacity("cpu-1") == 2
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                job = jc.get("default", "chaosel")
+                if job.status.dp_degree == 2:
+                    break
+                time.sleep(0.02)
+            assert job.status.dp_degree == 2, job.status.to_dict()
+
+            # and still runs to completion at full width
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if ex.live_count(f"chaosel-worker-{rid}-") == 2:
+                    break
+                time.sleep(0.02)
+            assert ex.finish(f"chaosel-worker-{rid}-", 0) == 2
+            job = controller.wait_for_job("default", "chaosel",
+                                          timeout=30)
+            assert job.status.state == S.TpuJobState.SUCCEEDED
+            assert inv.max_used["cpu-1"] == 2  # never double-owned
+        finally:
+            controller.stop()
+            kubelet.stop()
+
+    def test_fault_noop_guards(self):
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.chaos import PermanentPodLossFault
+
+        cluster = InMemoryCluster()
+        # no scheduler at all
+        c1 = Controller(KubeClient(cluster), TpuJobClient(cluster),
+                        S.ControllerConfig())
+        assert PermanentPodLossFault(c1, rate=1.0, seed=1).fire() is None
+        # scheduler but no elastic jobs
+        c2 = Controller(KubeClient(cluster), TpuJobClient(cluster),
+                        S.ControllerConfig(fleet={"cpu-1": 2}))
+        assert PermanentPodLossFault(c2, rate=1.0, seed=1).fire() is None
+
+    def test_fault_never_fires_at_the_dp_floor(self):
+        """A job already at minDpDegree can only FAIL from another
+        loss — the fault must skip it (it exercises nothing)."""
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.chaos import PermanentPodLossFault
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        controller = Controller(client, jc,
+                                S.ControllerConfig(fleet={"cpu-1": 2}))
+        j = self._elastic_job("floor")
+        j.spec.tpu.num_slices = 1  # already at minDpDegree
+        j.spec.elastic.max_dp_degree = 2
+        tj = TrainingJob(client, jc, j)
+        tj.setup(S.ControllerConfig())
+        tj._thread = threading.current_thread()  # reads as alive
+        controller.jobs[j.key] = tj
+        fault = PermanentPodLossFault(controller, rate=1.0, seed=1)
+        assert fault.fire() is None
+
+    def test_level_3_with_scheduler_adds_permanent_pod_loss(self):
+        from k8s_tpu.controller.controller import Controller
+
+        faulty = FaultyCluster(InMemoryCluster())
+        client = KubeClient(faulty)
+        controller = Controller(client, TpuJobClient(faulty),
+                                S.ControllerConfig(fleet={"cpu-1": 1}))
+        m = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty,
+                                   scheduler=controller)
+        assert "permanent-pod-loss" in sorted(
+            i.name for i in m.injectors)
+        m2 = ChaosMonkey.from_level(client, 3, seed=1, faulty=faulty)
+        assert "permanent-pod-loss" not in sorted(
+            i.name for i in m2.injectors)
+        ckpt_mod.arm_save_faults(0)
+        from k8s_tpu.obs import trace as obs_trace
+
+        obs_trace.arm_slow_host(0.0, steps=0)

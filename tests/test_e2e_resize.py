@@ -1,0 +1,326 @@
+"""Elastic-resize flagship e2e (docs/ELASTIC.md) over REAL subprocess
+trainers: a 2-process DP gang (cpu-1 × 2 slices, FSDP inside each
+slice) suffers ``permanent-pod-loss`` mid-run — one worker SIGKILLed
+AND its slice revoked from the scheduler inventory, so restore-in-place
+can never place again. The operator drives the ``Resizing`` transition:
+shrink to DP=1, restore from the survivor's + flushed local shards
+(lost steps bounded by the local interval), train on at half width;
+when the inventory frees the slice again the gang grows back to DP=2 —
+the DP=1 incarnation's teardown flush is the grow restore point
+(restore step == flush step), with the fresh worker pulling every shard
+it needs from its peer's tier. The job Succeeds at full width with
+``GangResized`` events naming BOTH transitions, the mesh event's ``dp``
+tracking 2→1→2, and the ledger's high-water mark proving the slice was
+never double-owned across the cycle.
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.api.objects import Container, EnvVar, PodSpec, PodTemplateSpec
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.obs.events import events_of
+from k8s_tpu.runtime.kubelet import (
+    LocalKubelet,
+    LocalServiceResolver,
+    SubprocessExecutor,
+)
+from k8s_tpu import spec as S
+
+OBS_PORT = 8790
+LOCAL_EVERY = 5  # local checkpoint interval: the shrink's loss bound
+
+
+def _worker_log(tmp_path, name, rid, idx=0):
+    import glob
+
+    pats = glob.glob(
+        str(tmp_path / "logs" / f"{name}-worker-{rid}-{idx}-pod-*.log"))
+    return "\n".join(open(p).read() for p in sorted(pats))
+
+
+def _all_logs(tmp_path):
+    import glob
+
+    return "\n".join(
+        f"--- {p} ---\n" + open(p).read()
+        for p in glob.glob(str(tmp_path / "logs" / "*.log")))
+
+
+def _xfail_if_glibc_heap_bug(logs: str) -> None:
+    """Same guard every restore-then-continue e2e carries on this
+    container (see test_e2e_distributed)."""
+    if ("malloc_consolidate" in logs
+            or "corrupted double-linked list" in logs
+            or "malloc(): invalid" in logs
+            or "double free or corruption" in logs
+            or "free(): invalid" in logs):
+        pytest.xfail("glibc heap corruption in restored worker "
+                     "(jax 0.4.x CPU collectives)")
+
+
+def _proc_env(pid):
+    with open(f"/proc/{pid}/environ", "rb") as f:
+        return dict(
+            kv.split("=", 1) for kv in
+            f.read().decode(errors="replace").split("\0") if "=" in kv)
+
+
+@pytest.mark.integration
+def test_permanent_loss_resize_shrink_grow_e2e(tmp_path):
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    resolver = LocalServiceResolver()
+    executor = SubprocessExecutor(
+        log_dir=str(tmp_path / "logs"),
+        extra_env={
+            "KTPU_FORCE_PLATFORM": "cpu",
+            "KTPU_NUM_CPU_DEVICES": "2",
+            "KTPU_INIT_TIMEOUT": "60",
+            # this container's escape hatch (train/checkpoint.py):
+            # orbax's background save thread is heap-unsafe on this
+            # jax 0.4.x runtime
+            "KTPU_SYNC_CHECKPOINT": "1",
+        },
+    )
+    kubelet = LocalKubelet(client, executor, resolver=resolver)
+    config = S.ControllerConfig(fleet={"cpu-1": 2},
+                                scheduler_cooldown_seconds=0.5)
+    controller = Controller(client, jc, config,
+                            reconcile_interval=0.2, sched_interval=0.1)
+
+    def fetcher_factory(tj):
+        # cluster-DNS stand-in only: heartbeats come over real HTTP
+        # from the real trainer subprocesses, one poll per live index
+        def fetch():
+            rid = tj.job.spec.runtime_id
+            obs = tj.job.spec.observability
+            w = tj.job.spec.replica_spec("WORKER")
+            if not rid or obs is None or not obs.obs_port or w is None:
+                return None
+            out = {}
+            for i in range(w.replicas or 0):
+                port = resolver.port_for(
+                    f"resz-worker-{rid}-{i}", obs.obs_port)
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz",
+                            timeout=2) as r:
+                        payload = json.loads(r.read())
+                    hbt = payload.get("obs")
+                    if isinstance(hbt, dict):
+                        if isinstance(payload.get("ckpt"), dict):
+                            hbt = {**hbt, "ckpt": payload["ckpt"]}
+                        out[i] = hbt
+                except Exception:
+                    pass
+            return out or None
+        return fetch
+
+    controller.worker_stats_fetcher_factory = fetcher_factory
+    kubelet.start()
+    controller.start()
+    try:
+        j = S.TpuJob()
+        j.metadata.name = "resz"
+        j.metadata.namespace = "default"
+        j.spec.max_gang_restarts = 8  # 2 resizes + glibc-abort slack
+        j.spec.tpu = S.TpuSpec(accelerator="cpu-1", num_slices=2)
+        j.spec.elastic = S.ElasticSpec(
+            min_dp_degree=1, max_dp_degree=2,
+            grow_hold_seconds=0.5, cooldown_seconds=0.5,
+            dead_after_seconds=30.0)  # the inventory trigger drives this e2e
+        j.spec.scheduling = S.SchedulingSpec(priority=0)
+        # local tier ONLY: with a durable tier the two-tier flush would
+        # let the grown gang restore from orbax at the same step (the
+        # planner's equal-step durable preference) — the scratch-tier
+        # deployment shape forces the fresh worker through the honest
+        # union/peer-wire path this e2e exists to prove
+        j.spec.checkpoint_policy = S.CheckpointPolicySpec(
+            local_dir=str(tmp_path / "local"),
+            local_interval_steps=LOCAL_EVERY)
+        j.spec.observability = S.ObservabilitySpec(
+            obs_port=OBS_PORT, straggler_profile_seconds=0.0)
+        args = ("--steps=40 --batch_size=4 --log_every=1 "
+                "--strategy=fsdp --seq_len=32 --step_sleep=0.2")
+        j.spec.replica_specs = [S.TpuReplicaSpec(
+            replica_type="WORKER",
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(
+                name="jax", image="i",
+                command=["python", "-m", "k8s_tpu.launcher.spmd_launcher"],
+                env=[
+                    EnvVar(name="KTPU_PROGRAM",
+                           value="k8s_tpu.programs.llama_train:main"),
+                    EnvVar(name="KTPU_PROGRAM_ARGS", value=args),
+                ],
+            )])),
+        )]
+        jc.create(j)
+
+        # ---- phase 1: the DP=2 gang trains past a local save --------
+        tj = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            tj = controller.jobs.get("default/resz")
+            if tj is not None:
+                break
+            time.sleep(0.05)
+        assert tj is not None, "resz never admitted"
+        rid = None
+        deadline = time.monotonic() + 240
+        step_seen = 0
+        while time.monotonic() < deadline:
+            cur = jc.get("default", "resz")
+            rid = cur.spec.runtime_id or rid
+            stats = tj._last_worker_stats or {}
+            step_seen = max([int(h.get("step", 0) or 0)
+                             for h in stats.values()] + [0])
+            if step_seen >= LOCAL_EVERY + 3:
+                break
+            assert not tj.finished, (
+                "finished before the fault\n" + _all_logs(tmp_path))
+            time.sleep(0.1)
+        assert step_seen >= LOCAL_EVERY + 3, _all_logs(tmp_path)
+        log0 = _worker_log(tmp_path, "resz", rid, 0)
+        mesh_evs = events_of(log0, "mesh")
+        assert mesh_evs and mesh_evs[0]["dp"] == 2, mesh_evs
+
+        # ---- phase 2: permanent-pod-loss ----------------------------
+        # worker 1 dies abruptly AND its slice leaves the fleet: the
+        # kill lands first (the node dropped dead), the revocation a
+        # beat later (well inside the reconciler's degraded-detection
+        # window) — restore-in-place can never place again
+        inv = controller.scheduler.inventory
+        victims = [p for p in executor._procs if p.poll() is None]
+        slice1 = [p for p in victims
+                  if _proc_env(p.pid).get("KTPU_PROCESS_ID") == "1"
+                  and _proc_env(p.pid).get("KTPU_NUM_PROCESSES") == "2"]
+        assert slice1, "no live worker-1 process to kill"
+        os.kill(slice1[-1].pid, signal.SIGKILL)
+        inv.set_capacity("cpu-1", 1)
+
+        # ---- phase 3: shrink to DP=1, restore, train on -------------
+        deadline = time.monotonic() + 120
+        job = None
+        while time.monotonic() < deadline:
+            job = jc.get("default", "resz")
+            if job.status.dp_degree == 1:
+                break
+            time.sleep(0.1)
+        assert job is not None and job.status.dp_degree == 1, (
+            _all_logs(tmp_path))
+        assert any(c.type == "GangResized" and "DP=2 -> DP=1" in c.reason
+                   for c in job.status.conditions), job.status.to_dict()
+        assert inv.used("cpu-1") == 1  # the ledger shrank with the gang
+
+        # the DP=1 incarnation restores from the survivor's newest
+        # local evidence: lost steps bounded by the local interval
+        deadline = time.monotonic() + 240
+        restores = []
+        while time.monotonic() < deadline:
+            log0 = _worker_log(tmp_path, "resz", rid, 0)
+            restores = events_of(log0, "ckpt_restore")
+            if restores:
+                break
+            time.sleep(0.2)
+        if not restores:
+            _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+        assert restores, "no ckpt_restore after shrink:\n" + _all_logs(
+            tmp_path)
+        shrink_restore = restores[0]
+        assert shrink_restore["step"] >= step_seen - LOCAL_EVERY - 1, (
+            shrink_restore, step_seen)
+        assert 0 <= shrink_restore["lost_steps"] <= LOCAL_EVERY + 2, (
+            shrink_restore)
+        assert shrink_restore["source"] in ("local", "local+peer")
+        # the re-derived world: mesh event from the DP=1 incarnation
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            log0 = _worker_log(tmp_path, "resz", rid, 0)
+            mesh_evs = events_of(log0, "mesh")
+            if len(mesh_evs) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(mesh_evs) >= 2 and mesh_evs[1]["dp"] == 1, mesh_evs
+
+        # let the half-width gang make real progress past the restore
+        target = shrink_restore["step"] + 3
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            log0 = _worker_log(tmp_path, "resz", rid, 0)
+            if f'"step": {target}' in log0:
+                break
+            assert not jc.get("default", "resz").status.is_failed(), (
+                _all_logs(tmp_path))
+            time.sleep(0.2)
+        assert f'"step": {target}' in log0, _all_logs(tmp_path)
+
+        # ---- phase 4: capacity returns, grow back to DP=2 -----------
+        inv.set_capacity("cpu-1", 2)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            job = jc.get("default", "resz")
+            if job.status.dp_degree == 2:
+                break
+            time.sleep(0.1)
+        assert job.status.dp_degree == 2, _all_logs(tmp_path)
+        assert any(c.type == "GangResized" and "DP=1 -> DP=2" in c.reason
+                   for c in job.status.conditions), job.status.to_dict()
+
+        # ---- phase 5: Succeeds at full width ------------------------
+        job = controller.wait_for_job("default", "resz", timeout=300)
+        if job.status.state != S.TpuJobState.SUCCEEDED:
+            _xfail_if_glibc_heap_bug(_all_logs(tmp_path))
+        assert job.status.state == S.TpuJobState.SUCCEEDED, (
+            json.dumps(job.status.to_dict(), indent=1)
+            + _all_logs(tmp_path))
+        log0 = _worker_log(tmp_path, "resz", rid, 0)
+        assert '"step": 40' in log0, log0
+
+        # the grow restore point IS the DP=1 teardown flush: the single
+        # surviving process flushed at its current step on SIGTERM and
+        # the DP=2 gang restored exactly there
+        flushes = events_of(log0, "preempt_checkpoint")
+        restores = events_of(log0, "ckpt_restore")
+        assert flushes, "no teardown flush in worker 0:\n" + log0
+        grow_restore = restores[-1]
+        assert grow_restore["step"] == flushes[-1]["step"], (
+            flushes, restores)
+        # the fresh worker 1 of the grown gang had no shards of its own
+        # at that step — every one came over the peer wire from the
+        # survivor's tier (union restore across the resize)
+        log1 = _worker_log(tmp_path, "resz", rid, 1)
+        r1 = events_of(log1, "ckpt_restore")
+        assert r1, "no ckpt_restore in grown worker 1:\n" + log1
+        assert r1[-1]["step"] == grow_restore["step"]
+        assert r1[-1]["peer_shards"] > 0 or \
+            r1[-1]["source"] == "local+peer", r1
+
+        # the mesh re-derived at every width: dp tracked 2 -> 1 -> 2
+        dps = [e["dp"] for e in events_of(log0, "mesh")]
+        assert dps[:1] == [2] and 1 in dps and dps[-1] == 2, dps
+
+        # GangResized events named both transitions
+        evs = [e.message for e in client.events.list("default")
+               if e.reason == "GangResized"]
+        assert any("DP=2 -> DP=1" in m for m in evs), evs
+        assert any("DP=1 -> DP=2" in m for m in evs), evs
+
+        # ---- the ledger: two slices, never double-owned -------------
+        assert inv.max_used["cpu-1"] == 2
+        assert inv.used("cpu-1") == 0
+        # both resizes were budget-counted (extra gang restarts only
+        # from the documented glibc abort class on this container)
+        assert job.status.gang_restarts >= 2
+    finally:
+        controller.stop()
+        kubelet.stop()

@@ -1,0 +1,780 @@
+"""Elastic gang resize (docs/ELASTIC.md): the pure decision-core
+matrix on an injected clock, the atomic scheduler-ledger recharge, the
+``elastic:`` spec round trips, and the controller-level
+shrink → grow → Succeeded reconciler flow.
+
+The flagship subprocess e2e (a REAL 2-process gang surviving
+permanent-pod-loss at DP=1 and growing back) lives in
+``tests/test_e2e_resize.py``.
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.cluster import InMemoryCluster
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.resize import ElasticResizer
+from k8s_tpu.runtime.kubelet import LocalKubelet
+from k8s_tpu.sched import (
+    ClusterScheduler,
+    Footprint,
+    JobRequest,
+    OversubscriptionError,
+    SliceInventory,
+)
+from k8s_tpu import spec as S
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, d: float) -> None:
+        self.t += d
+
+
+def hb(step: int) -> dict:
+    return {"step": step}
+
+
+# ---------------------------------------------------------------------------
+# decision core (pure, injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticResizer:
+    def mk(self, clock, min_dp=1, max_dp=2, **kw):
+        kw.setdefault("dead_after_s", 5.0)
+        kw.setdefault("grow_hold_s", 5.0)
+        kw.setdefault("cooldown_s", 10.0)
+        return ElasticResizer(min_dp, max_dp, clock=clock, **kw)
+
+    def test_bad_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            ElasticResizer(0, 2)
+        with pytest.raises(ValueError):
+            ElasticResizer(3, 2)
+
+    def test_shrink_on_inventory_loss_is_decisive(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        # no dead-heartbeat window needed: the ledger already knows
+        v = r.observe(dp=2, hosts=2, stats={0: hb(5), 1: hb(5)},
+                      attainable=1)
+        assert v.action == "shrink" and v.target_dp == 1
+        assert "inventory" in v.reason
+
+    def test_shrink_below_floor_refused(self):
+        clock = FakeClock()
+        r = self.mk(clock, min_dp=2, max_dp=4)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(1)}, attainable=1)
+        assert v.action is None
+        assert "minDpDegree" in v.reason
+
+    def test_dead_heartbeat_shrinks_after_window_only(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        # both answer at t=0
+        assert r.observe(dp=2, hosts=2,
+                         stats={0: hb(1), 1: hb(1)}).action is None
+        clock.advance(3.0)  # host 1 silent, but under the window
+        v = r.observe(dp=2, hosts=2, stats={0: hb(4)})
+        assert v.action is None
+        clock.advance(3.0)  # 6s silent >= 5s window, peer alive
+        v = r.observe(dp=2, hosts=2, stats={0: hb(7)})
+        assert v.action == "shrink" and v.target_dp == 1
+        assert v.dead_hosts == (1,)
+
+    def test_whole_gang_silence_is_not_permanent_loss(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        r.observe(dp=2, hosts=2, stats={0: hb(1), 1: hb(1)})
+        clock.advance(60.0)
+        # nobody answers: an outage or a restart in flight — the gang
+        # restart path owns this, not the resizer
+        assert r.observe(dp=2, hosts=2, stats={}).action is None
+
+    def test_never_seen_host_is_starting_not_dead(self):
+        """A host that never answered this episode is STARTING — pod
+        scheduling/image pulls routinely exceed any honest silence
+        window, so a slow boot must never read as permanent loss (an
+        actually-failed pod surfaces through the degraded-pod path,
+        a revoked slice through the inventory trigger)."""
+        clock = FakeClock()
+        r = self.mk(clock)
+        r.observe(dp=2, hosts=2, stats={0: hb(1)})  # host 1 never seen
+        clock.advance(60.0)  # way past any window
+        assert r.observe(dp=2, hosts=2, stats={0: hb(2)}).action is None
+        # once it answers and THEN goes silent, the window applies
+        r.observe(dp=2, hosts=2, stats={0: hb(3), 1: hb(3)})
+        clock.advance(6.0)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(4)})
+        assert v.action == "shrink" and v.dead_hosts == (1,)
+
+    def test_multi_host_slices_count_whole_slices(self):
+        clock = FakeClock()
+        r = self.mk(clock, min_dp=1, max_dp=4)
+        # 2 hosts/slice, 4 slices = 8 hosts, all seen once; then hosts
+        # 2 and 3 (slice 1) go silent together
+        r.observe(dp=4, hosts=8, stats={h: hb(1) for h in range(8)})
+        clock.advance(6.0)
+        v = r.observe(dp=4, hosts=8,
+                      stats={h: hb(2) for h in range(8) if h not in (2, 3)})
+        assert v.action == "shrink" and v.target_dp == 3
+        assert v.dead_hosts == (2, 3)
+
+    def test_grow_requires_sustained_hold(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        v = r.observe(dp=1, hosts=1, stats={0: hb(1)}, attainable=2)
+        assert v.action is None and "holding" in v.reason
+        clock.advance(6.0)
+        v = r.observe(dp=1, hosts=1, stats={0: hb(2)}, attainable=2)
+        assert v.action == "grow" and v.target_dp == 2
+
+    def test_grow_blip_resets_the_hold(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        r.observe(dp=1, hosts=1, stats={0: hb(1)}, attainable=2)
+        clock.advance(3.0)
+        # capacity dips back: the hold must re-arm from scratch
+        r.observe(dp=1, hosts=1, stats={0: hb(2)}, attainable=1)
+        clock.advance(3.0)
+        v = r.observe(dp=1, hosts=1, stats={0: hb(3)}, attainable=2)
+        assert v.action is None  # fresh hold just started
+        clock.advance(4.0)
+        assert r.observe(dp=1, hosts=1, stats={0: hb(4)},
+                         attainable=2).action is None
+        clock.advance(2.0)
+        assert r.observe(dp=1, hosts=1, stats={0: hb(5)},
+                         attainable=2).action == "grow"
+
+    def test_grow_capped_at_max_dp(self):
+        clock = FakeClock()
+        r = self.mk(clock, min_dp=1, max_dp=2)
+        assert r.observe(dp=2, hosts=2, stats={0: hb(1), 1: hb(1)},
+                         attainable=5).action is None  # already at max
+        r2 = self.mk(clock, min_dp=1, max_dp=3)
+        r2.observe(dp=1, hosts=1, stats={0: hb(1)}, attainable=5)
+        clock.advance(6.0)
+        v = r2.observe(dp=1, hosts=1, stats={0: hb(2)}, attainable=5)
+        assert v.action == "grow" and v.target_dp == 3  # capped
+
+    def test_cooldown_blocks_grow_and_dead_host_not_inventory(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        r.note_resized(2)
+        # grow held by the cooldown
+        v = r.observe(dp=2, hosts=2, stats={0: hb(1), 1: hb(1)},
+                      attainable=3)
+        assert v.action is None and "cooldown" in v.reason
+        # dead-host evidence held by the cooldown too (seen once, then
+        # silent past the window, still inside the cooldown)
+        clock.advance(6.0)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(2)})
+        assert v.action is None and "cooldown" in v.reason
+        # ...but the INVENTORY shrink is decisive and bypasses it: the
+        # capacity is gone, a same-shape restart could never place
+        v = r.observe(dp=2, hosts=2, stats={0: hb(3)}, attainable=1)
+        assert v.action == "shrink" and v.trigger == "inventory"
+
+    def test_grow_blocked_by_budget_keeps_shape(self):
+        """A blocked GROW must never hurt the running gang: the job
+        keeps training at its current width — only a shrink the budget
+        cannot back turns terminal."""
+        clock = FakeClock()
+        r = self.mk(clock)
+        r.observe(dp=1, hosts=1, stats={0: hb(1)}, attainable=2,
+                  budget_left=0)
+        clock.advance(6.0)  # past the grow hold
+        v = r.observe(dp=1, hosts=1, stats={0: hb(2)}, attainable=2,
+                      budget_left=0)
+        assert v.action is None
+        assert "budget" in v.reason
+
+    def test_health_ceiling_follows_restore_regression(self):
+        """A restore regresses the observed step; the last-healthy
+        tracker must follow it DOWN, or a stale pre-resize high-water
+        mark would exclude nothing of the new run's poisoned window."""
+        clock = FakeClock()
+        r = self.mk(clock)
+        ok = {"loss": 1.0, "grad_norm": 0.5, "nonfinite_grads": 0}
+        r.observe(dp=2, hosts=2, stats={0: hb(100)},
+                  health={"step": 100, **ok})
+        # resize + restore landed at step 60; healthy obs resumes there
+        r.observe(dp=1, hosts=1, stats={0: hb(60)},
+                  health={"step": 60, **ok})
+        v = r.observe(dp=1, hosts=1, stats={0: hb(70)}, attainable=0,
+                      health={"step": 70, "loss": math.nan,
+                              "grad_norm": math.nan,
+                              "nonfinite_grads": 1})
+        assert v.restore_ceiling == 60  # NOT the stale 100
+
+    def test_note_resized_clears_stale_host_evidence(self):
+        clock = FakeClock()
+        r = self.mk(clock, cooldown_s=2.0)  # cooldown < dead window
+        r.observe(dp=2, hosts=2, stats={0: hb(1), 1: hb(1)})
+        clock.advance(6.0)  # host 1 would be dead...
+        r.note_resized(2)
+        clock.advance(10.0)  # past cooldown AND the window — but the
+        # episode is fresh: host 1 is a STARTING host of the new gang,
+        # not the old one's corpse (a grown gang's pod must get its
+        # whole boot time)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(2)})
+        assert v.action is None
+        # it answers once, then goes silent: the window applies anew
+        r.observe(dp=2, hosts=2, stats={0: hb(3), 1: hb(3)})
+        clock.advance(6.0)
+        assert r.observe(dp=2, hosts=2,
+                         stats={0: hb(4)}).action == "shrink"
+
+    def test_health_gate_sets_restore_ceiling(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(5), 1: hb(5)},
+                      health={"step": 5, "loss": 1.0, "grad_norm": 0.5,
+                              "nonfinite_grads": 0})
+        assert v.restore_ceiling is None  # healthy: no ceiling
+        v = r.observe(dp=2, hosts=2, stats={0: hb(7), 1: hb(7)},
+                      attainable=1,
+                      health={"step": 7, "loss": math.nan,
+                              "grad_norm": math.nan,
+                              "nonfinite_grads": 3})
+        assert v.action == "shrink"
+        assert v.restore_ceiling == 5  # the last HEALTHY step
+
+    def test_budget_exhaustion(self):
+        clock = FakeClock()
+        r = self.mk(clock)
+        v = r.observe(dp=2, hosts=2, stats={0: hb(1), 1: hb(1)},
+                      attainable=1, budget_left=0)
+        assert v.action == "exhausted"
+        assert "budget" in v.reason
+
+    def test_resize_on_permanent_loss_false_never_shrinks(self):
+        clock = FakeClock()
+        r = self.mk(clock, resize_on_permanent_loss=False)
+        assert r.observe(dp=2, hosts=2, stats={0: hb(1)},
+                         attainable=1).action is None
+        clock.advance(60.0)
+        assert r.observe(dp=2, hosts=2, stats={0: hb(2)},
+                         attainable=1).action is None
+        # ...but growth back to capacity still works
+        r2 = self.mk(clock, resize_on_permanent_loss=False)
+        r2.observe(dp=1, hosts=1, stats={0: hb(1)}, attainable=2)
+        clock.advance(6.0)
+        assert r2.observe(dp=1, hosts=1, stats={0: hb(2)},
+                          attainable=2).action == "grow"
+
+
+# ---------------------------------------------------------------------------
+# scheduler ledger: atomic recharge
+# ---------------------------------------------------------------------------
+
+
+def fp(slices, accel="cpu-1"):
+    return Footprint(accel, slices=slices, chips=slices)
+
+
+class TestLedgerRecharge:
+    def test_shrink_frees_atomically(self):
+        inv = SliceInventory({"cpu-1": 2})
+        inv.charge("j", fp(2))
+        inv.recharge("j", fp(1))
+        assert inv.used("cpu-1") == 1
+        assert inv.holder("j").slices == 1
+        # the high-water mark never saw 2+1: the swap is one section
+        assert inv.max_used["cpu-1"] == 2
+
+    def test_grow_within_capacity(self):
+        inv = SliceInventory({"cpu-1": 2})
+        inv.charge("j", fp(1))
+        inv.recharge("j", fp(2))
+        assert inv.used("cpu-1") == 2
+        assert inv.max_used["cpu-1"] == 2  # never 1+2
+
+    def test_grow_refused_keeps_old_charge(self):
+        inv = SliceInventory({"cpu-1": 2})
+        inv.charge("j", fp(1))
+        inv.charge("k", fp(1))
+        with pytest.raises(OversubscriptionError):
+            inv.recharge("j", fp(2))
+        assert inv.used("cpu-1") == 2
+        assert inv.holder("j").slices == 1  # rolled back untouched
+
+    def test_capacity_listener_fires_on_return_only(self):
+        inv = SliceInventory({"cpu-1": 2})
+        seen = []
+        inv.on_capacity(seen.append)
+        inv.charge("j", fp(2))
+        assert seen == []  # charging frees nothing
+        inv.release("j")
+        assert seen == ["cpu-1"]
+        inv.set_capacity("cpu-1", 1)  # pool shrink: not a return
+        assert seen == ["cpu-1"]
+        inv.set_capacity("cpu-1", 3)  # pool growth IS a return
+        assert seen == ["cpu-1", "cpu-1"]
+
+    def test_recharge_shrink_notifies_listeners(self):
+        inv = SliceInventory({"cpu-1": 2})
+        inv.charge("j", fp(2))
+        seen = []
+        inv.on_capacity(seen.append)
+        inv.recharge("j", fp(1))
+        assert seen == ["cpu-1"]
+        inv.recharge("j", fp(2))  # grow frees nothing
+        assert seen == ["cpu-1"]
+
+    def test_scheduler_resize_running_updates_terms(self):
+        inv = SliceInventory({"cpu-1": 2})
+        sched = ClusterScheduler(inv, clock=lambda: 0.0,
+                                 preemption_cooldown=0.0)
+        sched.submit(JobRequest(key="a", footprint=fp(2)))
+        assert [r.key for r in sched.tick().admitted] == ["a"]
+        assert sched.resize_running("a", fp(1)) is True
+        assert sched.running_request("a").footprint.slices == 1
+        assert inv.used("cpu-1") == 1
+        # grow back
+        assert sched.resize_running("a", fp(2)) is True
+        assert inv.used("cpu-1") == 2
+        # unknown key / refused grow change nothing
+        assert sched.resize_running("ghost", fp(1)) is False
+        sched.submit(JobRequest(key="b", footprint=fp(0, accel="")))
+        assert sched.resize_running("a", fp(3)) is False
+        assert sched.running_request("a").footprint.slices == 2
+
+    def test_pool_deficit_guard_one_shrink_per_revoked_slice(self):
+        """Two elastic gangs on one pool both observe a single revoked
+        slice (attainable < dp for each); the FIRST inventory-triggered
+        shrink absorbs the deficit and the second must be refused —
+        N gangs must surrender exactly one slice per revocation, not
+        one each."""
+        inv = SliceInventory({"cpu-1": 4})
+        sched = ClusterScheduler(inv, clock=lambda: 0.0,
+                                 preemption_cooldown=0.0)
+        sched.submit(JobRequest(key="a", footprint=fp(2)))
+        sched.submit(JobRequest(key="b", footprint=fp(2)))
+        sched.tick()
+        inv.set_capacity("cpu-1", 3)  # one slice gone for good
+        assert sched.resize_running("a", fp(1),
+                                    require_pool_deficit=True) is True
+        # the deficit is absorbed: b keeps its shape
+        assert sched.resize_running("b", fp(1),
+                                    require_pool_deficit=True) is False
+        assert sched.running_request("b").footprint.slices == 2
+        assert inv.used("cpu-1") == 3  # exactly one slice surrendered
+        # dead-host shrinks carry their own evidence and skip the guard
+        assert sched.resize_running("b", fp(1)) is True
+
+
+# ---------------------------------------------------------------------------
+# spec: validation / defaulting / env / yaml
+# ---------------------------------------------------------------------------
+
+
+def elastic_job_spec(num_slices=2, min_dp=1, max_dp=0, accel="cpu-1",
+                     replicas=None, **elastic_kw):
+    return S.TpuJobSpec(
+        tpu=S.TpuSpec(accelerator=accel, num_slices=num_slices),
+        replica_specs=[S.TpuReplicaSpec(replica_type="WORKER",
+                                        replicas=replicas)],
+        elastic=S.ElasticSpec(min_dp_degree=min_dp,
+                              max_dp_degree=max_dp, **elastic_kw),
+    )
+
+
+class TestElasticSpec:
+    def test_defaults_normalize_bounds_and_are_idempotent(self):
+        spec = elastic_job_spec(num_slices=3, min_dp=1, max_dp=0)
+        spec.set_defaults()
+        assert spec.elastic.min_dp_degree == 1
+        assert spec.elastic.max_dp_degree == 3  # 0 → numSlices
+        spec.validate()
+        d = spec.to_dict()
+        rt = S.TpuJobSpec.from_dict(d)
+        rt.set_defaults()
+        assert rt.to_dict() == d  # idempotent through the round trip
+
+    def test_validation_matrix(self):
+        with pytest.raises(S.ValidationError):
+            bad = elastic_job_spec(min_dp=-1)
+            bad.set_defaults()
+            bad.validate()
+        # 0 is not invalid — it means "default": min → 1, max → numSlices
+        zero = elastic_job_spec(min_dp=0, max_dp=0)
+        zero.set_defaults()
+        zero.validate()
+        assert zero.elastic.min_dp_degree == 1
+        with pytest.raises(S.ValidationError):
+            bad = elastic_job_spec(min_dp=3, max_dp=2)
+            bad.set_defaults()
+            bad.validate()
+        # numSlices outside [min, max]
+        with pytest.raises(S.ValidationError):
+            bad = elastic_job_spec(num_slices=1, min_dp=2, max_dp=4)
+            bad.set_defaults()
+            bad.validate()
+        with pytest.raises(S.ValidationError):
+            bad = elastic_job_spec(num_slices=4, min_dp=1, max_dp=2)
+            bad.set_defaults()
+            bad.validate()
+        # elastic without a tpu block
+        with pytest.raises(S.ValidationError):
+            bad = S.TpuJobSpec(
+                replica_specs=[S.TpuReplicaSpec(replica_type="WORKER",
+                                                replicas=1)],
+                elastic=S.ElasticSpec())
+            bad.set_defaults()
+            bad.validate()
+        # serving + elastic
+        with pytest.raises(S.ValidationError):
+            bad = S.TpuJobSpec(
+                tpu=S.TpuSpec(accelerator="cpu-1"),
+                serving=S.ServingSpec(replicas=1),
+                replica_specs=[S.TpuReplicaSpec(replica_type="WORKER",
+                                                replicas=1)],
+                elastic=S.ElasticSpec())
+            bad.set_defaults()
+            bad.validate()
+        # negative windows / non-bool flag
+        with pytest.raises(S.ValidationError):
+            S.ElasticSpec(dead_after_seconds=-1.0).validate()
+        with pytest.raises(S.ValidationError):
+            S.ElasticSpec(resize_on_permanent_loss="yes").validate()
+        with pytest.raises(S.ValidationError):
+            S.ElasticSpec(min_dp_degree=True).validate()
+
+    def test_worker_replicas_must_be_whole_slice_multiples(self):
+        # cpu-1: 1 host/slice; elastic [1, 2] allows 1 or 2 workers
+        for ok in (1, 2):
+            spec = elastic_job_spec(num_slices=2, min_dp=1, max_dp=2,
+                                    replicas=ok)
+            spec.set_defaults()
+            spec.validate()
+        bad = elastic_job_spec(num_slices=2, min_dp=1, max_dp=2,
+                               replicas=3)
+        bad.set_defaults()
+        with pytest.raises(S.ValidationError):
+            bad.validate()
+        # without elastic the original exact-width rule is unchanged
+        fixed = S.TpuJobSpec(
+            tpu=S.TpuSpec(accelerator="cpu-1", num_slices=2),
+            replica_specs=[S.TpuReplicaSpec(replica_type="WORKER",
+                                            replicas=1)])
+        fixed.set_defaults()
+        with pytest.raises(S.ValidationError):
+            fixed.validate()
+
+    def test_env_roundtrip(self):
+        el = S.ElasticSpec(min_dp_degree=1, max_dp_degree=4,
+                           resize_on_permanent_loss=False)
+        env = el.to_env()
+        assert env == {"KTPU_ELASTIC_MIN_DP": "1",
+                       "KTPU_ELASTIC_MAX_DP": "4",
+                       "KTPU_ELASTIC_RESIZE": "0"}
+        rt = S.ElasticSpec.from_env(env)
+        assert rt.min_dp_degree == 1
+        assert rt.max_dp_degree == 4
+        assert rt.resize_on_permanent_loss is False
+        assert S.ElasticSpec.from_env({}) is None
+
+    def test_operator_injects_elastic_env_on_worker_pods(self):
+        from k8s_tpu.trainer.training import TrainingJob
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        j = S.TpuJob()
+        j.metadata.name = "elasticenv"
+        j.metadata.namespace = "default"
+        j.spec = elastic_job_spec(num_slices=2, min_dp=1, max_dp=2)
+        tj = TrainingJob(client, TpuJobClient(cluster), j)
+        tj.setup(S.ControllerConfig())
+        tj.create_resources(S.ControllerConfig())
+        rid = j.spec.runtime_id
+        w = client.jobs.get("default", f"elasticenv-worker-{rid}-0")
+        env = w.spec.template.spec.containers[0].env_dict()
+        assert env["KTPU_ELASTIC_MIN_DP"] == "1"
+        assert env["KTPU_ELASTIC_MAX_DP"] == "2"
+        assert env["KTPU_ELASTIC_RESIZE"] == "1"
+        assert env["KTPU_NUM_PROCESSES"] == "2"
+        # services span the WHOLE maxDpDegree range up front (stable
+        # DNS across resizes — the serving-fleet pattern)
+        for i in range(2):
+            assert client.services.get(
+                "default", f"elasticenv-worker-{rid}-{i}") is not None
+
+    def test_example_yaml_elastic_block(self):
+        import os
+
+        from k8s_tpu.tools.kubectl_local import load_tpu_job_yaml
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "tpu_job_multislice_llama.yaml")
+        with open(path) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        job.spec.validate()
+        assert job.spec.elastic is not None
+        assert job.spec.elastic.min_dp_degree == 1
+        assert job.spec.elastic.max_dp_degree == 2
+        assert job.spec.elastic.resize_on_permanent_loss is True
+
+    def test_phase_and_status_round_trip(self):
+        assert S.TpuJobPhase.RESIZING == "Resizing"
+        st = S.TpuJobStatus(phase=S.TpuJobPhase.RESIZING, dp_degree=1)
+        rt = S.TpuJobStatus.from_dict(st.to_dict())
+        assert rt.phase == "Resizing"
+        assert rt.dp_degree == 1
+
+
+# ---------------------------------------------------------------------------
+# controller integration (in-memory)
+# ---------------------------------------------------------------------------
+
+
+class PuppetExecutor:
+    """Pods run until told otherwise: ``finish(prefix, code)`` makes
+    every live pod whose name starts with ``prefix`` exit with
+    ``code``; teardown (the stop event) yields 143 as a real SIGTERM
+    would. Entries leave ``live`` when their thread exits, so
+    ``live_count`` reflects pods that are actually running."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.live = []  # (pod_name, Event, [code])
+
+    def execute(self, pod, env, stop):
+        ev = threading.Event()
+        code = [143]
+        entry = (pod.metadata.name, ev, code)
+        with self.lock:
+            self.live.append(entry)
+        try:
+            while not stop.is_set() and not ev.is_set():
+                ev.wait(0.02)
+            return code[0] if ev.is_set() else 143
+        finally:
+            with self.lock:
+                self.live.remove(entry)
+
+    def live_count(self, prefix: str) -> int:
+        with self.lock:
+            return sum(1 for name, ev, _ in self.live
+                       if name.startswith(prefix) and not ev.is_set())
+
+    def finish(self, prefix: str, code: int) -> int:
+        n = 0
+        with self.lock:
+            for name, ev, c in self.live:
+                if name.startswith(prefix) and not ev.is_set():
+                    c[0] = code
+                    ev.set()
+                    n += 1
+        return n
+
+
+def elastic_tpu_job(name, max_gang_restarts=4, grow_hold=0.2,
+                    cooldown=0.2, dead_after=30.0):
+    j = S.TpuJob()
+    j.metadata.name = name
+    j.metadata.namespace = "default"
+    j.spec = elastic_job_spec(
+        num_slices=2, min_dp=1, max_dp=2,
+        grow_hold_seconds=grow_hold, cooldown_seconds=cooldown,
+        dead_after_seconds=dead_after)
+    j.spec.max_gang_restarts = max_gang_restarts
+    j.spec.scheduling = S.SchedulingSpec(priority=0)
+    return j
+
+
+def make_resize_world(executor, fleet_slices=2):
+    cluster = InMemoryCluster()
+    client = KubeClient(cluster)
+    jc = TpuJobClient(cluster)
+    config = S.ControllerConfig(fleet={"cpu-1": fleet_slices},
+                                scheduler_cooldown_seconds=0.2)
+    controller = Controller(client, jc, config,
+                            reconcile_interval=0.05, sched_interval=0.05)
+    steps = {"n": 0}
+
+    def fetcher_factory(tj):
+        def fetch():
+            steps["n"] += 1
+            w = tj.job.spec.replica_spec("WORKER")
+            n = w.replicas or 0
+            return {i: {"step": steps["n"]} for i in range(n)} or None
+        return fetch
+
+    controller.worker_stats_fetcher_factory = fetcher_factory
+    kubelet = LocalKubelet(client, executor)
+    return client, jc, controller, kubelet
+
+
+def wait_for(fn, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestControllerResize:
+    def test_shrink_then_grow_then_succeed(self):
+        from k8s_tpu.controller import metrics as M
+
+        ex = PuppetExecutor()
+        client, jc, controller, kubelet = make_resize_world(ex)
+        pre_shrink = M.RESIZE_TOTAL.get(
+            {"job": "default:el", "direction": "shrink"})
+        pre_grow = M.RESIZE_TOTAL.get(
+            {"job": "default:el", "direction": "grow"})
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(elastic_tpu_job("el"))
+            wait_for(lambda: jc.get("default", "el").status.phase
+                     == S.TpuJobPhase.RUNNING, what="el running")
+            rid = jc.get("default", "el").spec.runtime_id
+            inv = controller.scheduler.inventory
+            assert inv.used("cpu-1") == 2
+
+            # ---- permanent loss: slice revoked, its worker dies -----
+            inv.set_capacity("cpu-1", 1)
+            assert ex.finish(f"el-worker-{rid}-1", 137) == 1
+
+            job = wait_for(
+                lambda: (lambda j: j if j.status.dp_degree == 1 else
+                         None)(jc.get("default", "el")),
+                what="shrink to DP=1")
+            conds = [c for c in job.status.conditions
+                     if c.type == "GangResized"]
+            assert conds and "DP=2 -> DP=1" in conds[0].reason
+            evs = [e for e in client.events.list("default")
+                   if e.reason == "GangResized"]
+            assert evs and "DP=2 -> DP=1" in evs[0].message
+            # the ledger re-charged atomically
+            wait_for(lambda: inv.used("cpu-1") == 1, what="ledger shrink")
+            assert controller.scheduler.running_request(
+                "default/el").footprint.slices == 1
+            # the recreated gang is ONE worker with the new world env
+            w0 = wait_for(
+                lambda: next(
+                    (x for x in client.jobs.list("default")
+                     if x.metadata.name == f"el-worker-{rid}-0"), None),
+                what="recreated worker 0")
+            wait_for(
+                lambda: not [x for x in client.jobs.list("default")
+                             if x.metadata.name == f"el-worker-{rid}-1"],
+                what="worker 1 gone")
+            env = w0.spec.template.spec.containers[0].env_dict()
+            assert env["KTPU_NUM_PROCESSES"] == "1"
+            assert M.RESIZE_TOTAL.get(
+                {"job": "default:el", "direction": "shrink"}) \
+                == pre_shrink + 1
+            assert M.RESIZE_DP.get({"job": "default:el"}) == 1.0
+
+            # ---- capacity returns: grow back ------------------------
+            inv.set_capacity("cpu-1", 2)
+            job = wait_for(
+                lambda: (lambda j: j if j.status.dp_degree == 2 else
+                         None)(jc.get("default", "el")),
+                timeout=60, what="grow to DP=2")
+            assert any("DP=1 -> DP=2" in c.reason
+                       for c in job.status.conditions
+                       if c.type == "GangResized")
+            wait_for(lambda: inv.used("cpu-1") == 2, what="ledger grow")
+            wait_for(
+                lambda: len([
+                    x for x in client.jobs.list("default")
+                    if x.metadata.name.startswith(f"el-worker-{rid}-")
+                ]) == 2,
+                what="two workers back")
+            assert M.RESIZE_TOTAL.get(
+                {"job": "default:el", "direction": "grow"}) \
+                == pre_grow + 1
+
+            # ---- run to completion ----------------------------------
+            wait_for(lambda: ex.live_count(f"el-worker-{rid}-") == 2,
+                     what="two live pods after the grow")
+            assert ex.finish(f"el-worker-{rid}-", 0) == 2
+            job = controller.wait_for_job("default", "el", timeout=30)
+            assert job.status.state == S.TpuJobState.SUCCEEDED
+            # one shrink + one grow, both budget-counted
+            assert job.status.gang_restarts == 2
+            # zero oversubscription across the whole cycle
+            assert inv.max_used["cpu-1"] == 2
+            assert inv.used("cpu-1") == 0
+        finally:
+            controller.stop()
+            kubelet.stop()
+
+    def test_budget_exhaustion_fails_job(self):
+        ex = PuppetExecutor()
+        client, jc, controller, kubelet = make_resize_world(ex)
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(elastic_tpu_job("broke", max_gang_restarts=0))
+            wait_for(lambda: jc.get("default", "broke").status.phase
+                     == S.TpuJobPhase.RUNNING, what="broke running")
+            rid = jc.get("default", "broke").spec.runtime_id
+            controller.scheduler.inventory.set_capacity("cpu-1", 1)
+            ex.finish(f"broke-worker-{rid}-1", 137)
+            job = wait_for(
+                lambda: (lambda j: j if j.status.phase in
+                         (S.TpuJobPhase.DONE, S.TpuJobPhase.FAILED)
+                         else None)(jc.get("default", "broke")),
+                what="job failed")
+            assert job.status.state == S.TpuJobState.FAILED
+            assert "resize" in (job.status.reason or "").lower()
+            # terminal transition freed the slices
+            wait_for(lambda: controller.scheduler.inventory
+                     .used("cpu-1") == 0, what="slices freed")
+        finally:
+            controller.stop()
+            kubelet.stop()
+
+    def test_capacity_intact_keeps_restore_in_place(self):
+        """Regression guard: a plain retryable worker death with the
+        fleet capacity INTACT must restart the gang same-shape (the
+        PR 4 path), never resize — elastic only reroutes recovery when
+        a same-shape restart could not place."""
+        ex = PuppetExecutor()
+        client, jc, controller, kubelet = make_resize_world(ex)
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(elastic_tpu_job("crash"))
+            wait_for(lambda: jc.get("default", "crash").status.phase
+                     == S.TpuJobPhase.RUNNING, what="crash running")
+            rid = jc.get("default", "crash").spec.runtime_id
+            ex.finish(f"crash-worker-{rid}-1", 137)  # capacity untouched
+            job = wait_for(
+                lambda: (lambda j: j if j.status.gang_restarts >= 1
+                         else None)(jc.get("default", "crash")),
+                what="gang restart")
+            assert job.status.dp_degree == 0  # never resized
+            assert not any(c.type == "GangResized"
+                           for c in job.status.conditions)
+            assert any(c.type == "GangRestart"
+                       for c in job.status.conditions)
+            # both workers come back at full width and finish
+            wait_for(lambda: ex.live_count(f"crash-worker-{rid}-") == 2,
+                     timeout=30, what="restarted gang live")
+            assert ex.finish(f"crash-worker-{rid}-", 0) == 2
+            job = controller.wait_for_job("default", "crash", timeout=30)
+            assert job.status.state == S.TpuJobState.SUCCEEDED
+        finally:
+            controller.stop()
+            kubelet.stop()
