@@ -280,6 +280,130 @@ def _run_fleet(args, on_accel: bool) -> int:
     return 0
 
 
+def _run_disagg(args) -> int:
+    """``--disagg``: A/B the SAME adversarial long-prompt workload
+    through an interleaved fleet and a phase-split (prefill/decode
+    pool) fleet of the same total size, reporting ITL percentiles
+    (p99 is the headline — the long-prompt stall the PR 2 token
+    budget only bounded and phase-splitting removes), aggregate
+    throughput, and KV-transfer bytes/s. Both fleets run identically-
+    paced stand-in replicas with the prefill-interference wall model
+    ON (each prefill chunk stretches its round — the real engine's
+    shared token budget in wall-clock form), so the delta measures the
+    PHASE SPLIT, not a pacing artifact. Tokens are asserted identical
+    across paths (the cross-path determinism oracle)."""
+    import threading as th
+
+    from k8s_tpu.router import LocalFleet, StandinEngine
+
+    n_total = args.fleet
+    n_prefill = args.disagg_prefill
+    if not 1 <= n_prefill < n_total:
+        raise SystemExit(
+            f"--disagg-prefill {n_prefill} must leave both pools "
+            f"non-empty within --fleet {n_total}")
+    rng = np.random.RandomState(0)
+    n_req = args.requests
+    vocab = 4093
+    long_len = (args.long_prompt if args.long_prompt
+                else 4 * args.max_prompt)
+    plens = rng.randint(2, args.max_prompt + 1, size=n_req)
+    is_long = rng.rand(n_req) < args.long_frac
+    plens[is_long] = long_len
+    news = rng.randint(max(1, args.max_new // 2), args.max_new + 1,
+                       size=n_req)
+    prompts = [rng.randint(0, vocab, size=n).astype(np.int32)
+               for n in plens]
+    if args.arrival_rate > 0:
+        gaps = rng.exponential(1.0 / args.arrival_rate, size=n_req)
+        arrivals = np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+    else:
+        arrivals = np.zeros(n_req)
+
+    def build_engines():
+        return [StandinEngine(
+            max_slots=args.slots, decode_chunk=args.decode_chunk,
+            round_wall_s=args.fleet_round_wall,
+            prefill_chunk=args.prefill_chunk, vocab=vocab,
+            prefill_wall_factor=1.0)
+            for _ in range(n_total)]
+
+    def run(roles):
+        fleet = LocalFleet(build_engines(), roles=roles).start()
+        results = [None] * n_req
+        t0 = time.perf_counter()
+
+        def one(i):
+            dt = t0 + arrivals[i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            code, body = fleet.generate(prompts[i], int(news[i]))
+            results[i] = (code, body)
+
+        threads = [th.Thread(target=one, args=(i,)) for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        codes = [r[0] for r in results]
+        assert codes == [200] * n_req, codes
+        useful = sum(len(r[1]["tokens"]) for r in results)
+        itl = np.sort(np.asarray(
+            [r[1].get("itl_ms") or 0.0 for r in results]))
+        health = fleet.router.healthz()
+        kv = (health.get("disaggregation") or {}).get("kv") or {}
+        fleet.stop()
+        return {
+            "tokens_per_sec": round(useful / wall, 1),
+            "_raw_tps": useful / wall,
+            "itl_p50_ms": round(float(itl[int(0.5 * (n_req - 1))]), 2),
+            "itl_p95_ms": round(float(itl[int(0.95 * (n_req - 1))]), 2),
+            "itl_p99_ms": round(float(itl[int(0.99 * (n_req - 1))]), 2),
+            "kv_transfers": kv.get("transfers", 0),
+            "kv_fallbacks": kv.get("fallbacks", 0),
+            "kv_bytes_per_sec": round(
+                kv.get("bytes_total", 0) / wall, 1),
+            "retries": health["retries"],
+            "tokens": [r[1]["tokens"] for r in results],
+        }
+
+    inter = run(None)
+    roles = (["prefill"] * n_prefill
+             + ["decode"] * (n_total - n_prefill))
+    disagg = run(roles)
+    # cross-path determinism: the stand-ins' tokens are a pure
+    # function of the prompt, so ANY divergence is a routing/handoff
+    # bug, not pacing noise
+    assert disagg["tokens"] == inter["tokens"], \
+        "disagg tokens diverged from interleaved"
+    result = {
+        "metric": "serving_disagg_itl_p99_ms",
+        "value": disagg["itl_p99_ms"],
+        "unit": "ms (lower is better)",
+        "fleet": n_total,
+        "prefill_replicas": n_prefill,
+        "decode_replicas": n_total - n_prefill,
+        "requests": n_req,
+        "long_frac": args.long_frac,
+        "long_prompt": int(long_len),
+        "round_wall_s": args.fleet_round_wall,
+        "itl_p99_win": round(
+            inter["itl_p99_ms"] / max(1e-9, disagg["itl_p99_ms"]), 2),
+        "throughput_ratio": round(
+            disagg["_raw_tps"] / max(1e-9, inter["_raw_tps"]), 2),
+        "tokens_identical": True,
+    }
+    for k in ("tokens_per_sec", "itl_p50_ms", "itl_p95_ms",
+              "itl_p99_ms", "kv_transfers", "kv_fallbacks",
+              "kv_bytes_per_sec", "retries"):
+        result[k] = disagg[k]
+        if not k.startswith("kv_"):
+            result[f"interleaved_{k}"] = inter[k]
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serving-bench")
     # None = per-platform default (full 705M workload on accelerator,
@@ -342,6 +466,16 @@ def main(argv=None) -> int:
     p.add_argument("--fleet-round-wall", type=float, default=0.02,
                    help="stand-in replica roofline: wall seconds per "
                         "engine pump round")
+    p.add_argument("--disagg", action="store_true",
+                   help="A/B an interleaved fleet vs a phase-split "
+                        "prefill/decode fleet of the same size under "
+                        "the adversarial long-prompt mix; reports ITL "
+                        "p99 + throughput + KV bytes/s "
+                        "(docs/SERVING.md Disaggregation)")
+    p.add_argument("--disagg-prefill", type=int, default=0,
+                   help="prefill-pool size for --disagg (default: "
+                        "fleet // 2, min 1 — pools sized to the 25% "
+                        "long-prompt mix's prefill share)")
     p.add_argument("--cpu-model", default="tiny", choices=["tiny", "small"],
                    help="CPU-backend model size: 'small' (~30M) makes "
                         "step compute dominate dispatch, the "
@@ -358,6 +492,8 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     on_accel = jax.default_backend() in ("tpu", "gpu")
+    if args.disagg and args.fleet <= 0:
+        args.fleet = 4  # 2 prefill + 2 decode by default
     # prefill_chunk defaults deliberately BELOW the adversarial prompt
     # length so a long prompt really spans multiple chunks (otherwise
     # its own bucket would ride along as a single monolithic chunk)
@@ -382,6 +518,23 @@ def main(argv=None) -> int:
     for k, v in platform_defaults.items():
         if getattr(args, k) is None:
             setattr(args, k, v)
+
+    if args.disagg:
+        if not args.long_frac:
+            # the disagg A/B is ABOUT the adversarial mix: a
+            # long-prompt-free workload has no interference to remove
+            args.long_frac = 0.25
+        if args.disagg_prefill <= 0:
+            # pools sized to the load's phase split: half the fleet
+            # prefills under a 25% long-prompt mix
+            args.disagg_prefill = max(1, args.fleet // 2)
+        if args.arrival_rate <= 0:
+            # steady-state arrivals, not a thundering herd: an
+            # all-at-once race makes ANY split look bad (phase pools
+            # serialize the burst interleaving absorbs), and no real
+            # fleet serves its whole day's traffic at t=0
+            args.arrival_rate = 25.0 if args.smoke else 10.0
+        return _run_disagg(args)
 
     if args.fleet > 0:
         return _run_fleet(args, on_accel)

@@ -137,6 +137,23 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_serving_fleet.xml"],
             args.artifacts_dir, cases,
         )
+        # disaggregation gate (ISSUE 13): the KV-handoff wire format,
+        # engine prefill-only / KV-seeded admission, the router's
+        # phase-aware steering + fallback ladder, the spec round trip,
+        # the kv-transfer-loss recovery path, and the disagg bench's
+        # --smoke A/B (ITL win + throughput parity + cross-path token
+        # identity). Always on and fast, mirroring the serving-fleet
+        # stage: a handoff regression (a corrupt transfer accepted, a
+        # dead decode pool losing a request) fails in seconds.
+        ok = ok and stage(
+            "disagg",
+            [py, "-m", "pytest", "tests/test_disagg.py",
+             "tests/test_benches.py::TestBenches"
+             "::test_serving_disagg_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_disagg.xml"],
+            args.artifacts_dir, cases,
+        )
         # observability gate (ISSUEs 9+10): tracer/flight-recorder
         # units, structured-event parser, straggler-detector AND
         # training-health-monitor decision tables (NaN one-shot,
@@ -236,10 +253,13 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_obs.py",
                       "--ignore=tests/test_sched.py",
                       "--ignore=tests/test_resize.py",
+                      "--ignore=tests/test_disagg.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_fleet_bench_smoke",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_serving_disagg_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(

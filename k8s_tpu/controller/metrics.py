@@ -342,3 +342,33 @@ SERVING_PREFIX_CACHE_BYTES = REGISTRY.gauge(
     "ktpu_serving_prefix_cache_bytes",
     "Device bytes held by the engine's shared-prefix KV snapshot LRU",
 )
+# Disaggregated prefill/decode serving (docs/SERVING.md
+# "Disaggregation"): the router's KV-handoff leg plus the decode
+# pool's self-speculative fast path.
+ROUTER_KV_TRANSFERS = REGISTRY.counter(
+    "ktpu_router_kv_transfers_total",
+    "Prefill→decode KV handoffs completed end to end (both legs)",
+)
+ROUTER_KV_FALLBACKS = REGISTRY.counter(
+    "ktpu_router_kv_fallback_total",
+    "Disaggregated requests served via a fallback rung (failed KV "
+    "push, dead decode replica, or empty pool) — degraded latency, "
+    "never a lost request",
+)
+ROUTER_KV_BYTES = REGISTRY.counter(
+    "ktpu_router_kv_bytes_total",
+    "Wire bytes of completed prefill→decode KV handoffs",
+)
+SERVING_SPEC_DECODE_ROUNDS = REGISTRY.gauge(
+    "ktpu_serving_spec_decode_rounds",
+    "Self-speculative verify rounds run by this engine (lifetime)",
+)
+SERVING_SPEC_DECODE_DRAFTED = REGISTRY.gauge(
+    "ktpu_serving_spec_decode_drafted",
+    "Draft tokens proposed by the n-gram drafter (lifetime)",
+)
+SERVING_SPEC_DECODE_ACCEPTED = REGISTRY.gauge(
+    "ktpu_serving_spec_decode_accepted",
+    "Draft tokens accepted by the verify step (lifetime); the bonus "
+    "correction token is not counted",
+)

@@ -40,7 +40,7 @@ from k8s_tpu.programs.common import (
     parse_run_config,
     preempt_requested,
 )
-from k8s_tpu.router import Router, parse_peers
+from k8s_tpu.router import Router, parse_peers, parse_roles
 
 
 def main(rdzv) -> None:
@@ -61,6 +61,11 @@ def main(rdzv) -> None:
             adv_port = 0
     port = int(extra.get("port", str(adv_port)))
     host = extra.get("host", "0.0.0.0")
+    # disaggregation (docs/SERVING.md "Disaggregation"): a role map
+    # covering both phases turns on phase-aware steering + the KV
+    # handoff legs; absent ⇒ interleaved routing, bit-identical
+    roles = parse_roles(
+        extra.get("roles", os.environ.get("KTPU_SERVING_ROLES", "")))
     router = Router(
         peers,
         host=host,
@@ -71,6 +76,7 @@ def main(rdzv) -> None:
             os.environ.get("KTPU_ROUTER_PREFIX_TOKENS", "16"))),
         saturation_depth=float(extra.get("saturation_depth", "8")),
         request_timeout=float(extra.get("request_timeout", "300")),
+        roles=roles or None,
     ).start()
     mark_preempt_aware()  # drain in the SIGTERM grace period
     print(json.dumps({
@@ -79,6 +85,8 @@ def main(rdzv) -> None:
         "peers": {str(i): u for i, u in sorted(
             (r.index, r.url) for r in router.replicas.values())},
         "prefix_tokens": router.prefix_tokens,
+        "roles": {str(i): r for i, r in sorted(router.roles.items())},
+        "disaggregated": router.disaggregated,
     }), flush=True)
     while not preempt_requested():
         time.sleep(0.1)

@@ -115,6 +115,22 @@ def main(rdzv) -> None:
         "prefix_cache_tokens",
         os.environ.get("KTPU_SERVING_PREFIX_TOKENS", "0")))
     prefix_cache_max = int(extra.get("prefix_cache_max", "8"))
+    # disaggregation contract (docs/SERVING.md "Disaggregation"): the
+    # operator stamps each fleet worker's phase-pool role and, for
+    # decode workers, the self-speculative draft length
+    role = extra.get("role", os.environ.get("KTPU_SERVING_ROLE", ""))
+    spec_decode_k = int(extra.get(
+        "spec_decode_tokens",
+        os.environ.get("KTPU_SERVING_SPEC_DECODE", "0")))
+    if role == "prefill" and not chunked_prefill:
+        # fail FAST and loud at startup: a prefill-pool worker on the
+        # legacy one-shot path would 400 every /v1/prefill (the KV
+        # handoff unit is the chunked working cache), turning the
+        # whole fleet's happy path into client errors
+        raise ValueError(
+            "a prefill-role replica requires chunked prefill: drop "
+            "--chunked_prefill=0 from KTPU_PROGRAM_ARGS (the KV "
+            "handoff unit is the chunked-prefill working cache)")
     # 0.0.0.0: the pod's Service endpoint must reach the listener —
     # loopback (the library/test default) would make an operator-
     # deployed server unreachable from outside the pod
@@ -156,9 +172,11 @@ def main(rdzv) -> None:
         max_tokens_per_round=max_tokens_per_round,
         prefix_cache_tokens=prefix_cache_tokens,
         prefix_cache_max=prefix_cache_max,
+        spec_decode_k=spec_decode_k,
     )
     frontend = ServingFrontend(engine, host=host, port=port,
-                               max_queue_depth=max_queue_depth)
+                               max_queue_depth=max_queue_depth,
+                               role=role)
     # use the SIGTERM grace period to drain instead of dying mid-request
     mark_preempt_aware()
     replica = os.environ.get("KTPU_SERVING_REPLICA", "")
@@ -173,6 +191,8 @@ def main(rdzv) -> None:
         "max_tokens_per_round": engine.max_tokens_per_round,
         "max_queue_depth": max_queue_depth,
         "prefix_cache_tokens": prefix_cache_tokens,
+        "role": role,
+        "spec_decode_tokens": spec_decode_k,
         "restored": bool(cfg.checkpoint_dir),
     }), flush=True)
     frontend.serve(should_stop=preempt_requested)

@@ -23,5 +23,6 @@ from k8s_tpu.router.router import (  # noqa: F401
     Replica,
     Router,
     parse_peers,
+    parse_roles,
     prefix_key,
 )

@@ -52,6 +52,9 @@ class _Req:
         self.prefill_start_at = 0.0
         self.prefill_remaining = int(len(prompt))
         self.token_times: List = []
+        # disaggregation mirror of the real engine's Request fields
+        self.prefill_only = False
+        self.kv_result = None
 
 
 class StandinEngine:
@@ -67,7 +70,8 @@ class StandinEngine:
 
     def __init__(self, *, max_slots: int = 2, decode_chunk: int = 8,
                  round_wall_s: float = 0.01, prefill_chunk: int = 32,
-                 vocab: int = 4093):
+                 vocab: int = 4093, prefill_wall_factor: float = 0.0,
+                 kv_bytes_per_token: int = 256):
         self.max_slots = int(max_slots)
         self.decode_chunk = int(decode_chunk)
         self.round_wall_s = float(round_wall_s)
@@ -76,6 +80,17 @@ class StandinEngine:
         self.max_tokens_per_round = (
             self.prefill_chunk + self.max_slots * self.decode_chunk)
         self.vocab = int(vocab)
+        # prefill interference model (the disagg A/B's honest knob):
+        # each prefill chunk paid in a round stretches the round wall
+        # by this factor — the real engine's token budget in wall-clock
+        # form, so a long-prompt mix visibly stalls co-resident decode
+        # rows exactly the way phase-splitting removes. 0 = off (the
+        # pre-disagg pacing, which the fleet bench calibrated against).
+        self.prefill_wall_factor = float(prefill_wall_factor)
+        # modeled KV handoff size (bytes per prompt token): what the
+        # stand-in ships on /v1/prefill so the wire, crc framing and
+        # bytes accounting are real even when the cache is fake
+        self.kv_bytes_per_token = int(kv_bytes_per_token)
         self._lock = threading.Lock()
         self._queue: List[_Req] = []
         self._slots: List[Optional[_Req]] = [None] * self.max_slots
@@ -87,7 +102,8 @@ class StandinEngine:
                       "queue_depth": 0, "ttft_s_sum": 0.0,
                       "ttft_count": 0, "prefix_hits": 0,
                       "prefix_misses": 0, "prefix_captures": 0,
-                      "prefix_tokens_saved": 0}
+                      "prefix_tokens_saved": 0,
+                      "kv_prefills": 0, "kv_admits": 0}
 
     # -- engine surface ---------------------------------------------------
 
@@ -101,6 +117,46 @@ class StandinEngine:
             if self._closed:
                 raise RuntimeError("engine is closed")
             req = _Req(next(self._rid), prompt, max_new_tokens)
+            self._queue.append(req)
+        return req.rid
+
+    def submit_prefill(self, prompt, max_new_tokens: int) -> int:
+        """Disagg prefill leg, stand-in flavor: pays the prompt's
+        prefill rounds, then finishes with a modeled KV payload
+        (bytes ∝ prompt tokens) + the deterministic first token. The
+        flag is set INSIDE the enqueue critical section — flagging
+        after submit() raced the pump, which could admit the request
+        into a slot and run it as a full generate (kv_result=None)."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            req = _Req(next(self._rid), prompt, max_new_tokens)
+            req.prefill_only = True
+            self._queue.append(req)
+        return req.rid
+
+    def submit_with_kv(self, kv: dict, max_new_tokens: int) -> int:
+        """Disagg decode leg: the prompt rides in the KV meta (the
+        stand-in's tokens are a deterministic function of it — the
+        cross-path determinism oracle), prefill is already paid, and
+        the first token is pre-seeded."""
+        prompt = np.asarray(kv["prompt"], np.int64).reshape(-1)
+        if int(kv["plen"]) != prompt.size:
+            raise ValueError("kv seed: plen != prompt length")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            req = _Req(next(self._rid), prompt, max_new_tokens)
+            req.prefill_remaining = 0
+            req.tokens = [int(kv["first_token"])]
+            self.stats["kv_admits"] += 1
             self._queue.append(req)
         return req.rid
 
@@ -132,7 +188,12 @@ class StandinEngine:
             active = [r for r in self._slots if r is not None]
         if not active:
             return bool(self._queue)
-        time.sleep(self.round_wall_s)  # the virtual roofline
+        # the virtual roofline; prefill chunks stretch the round by
+        # prefill_wall_factor each (see __init__) — the interference
+        # the disagg A/B measures
+        n_pref = sum(1 for r in active if r.prefill_remaining > 0)
+        time.sleep(self.round_wall_s
+                   * (1.0 + self.prefill_wall_factor * n_pref))
         now = time.perf_counter()
         self.stats["chunks"] += 1
         with self._lock:
@@ -145,6 +206,32 @@ class StandinEngine:
                     req.prefill_remaining -= paid
                     self.stats["prefill_chunks"] += 1
                     self.stats["prefill_tokens"] += paid
+                    if req.prefill_remaining == 0 and req.prefill_only:
+                        # prefill leg complete: first token + modeled
+                        # KV payload, slot freed — the handoff's
+                        # stand-in half
+                        tok0 = self._token(req, 0)
+                        req.tokens = [tok0]
+                        req.kv_result = {
+                            "plen": int(req.prompt.size),
+                            "rows": int(req.prompt.size),
+                            "first_token": tok0,
+                            "prompt": [int(t) for t in req.prompt],
+                            "leaves": [np.zeros(
+                                int(req.prompt.size)
+                                * self.kv_bytes_per_token, np.uint8)],
+                        }
+                        req.first_token_at = now
+                        req.token_times.append((now, 1))
+                        self.stats["ttft_s_sum"] += \
+                            now - req.submitted_at
+                        self.stats["ttft_count"] += 1
+                        self.stats["prefills"] += 1
+                        self.stats["kv_prefills"] += 1
+                        req.done = True
+                        req.finished_at = now
+                        self._done[req.rid] = req
+                        self._slots[i] = None
                     continue
                 base = len(req.tokens)
                 k = min(self.decode_chunk, req.max_new - base)
@@ -188,18 +275,26 @@ class LocalFleet:
     dedicated pump thread (the engine's single-scheduler contract)."""
 
     def __init__(self, engines, *, max_queue_depth: int = 0,
-                 router_kwargs: Optional[dict] = None):
+                 router_kwargs: Optional[dict] = None,
+                 roles: Optional[List[str]] = None):
         self.engines = list(engines)
+        self.roles = list(roles) if roles else []
+        if self.roles and len(self.roles) != len(self.engines):
+            raise ValueError("roles must match engines 1:1")
         self.frontends = [
             ServingFrontend(e, host="127.0.0.1", port=0,
-                            max_queue_depth=max_queue_depth)
-            for e in self.engines
+                            max_queue_depth=max_queue_depth,
+                            role=(self.roles[i] if self.roles else ""))
+            for i, e in enumerate(self.engines)
         ]
         self._stops = [threading.Event() for _ in self.engines]
         self._pumps: List[threading.Thread] = []
         self._killed: set = set()
         kwargs = dict(router_kwargs or {})
         kwargs.setdefault("poll_interval", 0.2)
+        if self.roles:
+            kwargs.setdefault(
+                "roles", {i: r for i, r in enumerate(self.roles)})
         self.router = Router(
             {i: f"http://127.0.0.1:{fe.port}"
              for i, fe in enumerate(self.frontends)},
@@ -284,6 +379,23 @@ class LocalFleet:
         if len(alive) <= 1:
             return None
         victim = alive[rng.randrange(len(alive))]
+        self.kill_replica(victim)
+        return victim
+
+    def kill_random_decode_replica(self, rng) -> Optional[int]:
+        """Chaos ``kv-transfer-loss``: kill one live DECODE-pool
+        replica (the KV handoff's target side), always leaving at
+        least one replica of ANY role standing — the fallback ladder
+        needs somewhere to land. Killing the LAST decode replica is
+        allowed (and interesting): it forces the interleave-fallback
+        rung. No-op on non-disaggregated fleets."""
+        if not self.roles:
+            return None
+        alive = self.alive()
+        decode_alive = [i for i in alive if self.roles[i] == "decode"]
+        if not decode_alive or len(alive) <= 1:
+            return None
+        victim = decode_alive[rng.randrange(len(decode_alive))]
         self.kill_replica(victim)
         return victim
 

@@ -49,6 +49,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import logging
 import threading
 import time
 import urllib.error
@@ -58,6 +59,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from k8s_tpu.controller import metrics
+
+log = logging.getLogger(__name__)
 
 # replica health states (the router's view, refreshed by the poller)
 READY = "ready"
@@ -84,6 +87,34 @@ def parse_peers(raw: str) -> Dict[int, str]:
         try:
             out[int(idx)] = url.rstrip("/")
         except ValueError:
+            continue
+    return out
+
+
+def parse_roles(raw: str) -> Dict[int, str]:
+    """``"0=prefill,1=decode,2=decode"`` → {index: role} (the
+    ``KTPU_SERVING_ROLES`` contract, same shape as the peers env).
+    Malformed entries and unknown roles are skipped WITH a warning —
+    a silently-dropped role leaves that replica in neither pool
+    (unroutable on the happy path), which must at least be visible in
+    the router log."""
+    out: Dict[int, str] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        idx, sep, role = part.partition("=")
+        role = role.strip().lower()
+        if not sep or role not in ("prefill", "decode"):
+            log.warning("roles: skipping malformed entry %r (want "
+                        "<index>=prefill|decode) — that replica will "
+                        "belong to NO pool", part)
+            continue
+        try:
+            out[int(idx)] = role
+        except ValueError:
+            log.warning("roles: skipping entry %r (non-integer "
+                        "index)", part)
             continue
     return out
 
@@ -118,10 +149,14 @@ class Replica:
     routed_since_poll: int = 0   # staleness compensation (see load())
     last_error: str = ""
 
-    def load(self) -> float:
+    def load(self, include_backlog: bool = True) -> float:
         """Score used for routing: lower is better. Derived from the
         last successful poll plus the requests this router sent since
-        (the poll view is up to one poll interval stale)."""
+        (the poll view is up to one poll interval stale).
+        ``include_backlog=False`` is the DECODE-pool score: a decode
+        replica never prefills on the steady path, so the prefill-
+        backlog term is meaningless there and would only let a
+        fallback-prefill straggler repel its pool's real work."""
         st = self.stats or {}
         inner = st.get("stats") or {}
         # prefer the LIVE top-level queue_depth (reads the queue
@@ -134,11 +169,13 @@ class Replica:
         # prefill backlog in chunk units: a half-prefilled 8k prompt is
         # real pending work the queue depth doesn't show
         backlog = 0.0
-        chunk = float(
-            (st.get("scheduler") or {}).get("prefill_chunk") or 256)
-        for p in (st.get("prefill_progress") or {}).values():
-            backlog += max(0.0, float(p.get("total", 0) - p.get("done", 0))
-                           ) / max(1.0, chunk)
+        if include_backlog:
+            chunk = float(
+                (st.get("scheduler") or {}).get("prefill_chunk") or 256)
+            for p in (st.get("prefill_progress") or {}).values():
+                backlog += max(
+                    0.0, float(p.get("total", 0) - p.get("done", 0))
+                ) / max(1.0, chunk)
         return q + inflight + backlog + self.routed_since_poll
 
 
@@ -165,6 +202,7 @@ class Router:
         request_timeout: float = 300.0,
         down_after: int = DEFAULT_DOWN_AFTER,
         slo_window: int = 256,
+        roles: Optional[Dict[int, str]] = None,
     ):
         self.replicas: Dict[int, Replica] = {
             int(i): Replica(index=int(i), url=u.rstrip("/"))
@@ -172,6 +210,21 @@ class Router:
         }
         if not self.replicas:
             raise ValueError("router needs at least one replica endpoint")
+        # Disaggregation (docs/SERVING.md "Disaggregation"): with a
+        # role map carrying BOTH roles, routing is phase-aware — new
+        # requests score against the prefill pool, the finished KV
+        # hops to the least-loaded decode replica, and the decode leg
+        # streams there. No/partial roles ⇒ today's interleaved
+        # routing, bit-identical (the regression guard).
+        self.roles: Dict[int, str] = {
+            int(i): str(r) for i, r in (roles or {}).items()}
+        self.disaggregated = (
+            any(r == "prefill" for r in self.roles.values())
+            and any(r == "decode" for r in self.roles.values()))
+        # lifetime KV-handoff counters (mirrored into ktpu_router_kv_*)
+        self.kv_transfers = 0
+        self.kv_fallbacks = 0
+        self.kv_bytes = 0
         self.poll_interval = float(poll_interval)
         self.poll_timeout = float(poll_timeout)
         self.prefix_tokens = int(prefix_tokens)
@@ -340,13 +393,28 @@ class Router:
     def _saturated(self, r: Replica) -> bool:
         return r.load() >= self.saturation_depth
 
+    def _in_prefill_pool(self, index: int) -> bool:
+        """Phase membership for the ADMISSION pool: in disaggregated
+        mode only prefill-role replicas take new prompts; otherwise
+        every replica does (interleaved fleet)."""
+        if not self.disaggregated:
+            return True
+        return self.roles.get(index) == "prefill"
+
     def pick_replica(self, prompt) -> Tuple[Optional[int], str]:
         """Pure routing decision: (replica index | None, affinity
         verdict in {"hit", "fallback", "miss", "none"}). Deterministic
-        given the current stats view — the unit-test surface."""
+        given the current stats view — the unit-test surface. In
+        disaggregated mode this picks the PREFILL-leg replica: the
+        candidate set is the prefill pool, and prefix affinity both
+        binds and honors bindings WITHIN that pool only — affinity to
+        a decode replica is dead weight (its prefix KV never warms),
+        so a stale cross-pool binding falls back and re-binds."""
         key = prefix_key(prompt, self.prefix_tokens)
         with self._lock:
-            ready = [r for r in self.replicas.values() if self._routable(r)]
+            ready = [r for r in self.replicas.values()
+                     if self._routable(r)
+                     and self._in_prefill_pool(r.index)]
             if not ready:
                 return None, "none"
             if key is not None:
@@ -354,6 +422,7 @@ class Router:
                 if bound is not None:
                     r = self.replicas.get(bound)
                     if r is not None and self._routable(r) \
+                            and self._in_prefill_pool(bound) \
                             and not self._saturated(r):
                         self._affinity.move_to_end(key)
                         return bound, "hit"
@@ -372,6 +441,23 @@ class Router:
                     self._affinity.popitem(last=False)
             return best.index, verdict
 
+    def pick_decode(self, exclude=()) -> Optional[int]:
+        """Decode-leg target: the least-loaded READY decode replica,
+        scored WITHOUT the prefill-backlog term (meaningless in a pool
+        that never prefills on the steady path). Ties break on the
+        lower index; ``exclude`` holds indices already tried for this
+        request."""
+        with self._lock:
+            ready = [r for r in self.replicas.values()
+                     if self._routable(r)
+                     and self.roles.get(r.index) == "decode"
+                     and r.index not in exclude]
+            if not ready:
+                return None
+            best = min(ready, key=lambda r: (r.load(include_backlog=False),
+                                             r.index))
+            return best.index
+
     def _count_verdict(self, verdict: str) -> None:
         if verdict == "hit":
             self.affinity_hits += 1
@@ -384,14 +470,15 @@ class Router:
 
     # ------------------------------------------------------------ data path
 
-    def _forward(self, url: str, body: bytes, trace_id: str = ""):
+    def _forward(self, url: str, body: bytes, trace_id: str = "",
+                 path: str = "/v1/generate"):
         headers = {"Content-Type": "application/json"}
         if trace_id:
             # trace propagation: the replica stamps its spans under
             # the SAME id this router (and its caller) logs
             headers["X-KTPU-Trace-Id"] = trace_id
         req = urllib.request.Request(
-            url + "/v1/generate", data=body, headers=headers)
+            url + path, data=body, headers=headers)
         with urllib.request.urlopen(
                 req, timeout=self.request_timeout) as resp:
             return resp.status, json.loads(resp.read())
@@ -402,23 +489,45 @@ class Router:
         carries ``trace_id`` + a ``spans`` block decomposing the
         request path: ``router_s`` (time this router spent on scoring,
         forwarding overhead, and any peer retries) over the engine's
-        queue → prefill → decode spans."""
+        queue → prefill → decode spans (and, in disaggregated mode,
+        the ``kv_transfer_s`` leg between them)."""
         if self._draining:
             return 503, {"error": "router draining"}, None
         if not trace_id:
             import uuid
 
             trace_id = "req-" + uuid.uuid4().hex[:12]
+        if self.disaggregated:
+            return self._route_disagg(prompt, body, trace_id)
+        return self._route_plain(prompt, body, trace_id)
+
+    def _route_plain(self, prompt, body: bytes, trace_id: str,
+                     tried: Optional[set] = None,
+                     count_affinity: bool = True):
+        """The interleaved routing loop (pre-disaggregation behavior,
+        byte-identical when no roles are configured). Also the FINAL
+        rung of the disaggregated fallback ladder — ``tried`` then
+        pre-excludes replicas that already failed this request and
+        ``count_affinity=False`` keeps the affinity counters honest
+        (the disagg leg already counted its verdict)."""
         t_route0 = time.perf_counter()
-        tried: set = set()
+        tried = set(tried or ())
         saw_429 = False
         retry_after = "1"
         first_verdict: Optional[str] = None
         while True:
-            idx, verdict = self._pick_excluding(prompt, tried)
+            if count_affinity:
+                idx, verdict = self._pick_excluding(prompt, tried)
+            else:
+                # disagg fallback rung: ANY ready replica may serve
+                # the request interleaved — pool restriction and
+                # affinity are the happy path's concerns, not the
+                # ladder's last rung
+                idx, verdict = self._pick_any(tried)
             if first_verdict is None:
-                with self._lock:
-                    self._count_verdict(verdict)
+                if count_affinity:
+                    with self._lock:
+                        self._count_verdict(verdict)
                 first_verdict = verdict
             if idx is None:
                 break
@@ -489,9 +598,283 @@ class Router:
                     {"Retry-After": retry_after})
         return 503, {"error": "no routable replica"}, None
 
+    # ------------------------------------------------- disaggregated path
+
+    def _note_kv_fallback(self) -> None:
+        with self._lock:
+            self.kv_fallbacks += 1
+        metrics.ROUTER_KV_FALLBACKS.inc()
+
+    def _fallback_plain(self, prompt, body: bytes, trace_id: str,
+                        tried) -> tuple:
+        """Last rung of the disagg ladder: serve the whole request
+        interleaved on any ready replica (prefill replicas are full
+        engines — the 'local prefill' degradation). Greedy engines are
+        deterministic, so the fallback's tokens are bit-identical to
+        the phase-split path's."""
+        self._note_kv_fallback()
+        return self._route_plain(prompt, body, trace_id,
+                                 tried=tried, count_affinity=False)
+
+    def _route_disagg(self, prompt, body: bytes, trace_id: str):
+        """Phase-split data path: prefill leg → KV push (done by the
+        prefill worker, target chosen HERE) → decode leg, composed
+        into one response whose spans satisfy
+        ``engine_queue_s + prefill_s + kv_transfer_s == ttft_s`` by
+        construction. The fallback ladder, in order: retry prefill on
+        a pool peer → the prefill worker's own local-prefill fallback
+        (push failed) → re-route the whole request interleaved (decode
+        leg failed / pools empty). Every rung returns the same
+        deterministic tokens; only latency degrades."""
+        t_route0 = time.perf_counter()
+        try:
+            payload_in = json.loads(body)
+            max_new = int(payload_in.get("max_new_tokens", 16))
+        except Exception:
+            max_new = 16
+        pre_tried: set = set()
+        dec_tried: set = set()
+        first_verdict: Optional[str] = None
+        saw_429 = False
+        retry_after = "1"
+        while True:
+            idx, verdict = (
+                self.pick_replica(prompt) if not pre_tried
+                else self._pick_prefill_excluding(pre_tried))
+            if first_verdict is None:
+                with self._lock:
+                    self._count_verdict(verdict)
+                first_verdict = verdict
+            if idx is None:
+                break  # prefill pool exhausted → interleave fallback
+            d_idx = self.pick_decode(exclude=dec_tried)
+            if d_idx is None:
+                break  # decode pool empty → interleave fallback
+            import uuid
+
+            handle = "kv-" + uuid.uuid4().hex[:16]
+            pre_tried.add(idx)
+            p, d = self.replicas[idx], self.replicas[d_idx]
+            with self._lock:
+                p.routed += 1
+                p.routed_since_poll += 1
+            metrics.ROUTER_REQUESTS.inc({"replica": str(idx)})
+            pre_body = json.dumps({
+                "prompt": [int(t) for t in prompt],
+                "max_new_tokens": max_new,
+                "kv_target": d.url,
+                "handle": handle,
+            }).encode()
+            try:
+                code, pre = self._forward(p.url, pre_body,
+                                          trace_id=trace_id,
+                                          path="/v1/prefill")
+            except urllib.error.HTTPError as e:
+                # drain the error body on EVERY path (the plain
+                # loop's discipline): an unread HTTPError pins its
+                # socket until GC, one per tried replica per shed
+                # request under a saturated pool
+                try:
+                    err_body = e.read()
+                except Exception:
+                    err_body = b""
+                if e.code == 429:
+                    saw_429 = True
+                    retry_after = e.headers.get("Retry-After") \
+                        or retry_after
+                    self._note_retry(idx)
+                    continue
+                if e.code >= 500:
+                    self._note_retry(idx)
+                    continue
+                try:
+                    err_payload = json.loads(err_body)
+                except Exception:
+                    err_payload = {
+                        "error": f"replica {idx}: HTTP {e.code}"}
+                return e.code, err_payload, None
+            except Exception as e:  # refused/reset/timeout: dead worker
+                self.note_poll_failure(idx, str(e))
+                self._note_retry(idx)
+                continue
+            if not isinstance(pre, dict):
+                break
+            spans_pre = pre.get("spans") or {}
+            kv_s = float(spans_pre.get("kv_transfer_s") or 0.0)
+            kv_bytes = int(pre.get("kv_bytes") or 0)
+            if pre.get("local_fallback"):
+                # the push died mid-transfer; the prefill worker
+                # already served the whole request from its snapshot
+                self._note_kv_fallback()
+                return self._compose(
+                    t_route0, trace_id, pre, spans_pre, kv_s, 0,
+                    replica=idx, prefill_replica=idx,
+                    retries=len(pre_tried) - 1 + len(dec_tried),
+                    local_fallback=True, pre_latency=0.0)
+            # decode leg — count the committed work against d's score
+            # only NOW: incrementing at pick time accrued phantom load
+            # on the least-loaded replica across prefill-leg retries
+            # (dec_tried only grows on decode-leg failures) and on
+            # local fallbacks that never send it anything
+            with self._lock:
+                d.routed += 1
+                d.routed_since_poll += 1
+            metrics.ROUTER_REQUESTS.inc({"replica": str(d_idx)})
+            dec_body = json.dumps({
+                "handle": handle, "max_new_tokens": max_new}).encode()
+            dec = None
+            for attempt in (0, 1):
+                try:
+                    code2, dec = self._forward(d.url, dec_body,
+                                               trace_id=trace_id,
+                                               path="/v1/decode")
+                    break
+                except urllib.error.HTTPError as e:
+                    try:
+                        e.read()  # drain: an unread error pins a socket
+                    except Exception:
+                        pass
+                    if e.code in (429, 503) and attempt == 0:
+                        # transient admission rejection: the decode
+                        # worker RESTORED the popped handle expecting
+                        # exactly this retry — one brief retry against
+                        # the SAME replica (the handle lives there)
+                        # beats a full interleaved re-prefill
+                        try:
+                            ra = float(
+                                e.headers.get("Retry-After") or 0.2)
+                        except (TypeError, ValueError):
+                            ra = 0.2  # HTTP-date form: just back off
+                        time.sleep(min(0.5, ra))
+                        continue
+                    # 404 = handle never arrived / evicted; other
+                    # codes = replica-side — the KV is unusable now:
+                    # fall through to the interleaved rung rather
+                    # than re-prefilling through the disagg loop
+                    self._note_retry(d_idx)
+                    dec_tried.add(d_idx)
+                    return self._fallback_plain(prompt, body,
+                                                trace_id, dec_tried)
+                except Exception as e:  # replica died mid-stream
+                    self.note_poll_failure(d_idx, str(e))
+                    self._note_retry(d_idx)
+                    dec_tried.add(d_idx)
+                    return self._fallback_plain(prompt, body,
+                                                trace_id, dec_tried)
+            if not isinstance(dec, dict):
+                dec_tried.add(d_idx)
+                return self._fallback_plain(prompt, body, trace_id,
+                                            dec_tried)
+            with self._lock:
+                self.kv_transfers += 1
+                self.kv_bytes += kv_bytes
+            metrics.ROUTER_KV_TRANSFERS.inc()
+            metrics.ROUTER_KV_BYTES.inc(by=kv_bytes)
+            return self._compose(
+                t_route0, trace_id, dec, spans_pre, kv_s, kv_bytes,
+                replica=d_idx, prefill_replica=idx,
+                retries=len(pre_tried) - 1 + len(dec_tried),
+                pre_latency=float(pre.get("latency_s") or 0.0))
+        if saw_429 and not [
+                r for r in self.replicas.values()
+                if self._routable(r)
+                and self._in_prefill_pool(r.index)
+                and r.index not in pre_tried]:
+            # the PREFILL pool is saturated (429s, not deaths): shed
+            # load honestly. Spilling full interleaved requests onto
+            # the decode pool here would silently reintroduce the
+            # prefill interference this mode exists to remove AND hide
+            # the backpressure signal clients throttle on.
+            with self._lock:
+                self.rejected += 1
+            return (429, {"error": "prefill pool saturated"},
+                    {"Retry-After": retry_after})
+        # pools unusable (no ready prefill or decode replica): serve
+        # interleaved on whatever is still standing — EXCLUDING the
+        # replicas that already failed this request (a dead-but-not-
+        # yet-DOWN prefill pod would otherwise eat a second connect
+        # timeout per request on the fallback rung)
+        return self._fallback_plain(prompt, body, trace_id,
+                                    pre_tried | dec_tried)
+
+    def _pick_prefill_excluding(self, tried: set):
+        with self._lock:
+            ready = [r for r in self.replicas.values()
+                     if self._routable(r)
+                     and self._in_prefill_pool(r.index)
+                     and r.index not in tried]
+            if not ready:
+                return None, "none"
+            best = min(ready, key=lambda r: (r.load(), r.index))
+            return best.index, "none"
+
+    def _compose(self, t_route0: float, trace_id: str, leg: dict,
+                 spans_pre: dict, kv_s: float, kv_bytes: int, *,
+                 replica: int, prefill_replica: int, retries: int,
+                 local_fallback: bool = False,
+                 pre_latency: float = 0.0):
+        """Merge the two legs into one client payload. TTFT is
+        CONSTRUCTED as queue + prefill + kv_transfer — the span-sum
+        identity the e2e pins — and the decode leg's whole post-queue
+        time folds into ``decode_s`` (its own internal pre-first-chunk
+        wait is stream-side latency, not time-to-first-token: the
+        first token already exists when the leg starts)."""
+        spans_leg = leg.get("spans") or {}
+        if local_fallback:
+            # the prefill worker served BOTH halves: its spans already
+            # combine the legs — don't double-count the queue term
+            eq = float(spans_leg.get("engine_queue_s") or 0.0)
+            pf = float(spans_leg.get("prefill_s") or 0.0)
+            dc = float(spans_leg.get("decode_s") or 0.0)
+        else:
+            eq = (float(spans_pre.get("engine_queue_s") or 0.0)
+                  + float(spans_leg.get("engine_queue_s") or 0.0))
+            pf = float(spans_pre.get("prefill_s") or 0.0)
+            dc = (float(spans_leg.get("prefill_s") or 0.0)
+                  + float(spans_leg.get("decode_s") or 0.0))
+        ttft = eq + pf + kv_s
+        # BOTH legs' engine wall comes out of the router_s derivation
+        # (pre_latency is 0 for local fallback, whose single leg
+        # already covers everything) — subtracting only the decode
+        # leg reported the whole prefill+push wall as router overhead
+        engine_latency = float(leg.get("latency_s") or 0.0) \
+            + float(pre_latency)
+        router_s = max(
+            0.0, time.perf_counter() - t_route0 - engine_latency)
+        itl = float(leg.get("itl_ms") or 0.0)
+        spans = {
+            "engine_queue_s": round(eq, 4),
+            "prefill_s": round(pf, 4),
+            "kv_transfer_s": round(kv_s, 4),
+            "decode_s": round(dc, 4),
+            "router_s": round(router_s, 4),
+        }
+        with self._lock:
+            self.routed_total += 1
+            self._slo.append((ttft, itl))
+            self._spans.append(dict(spans))
+        payload = {
+            "tokens": leg.get("tokens"),
+            "latency_s": round(time.perf_counter() - t_route0, 4),
+            "ttft_s": round(ttft, 4),
+            "itl_ms": round(itl, 3),
+            "trace_id": leg.get("trace_id") or trace_id,
+            "replica": replica,
+            "prefill_replica": prefill_replica,
+            "retries": retries,
+            "kv_bytes": kv_bytes,
+            "spans": spans,
+        }
+        if local_fallback:
+            payload["local_fallback"] = True
+        return 200, payload, None
+
     def _pick_excluding(self, prompt, tried: set):
         if not tried:
             return self.pick_replica(prompt)
+        return self._pick_any(tried)
+
+    def _pick_any(self, tried: set):
         with self._lock:
             ready = [r for r in self.replicas.values()
                      if self._routable(r) and r.index not in tried]
@@ -527,8 +910,12 @@ class Router:
         with self._lock:
             samples = list(self._spans)
         out: dict = {"window": len(samples)}
-        for key in ("router_s", "engine_queue_s", "prefill_s",
-                    "decode_s"):
+        keys = ["router_s", "engine_queue_s", "prefill_s", "decode_s"]
+        if self.disaggregated:
+            # the new leg sits between prefill and decode — measured,
+            # not guessed (p50/p95 + bytes below in healthz "kv")
+            keys.insert(3, "kv_transfer_s")
+        for key in keys:
             xs = [s[key] for s in samples if key in s]
             out[f"{key[:-2]}_p50_ms"] = round(1e3 * _pct(xs, 0.5), 3)
             out[f"{key[:-2]}_p95_ms"] = round(1e3 * _pct(xs, 0.95), 3)
@@ -560,6 +947,25 @@ class Router:
                 "retries": self.retries,
                 "rejected": self.rejected,
             }
+            disagg = None
+            if self.disaggregated:
+                disagg = {
+                    "roles": {str(i): r
+                              for i, r in sorted(self.roles.items())},
+                    "prefill_ready": sum(
+                        1 for r in self.replicas.values()
+                        if r.state == READY
+                        and self.roles.get(r.index) == "prefill"),
+                    "decode_ready": sum(
+                        1 for r in self.replicas.values()
+                        if r.state == READY
+                        and self.roles.get(r.index) == "decode"),
+                    "kv": {
+                        "transfers": self.kv_transfers,
+                        "fallbacks": self.kv_fallbacks,
+                        "bytes_total": self.kv_bytes,
+                    },
+                }
             draining = self._draining
         return {
             "ok": not draining and ready > 0,
@@ -567,6 +973,9 @@ class Router:
             "ready_replicas": ready,
             "replicas": replicas,
             "affinity": affinity,
+            # only present in disaggregated mode: the no-disagg healthz
+            # stays byte-identical (the regression guard)
+            **({"disaggregation": disagg} if disagg else {}),
             "slo": self.slo_snapshot(),
             "trace": self.trace_snapshot(),
             **counters,

@@ -33,6 +33,12 @@ router-replica-loss one serving-fleet engine replica crashed abruptly
                     on a peer, zero accepted requests lost
 router-stats-flake  a replica's /healthz errors while it keeps serving
                     → the router poll loop survives and keeps routing
+kv-transfer-loss    the decode-pool target of a disaggregated KV
+                    handoff killed mid-transfer → the request still
+                    completes via the fallback ladder (prefill-local
+                    decode, retry-on-peer, or interleaved re-route),
+                    counted in ktpu_router_kv_fallback_total — a lost
+                    transfer degrades latency, never a request
 slow-host           one gang host's train steps throttled (armed via
                     the obs tracer hook in-process, or
                     ``KTPU_CHAOS_SLOW_HOST`` env for subprocess gangs)
@@ -483,6 +489,37 @@ class RouterReplicaLossFault(FaultInjector):
         return f"replica-{victim}"
 
 
+class KvTransferLossFault(FaultInjector):
+    """Kill the DECODE side of a disaggregated serving fleet — the
+    target of an in-flight (or imminent) prefill→decode KV handoff
+    (``kv-transfer-loss``). The transfer's bytes land nowhere, so the
+    request must complete through the fallback ladder instead: the
+    prefill worker's local-prefill fallback (push refused) or the
+    router's retry-on-peer / interleave rung (decode leg dead), with
+    every rung counted in ``ktpu_router_kv_fallback_total``. No-op on
+    fleets without phase roles, and never removes the last standing
+    replica (the ladder needs a rung to land on)."""
+
+    name = "kv-transfer-loss"
+
+    def __init__(self, fleet, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.fleet = fleet
+
+    def fire(self) -> Optional[str]:
+        kill = getattr(self.fleet, "kill_random_decode_replica", None)
+        if kill is None:
+            return None
+        victim = kill(self.rng)
+        if victim is None:
+            return None  # interleaved fleet / no safe decode victim
+        self.injected += 1
+        log.info("chaos[%s]: killed decode replica %d mid-handoff",
+                 self.name, victim)
+        return f"decode-replica-{victim}"
+
+
 class RouterStatsFlakeFault(FaultInjector):
     """Make one replica's /healthz stats endpoint error for the next
     few polls while its data plane keeps serving — the router's poll
@@ -846,6 +883,10 @@ class ChaosMonkey:
                 inj += [
                     RouterReplicaLossFault(fleet, rate=0.15, seed=s()),
                     RouterStatsFlakeFault(fleet, rate=0.3, seed=s()),
+                    # no-op unless the fleet carries phase roles — a
+                    # disaggregated fleet additionally loses KV-handoff
+                    # targets mid-transfer
+                    KvTransferLossFault(fleet, rate=0.15, seed=s()),
                 ]
             if scheduler is not None:
                 inj.append(

@@ -78,6 +78,15 @@ class Request:
     # the raw material for TTFT / inter-token percentiles; bounded by
     # ceil(max_new / decode_chunk) entries per request
     token_times: List = dataclasses.field(default_factory=list)
+    # Disaggregated serving (docs/SERVING.md "Disaggregation"):
+    # prefill_only requests run chunked prefill to completion, then
+    # finish with kv_result = the working-cache KV snapshot + first
+    # token (never touching a decode slot); kv_seed requests carry a
+    # received snapshot that scatters straight into a slot, skipping
+    # prefill compute entirely — the two halves of a KV handoff.
+    prefill_only: bool = False
+    kv_seed: Optional[dict] = None
+    kv_result: Optional[dict] = None
 
 
 def _next_chunk(chunk_buckets: Sequence[int], offset: int, plen: int,
@@ -320,6 +329,57 @@ def _set_slot(tok_v, lengths_v, active_v, budget_v, slot, tok_new,
     return tok_v, lengths_v, active_v, budget_v
 
 
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2,))
+def _verify_chunk(model, params, cache, x, positions):
+    """Self-speculative decode's verify step: ONE ragged forward over
+    ``x`` [B, K+1] = per row ``[last_token, draft_1..draft_K]`` at
+    per-row positions ``lengths + arange(K+1)``. The model's warm-cache
+    continuation path (the chunked-prefill machinery) writes all K+1
+    KV rows at each row's own offset and masks causally per row, so
+    the returned greedy tokens ``t_j`` are EXACTLY what sequential
+    decode would emit after ``x[:, :j+1]`` — the accept-prefix rule
+    (host-side) then keeps ``d_i`` iff ``d_i == t_{i-1}``, plus the
+    bonus correction ``t_a``. Rejected drafts' KV rows sit above the
+    accepted length where the per-row position mask hides them, and
+    decode overwrites row p before the first read at position p — the
+    same garbage-tolerance contract as ``_scatter_slot_rows``.
+
+    Greedy only (``jnp.argmax`` mirrors ``_pick_token`` at
+    temperature 0): acceptance must be bit-identical to the plain
+    decode path, which sampling can't be."""
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, x,
+        positions=positions, mutable=["cache"],
+    )
+    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    return mut["cache"], toks
+
+
+def _ngram_draft(ctx: np.ndarray, k: int, n: int) -> np.ndarray:
+    """Prompt-lookup drafting (the model's own n-gram cache): find the
+    most recent PREVIOUS occurrence of the context's trailing n-gram
+    and propose the up-to-k tokens that followed it. Cheap, exact-
+    arithmetic, and surprisingly effective on repetitive continuations;
+    a miss returns an empty draft — the verify step then degenerates to
+    one plain greedy step (never slower than no speculation by more
+    than the batched verify's padding)."""
+    L = int(ctx.size)
+    if L <= n:
+        return ctx[:0]
+    tail = ctx[L - n:]
+    # vectorized most-recent-match: one C-level comparison over all
+    # windows ending before the tail itself — a Python-level backward
+    # scan costs O(L) numpy calls per slot per round, which on a long
+    # non-repetitive context can exceed the verify forward it feeds
+    wins = np.lib.stride_tricks.sliding_window_view(ctx, n)[:L - n]
+    hits = np.nonzero((wins == tail).all(axis=1))[0]
+    if hits.size == 0:
+        return ctx[:0]
+    s = int(hits[-1])
+    return ctx[s + n:s + n + k]
+
+
 def _harvest_loop(fetchq: "queue.Queue", readyq: "queue.Queue") -> None:
     """Harvester thread: materializes chunks' packed arrays.
     ``np.asarray`` blocks for a full transport round-trip, so it lives
@@ -416,6 +476,19 @@ class ContinuousBatchingEngine:
     prefix_cache_max:
         LRU capacity (distinct prefixes held on device). Each entry
         costs one stage-sized batch-1 KV cache.
+    spec_decode_k:
+        Self-speculative decode (docs/SERVING.md "Disaggregation"):
+        > 0 replaces the K-step decode chunk with draft-k/verify
+        rounds — an n-gram drafter proposes up to this many tokens
+        per round and ONE ragged verify step accepts the matching
+        prefix (+ the bonus correction), bit-identically to greedy.
+        Requires temperature=0. The pump runs synchronously in this
+        mode (one device round-trip per verify), which the multi-token
+        rounds amortize; rows within k+1 of the cache end fall back
+        to plain chunks.
+    spec_ngram:
+        Drafting n-gram length (the context suffix matched against
+        earlier context). 2 is the prompt-lookup default.
     """
 
     def __init__(
@@ -435,6 +508,8 @@ class ContinuousBatchingEngine:
         max_tokens_per_round: Optional[int] = None,
         prefix_cache_tokens: int = 0,
         prefix_cache_max: int = 8,
+        spec_decode_k: int = 0,
+        spec_ngram: int = 2,
     ):
         cfg = model.config
         if not (cfg.decode and cfg.ragged_decode):
@@ -564,6 +639,28 @@ class ContinuousBatchingEngine:
         # key (prefix token bytes) -> (stage, snapshot cache tree)
         self._prefix_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # Self-speculative decode (docs/SERVING.md "Disaggregation"):
+        # > 0 turns the decode pump into draft-k/verify rounds — the
+        # n-gram draft proposes K tokens, ONE ragged verify step
+        # checks them all, and the accepted prefix (+ bonus token)
+        # lands in one round instead of K. Greedy-only: acceptance is
+        # bit-identical to plain decode, which sampling cannot be.
+        self.spec_decode_k = int(spec_decode_k)
+        self.spec_ngram = max(1, int(spec_ngram))
+        if self.spec_decode_k > 0 and float(temperature) != 0.0:
+            raise ValueError(
+                "spec_decode_k requires temperature=0 (greedy): the "
+                "accept-prefix rule is only bit-identical to the "
+                "plain decode path under argmax")
+        # host mirrors of the device scheduling vectors — authoritative
+        # only in spec-decode mode, where every round is synchronous
+        self._tok_h = np.zeros(self.max_slots, np.int32)
+        self._len_h = np.zeros(self.max_slots, np.int32)
+        self._budget_h = np.zeros(self.max_slots, np.int32)
+        # slot -> first token of the admission that just filled it
+        # (device scalar or host int); consumed by the spec-mode pump,
+        # which attributes fills inline instead of via packed row 0
+        self._fill_toks: Dict[int, object] = {}
         # key -> device bytes of that snapshot; summed into
         # stats["prefix_cache_bytes"] on every insert/evict so the LRU
         # is bytes-accounted, not just count-bounded — the number fleet
@@ -633,11 +730,115 @@ class ContinuousBatchingEngine:
                       "ttft_s_sum": 0.0, "ttft_count": 0,
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_captures": 0, "prefix_tokens_saved": 0,
-                      "prefix_cache_bytes": 0}
+                      "prefix_cache_bytes": 0,
+                      # disaggregation: prefill-only completions and
+                      # KV-seeded slot admissions (docs/SERVING.md)
+                      "kv_prefills": 0, "kv_admits": 0,
+                      # self-speculative decode: rounds run, draft
+                      # tokens proposed, draft tokens accepted, rounds
+                      # that fell back to the plain chunk path
+                      "spec_decode_rounds": 0, "spec_decode_drafted": 0,
+                      "spec_decode_accepted": 0,
+                      "spec_decode_fallbacks": 0}
 
     # -- request intake --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = self._validate_submit(prompt, max_new_tokens)
+        req = Request(next(self._rid), prompt, int(max_new_tokens),
+                      submitted_at=time.perf_counter())
+        self._enqueue(req)
+        return req.rid
+
+    def submit_prefill(self, prompt, max_new_tokens: int) -> int:
+        """Disaggregated serving, prefill half: run chunked prefill to
+        completion and finish with the first token + a host-side KV
+        snapshot (``Request.kv_result``) instead of occupying a decode
+        slot. ``max_new_tokens`` is recorded for the handoff metadata
+        only — the decode pool spends it."""
+        if not self.chunked_prefill:
+            raise ValueError(
+                "submit_prefill needs chunked_prefill=True: the KV "
+                "handoff unit is the chunked-prefill working cache")
+        prompt = self._validate_submit(prompt, max_new_tokens)
+        req = Request(next(self._rid), prompt, int(max_new_tokens),
+                      submitted_at=time.perf_counter(),
+                      prefill_only=True)
+        self._enqueue(req)
+        return req.rid
+
+    def submit_with_kv(self, kv: dict, max_new_tokens: int) -> int:
+        """Disaggregated serving, decode half: admit a request whose
+        prefill already ran elsewhere. ``kv`` is the unpacked handoff:
+        ``plen`` (real prompt tokens), ``rows`` (cache rows carried,
+        a chunk-grid multiple >= plen), ``first_token`` (the prefill
+        worker's greedy pick), ``leaves`` (host cache arrays in tree-
+        flatten order) and optionally ``prompt`` (token ids, kept for
+        bookkeeping). The snapshot scatters into a free slot exactly
+        like a locally-prefilled working cache; decode then proceeds
+        bit-identically to the interleaved path."""
+        plen = int(kv["plen"])
+        rows = int(kv["rows"])
+        if plen < 1 or rows < plen:
+            raise ValueError(f"kv seed: bad plen={plen} rows={rows}")
+        if rows > self.max_seq:
+            raise ValueError(
+                f"kv seed: rows {rows} exceed cache size {self.max_seq}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if plen + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {max_new_tokens} exceeds cache "
+                f"size {self.max_seq}")
+        cache_leaves: List = []
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: cache_leaves.append((p, x)), self._cache)
+        if len(kv["leaves"]) != len(cache_leaves):
+            raise ValueError(
+                f"kv seed: {len(kv['leaves'])} leaves != engine cache's "
+                f"{len(cache_leaves)} (model configs must match across "
+                "pools)")
+        # validate SHAPES and DTYPES here, on the intake thread — a
+        # mismatch surfacing later inside _admit_kv's jitted scatter
+        # would raise on the PUMP thread and take the whole replica
+        # down with it, instead of 400-ing one request
+        for i, ((path, big), leaf) in enumerate(
+                zip(cache_leaves, kv["leaves"])):
+            name = path[-1].key if hasattr(path[-1], "key") \
+                else str(path[-1])
+            axis = big.ndim - 2 if name in ("cached_key", "cached_value") \
+                else big.ndim - 1
+            want = list(big.shape)
+            want[big.ndim - 4] = 1      # batch-1 working cache
+            want[axis] = rows
+            got = np.asarray(leaf)
+            if list(got.shape) != want or got.dtype != big.dtype:
+                raise ValueError(
+                    f"kv seed: leaf {i} ({name}) is "
+                    f"{got.dtype}{list(got.shape)}, engine expects "
+                    f"{big.dtype}{want} (model configs must match "
+                    "across pools)")
+        prompt = np.asarray(
+            kv.get("prompt") if kv.get("prompt") is not None
+            else np.zeros(plen, np.int32), np.int32).reshape(-1)
+        req = Request(next(self._rid), prompt, int(max_new_tokens),
+                      submitted_at=time.perf_counter(), kv_seed=kv)
+        self._enqueue(req)
+        return req.rid
+
+    def _enqueue(self, req: Request) -> None:
+        # the closed check and the enqueue must be one atomic unit vs a
+        # concurrent close() (submit is documented callable from an
+        # arrival thread): after close() the harvesters are gone, so a
+        # request slipping past an unsynchronized check would enqueue
+        # onto a dead engine and its caller would wait forever
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._reqs[req.rid] = req
+            self._queue.append(req)
+
+    def _validate_submit(self, prompt, max_new_tokens: int) -> np.ndarray:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -667,19 +868,7 @@ class ContinuousBatchingEngine:
                 f"prompt {prompt.size} + new {max_new_tokens} exceeds "
                 f"cache size {self.max_seq}"
             )
-        req = Request(next(self._rid), prompt, int(max_new_tokens),
-                      submitted_at=time.perf_counter())
-        # the closed check and the enqueue must be one atomic unit vs a
-        # concurrent close() (submit is documented callable from an
-        # arrival thread): after close() the harvesters are gone, so a
-        # request slipping past an unsynchronized check would enqueue
-        # onto a dead engine and its caller would wait forever
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("engine is closed")
-            self._reqs[req.rid] = req
-            self._queue.append(req)
-        return req.rid
+        return prompt
 
     def queue_depth(self) -> int:
         """LIVE admission-queue depth (requests accepted but not yet
@@ -711,6 +900,11 @@ class ContinuousBatchingEngine:
             if self._slot_req[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            if req.kv_seed is not None:
+                # KV-seeded admission works on the legacy path too —
+                # the scatter/activate machinery is path-independent
+                self._admit_kv(req, slot, fills)
+                continue
             req.prefill_start_at = time.perf_counter()
             plen = int(req.prompt.size)
             plen_b = self._bucket_for(plen)
@@ -735,6 +929,8 @@ class ContinuousBatchingEngine:
             self._slot_req[slot] = req
             self._active_h[slot] = True  # optimistic; fixed at harvest
             fills[slot] = req.rid
+            if self.spec_decode_k > 0:
+                self._fill_toks[slot] = tok_new
         return fills
 
     def _free_slot(self) -> Optional[int]:
@@ -762,6 +958,59 @@ class ContinuousBatchingEngine:
         if stage not in self._pcaches:
             self._pcaches[stage] = _init_cache(model, self.params, 1)
         return model, self._pcaches[stage]
+
+    def _snapshot_kv(self, pcache, rows: int) -> List[np.ndarray]:
+        """Host-side copy of the working cache's first ``rows`` rows
+        per leaf, in tree-flatten order — the KV handoff payload.
+        ``np.array(copy=True)``: on CPU backends ``np.asarray`` is a
+        ZERO-COPY view of the device buffer, which the next prompt's
+        donated chunk would scribble over (the PR 9 checkpoint-save
+        lesson, same bug class)."""
+
+        def one(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") \
+                else str(path[-1])
+            if name in ("cached_key", "cached_value"):
+                axis = leaf.ndim - 2
+            elif name in ("key_scale", "value_scale"):
+                axis = leaf.ndim - 1
+            else:
+                raise ValueError(f"unknown cache leaf {name!r}")
+            return np.array(
+                jax.lax.slice_in_dim(leaf, 0, rows, axis=axis),
+                copy=True)
+
+        return jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map_with_path(one, pcache))
+
+    def _admit_kv(self, req: Request, slot: int,
+                  fills: Dict[int, int]) -> None:
+        """Scatter a received KV snapshot into ``slot`` and activate it
+        — the decode-side half of the handoff. No prefill compute and
+        no token budget spent: the scatter is one DUS write, the same
+        touch the local final-chunk path pays."""
+        kv = req.kv_seed
+        req.prefill_start_at = time.perf_counter()
+        treedef = jax.tree_util.tree_structure(self._cache)
+        ptree = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x) for x in kv["leaves"]])
+        rows = int(kv["rows"])
+        self._cache = _scatter_slot_rows(
+            self._cache, ptree, jnp.int32(slot), rows_b=rows)
+        first = int(kv["first_token"])
+        (self._tok, self._lengths, self._active,
+         self._budget) = _set_slot(
+            self._tok, self._lengths, self._active, self._budget,
+            jnp.int32(slot), jnp.int32(first), jnp.int32(kv["plen"]),
+            jnp.int32(req.max_new_tokens), eos_id=self.eos_id,
+        )
+        req.prefill_done = int(kv["plen"])
+        self.stats["kv_admits"] += 1
+        self._slot_req[slot] = req
+        self._active_h[slot] = True  # optimistic; fixed at harvest
+        fills[slot] = req.rid
+        if self.spec_decode_k > 0:
+            self._fill_toks[slot] = first
 
     def _admit_prefix(self, req: Request) -> None:
         """Prefix-cache lookup at admission of the next prompt to
@@ -812,9 +1061,25 @@ class ContinuousBatchingEngine:
             if self._prefilling is None:
                 if not self._queue:
                     break
-                slot = self._free_slot()
-                if slot is None:
-                    break
+                head = self._queue[0]
+                if head.kv_seed is not None:
+                    # KV-seeded admission: no prefill compute, no
+                    # budget spent — just a slot and one DUS scatter
+                    slot = self._free_slot()
+                    if slot is None:
+                        break
+                    self._queue.popleft()
+                    self._admit_kv(head, slot, fills)
+                    continue
+                if head.prefill_only:
+                    # prefill-only requests never hold a decode slot:
+                    # their product is the working-cache snapshot, not
+                    # a decode stream
+                    slot = None
+                else:
+                    slot = self._free_slot()
+                    if slot is None:
+                        break
                 self._prefilling = self._queue.popleft()
                 self._prefilling.prefill_start_at = time.perf_counter()
                 self._prefill_slot = slot
@@ -842,7 +1107,7 @@ class ContinuousBatchingEngine:
             offset = req.prefill_done
             padded = np.zeros((1, chunk_b), np.int32)
             padded[0, :take] = req.prompt[offset:offset + take]
-            if final and offset == 0:
+            if final and offset == 0 and not req.prefill_only:
                 # single-chunk prompt (the common case): the legacy
                 # one-shot insert is strictly better — fresh cache
                 # rides the flash kernel instead of the warm-cache
@@ -872,6 +1137,8 @@ class ContinuousBatchingEngine:
                 self._slot_req[slot] = req
                 self._active_h[slot] = True  # optimistic
                 fills[slot] = req.rid
+                if self.spec_decode_k > 0:
+                    self._fill_toks[slot] = tok_new
                 self._prefilling = None
                 self._prefill_slot = None
                 self._pstage = None
@@ -930,6 +1197,36 @@ class ContinuousBatchingEngine:
                 rows_b = min(stage,
                              -(-rows // self.prefill_chunk)
                              * self.prefill_chunk)
+                if req.prefill_only:
+                    # disaggregation: the finished working cache IS the
+                    # product — snapshot it to host (the wire payload)
+                    # with the first token, and complete the request
+                    # without ever touching a decode slot
+                    first = int(tok_new)  # host sync; one per prompt
+                    req.kv_result = {
+                        "plen": int(req.prompt.size),
+                        "rows": rows_b,
+                        "first_token": first,
+                        "prompt": [int(t) for t in req.prompt],
+                        "leaves": self._snapshot_kv(pcache, rows_b),
+                    }
+                    req.tokens.append(first)
+                    now = time.perf_counter()
+                    req.first_token_at = now
+                    req.finished_at = now
+                    req.token_times.append((now, 1))
+                    self.stats["ttft_s_sum"] += now - req.submitted_at
+                    self.stats["ttft_count"] += 1
+                    self.stats["prefills"] += 1
+                    self.stats["kv_prefills"] += 1
+                    req.done = True
+                    with self._lock:
+                        self._done[req.rid] = self._reqs.pop(
+                            req.rid, req)
+                    self._prefilling = None
+                    self._prefill_slot = None
+                    self._pstage = None
+                    continue
                 self._cache = _scatter_slot_rows(
                     self._cache, pcache, jnp.int32(slot),
                     rows_b=rows_b,
@@ -945,6 +1242,8 @@ class ContinuousBatchingEngine:
                 self._slot_req[slot] = req
                 self._active_h[slot] = True  # optimistic; fixed at harvest
                 fills[slot] = req.rid
+                if self.spec_decode_k > 0:
+                    self._fill_toks[slot] = tok_new
                 self._prefilling = None
                 self._prefill_slot = None
                 self._pstage = None
@@ -1010,6 +1309,13 @@ class ContinuousBatchingEngine:
         tok_in, toks = arr[0], arr[1:K + 1]
         valid = arr[K + 1:2 * K + 1].astype(bool)
         active_out = arr[2 * K + 1].astype(bool)
+        if self.spec_decode_k > 0:
+            # spec mode runs synchronously (one chunk ever in flight),
+            # so this packed view IS the current device state — refresh
+            # the host mirrors the next verify round plans from
+            self._tok_h[:] = arr[K]
+            self._budget_h[:] = arr[2 * K + 2]
+            self._len_h[:] = arr[2 * K + 3]
         self.stats["chunk_s"] += time.perf_counter() - t0
         self.stats["wasted_slot_steps"] += int((~valid).sum())
         now = time.perf_counter()
@@ -1046,11 +1352,157 @@ class ContinuousBatchingEngine:
                     self._active_h[slot] = False
         return True
 
+    # -- self-speculative decode (docs/SERVING.md "Disaggregation") ------
+
+    def _push_state(self) -> None:
+        """Host mirrors → device vectors. In spec mode the mirrors are
+        authoritative between rounds; the device copies only exist for
+        the jits (verify, plain fallback chunk, slot admission)."""
+        self._tok = jnp.asarray(self._tok_h)
+        self._lengths = jnp.asarray(self._len_h)
+        self._active = jnp.asarray(self._active_h)
+        self._budget = jnp.asarray(self._budget_h)
+
+    def _finish_slot(self, slot: int, req: Request) -> None:
+        req.done = True
+        req.finished_at = time.perf_counter()
+        with self._lock:
+            self._done[req.rid] = self._reqs.pop(req.rid, req)
+        self._slot_req[slot] = None
+        self._active_h[slot] = False
+
+    def _spec_step(self) -> bool:
+        """Spec-mode pump round: admissions attribute inline (the host
+        mirrors need every first token anyway), then one verify round
+        advances every active slot by 1 + accepted-draft tokens."""
+        fills = (self._schedule_prefill() if self.chunked_prefill
+                 else self._fill_free_slots())
+        self.stats["queue_depth"] = len(self._queue)
+        now = time.perf_counter()
+        for slot, rid in fills.items():
+            req = self._reqs.get(rid)
+            tok = int(self._fill_toks.pop(slot))
+            if req is None or req.done:
+                continue
+            req.tokens.append(tok)
+            req.first_token_at = now
+            req.token_times.append((now, 1))
+            self.stats["ttft_s_sum"] += now - req.submitted_at
+            self.stats["ttft_count"] += 1
+            self._tok_h[slot] = tok
+            self._len_h[slot] = req.prefill_done
+            self._budget_h[slot] = req.max_new_tokens - 1
+            alive = self._budget_h[slot] > 0 and (
+                self.eos_id is None or tok != self.eos_id)
+            self._active_h[slot] = bool(alive)
+            if not alive:
+                self._finish_slot(slot, req)
+        if self._active_h.any():
+            self._spec_round()
+        return bool(
+            self._queue or self._prefilling is not None
+            or any(r is not None for r in self._slot_req)
+        )
+
+    def _spec_round(self) -> None:
+        """Draft-K / verify-once / accept-prefix for every active slot.
+        Bit-identical to sequential greedy decode: the verify forward
+        runs the SAME warm-cache continuation path at the same
+        positions, and only tokens whose entire input prefix matched
+        the sequential stream are kept (plus the bonus correction,
+        which is itself the sequential next token)."""
+        K = self.spec_decode_k
+        active_idx = [i for i in range(self.max_slots)
+                      if self._active_h[i]]
+        if any(int(self._len_h[i]) + K + 1 > self.max_seq
+               for i in active_idx):
+            # a row too close to the cache end would clamp the verify
+            # DUS (corrupting EARLIER rows) — run one plain chunk
+            # round instead; rare, and only near end-of-cache
+            self.stats["spec_decode_fallbacks"] += 1
+            self._plain_sync_round()
+            return
+        x = np.zeros((self.max_slots, K + 1), np.int32)
+        pos = np.broadcast_to(
+            np.arange(K + 1, dtype=np.int32),
+            (self.max_slots, K + 1)).copy()
+        drafted = 0
+        for i in active_idx:
+            req = self._slot_req[i]
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            d = _ngram_draft(ctx, K, self.spec_ngram)
+            drafted += int(d.size)
+            x[i, 0] = self._tok_h[i]
+            x[i, 1:1 + d.size] = d
+            pos[i] += self._len_h[i]
+            # inactive rows keep pos = arange(K+1) at offset 0: their
+            # writes land on rows the next occupant's prefill scatter
+            # or decode append overwrites before any read (the
+            # engine-wide garbage-tolerance contract)
+        self._push_state()
+        self._cache, toks = _verify_chunk(
+            self.model, self.params, self._cache,
+            jnp.asarray(x), jnp.asarray(pos))
+        t = np.asarray(toks)  # [B, K+1]; sync fetch — spec mode's RTT
+        now = time.perf_counter()
+        self.stats["spec_decode_rounds"] += 1
+        self.stats["spec_decode_drafted"] += drafted
+        self.stats["chunks"] += 1
+        self.stats["decode_steps"] += 1
+        for i in active_idx:
+            req = self._slot_req[i]
+            # accept-prefix: draft j survives iff it equals the greedy
+            # token after the (already-accepted) prefix before it. A
+            # pad that happens to equal the true token is sound to
+            # accept — its KV row is then the true token's KV.
+            a = 0
+            while a < K and x[i, a + 1] == t[i, a]:
+                a += 1
+            emitted = [int(v) for v in x[i, 1:a + 1]] + [int(t[i, a])]
+            m = min(len(emitted), int(self._budget_h[i]))
+            emitted = emitted[:m]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            if not emitted:
+                continue
+            # the first `a` emitted tokens are accepted DRAFTS; the
+            # bonus only rides when nothing truncated it — counting
+            # len-1 unconditionally under-reported truncated rounds
+            self.stats["spec_decode_accepted"] += min(len(emitted), a)
+            req.tokens.extend(emitted)
+            req.token_times.append((now, len(emitted)))
+            self._budget_h[i] -= len(emitted)
+            self._tok_h[i] = emitted[-1]
+            # the newest token's position is L + len(emitted) in both
+            # cases (bonus kept or cut); when cut, its KV row is
+            # already written and the next feed rewrites it
+            # idempotently at the same position
+            self._len_h[i] += len(emitted)
+            hit_eos = (self.eos_id is not None
+                       and emitted[-1] == self.eos_id)
+            alive = (self._budget_h[i] > 0 and not hit_eos
+                     and self._len_h[i] < self.max_seq)
+            self._active_h[i] = bool(alive)
+            if not alive:
+                self._finish_slot(i, req)
+
+    def _plain_sync_round(self) -> None:
+        """One plain decode chunk, dispatched and harvested in place —
+        the spec pump's end-of-cache fallback. The packed fetch
+        refreshes the host mirrors via _attribute's spec-mode hook."""
+        self._push_state()
+        self._dispatch_chunk({})
+        while self._unattributed:
+            self._attribute(block=True)
+
     def step(self) -> bool:
         """One pump round: attribute whatever the harvester finished,
         fill free slots, dispatch. Returns True while work remains."""
         if self._closed:
             raise RuntimeError("engine is closed")
+        if self.spec_decode_k > 0:
+            return self._spec_step()
         while self._attribute(block=False):
             pass
         if self._unattributed >= self.pipeline_depth:

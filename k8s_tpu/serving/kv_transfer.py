@@ -1,0 +1,174 @@
+"""KV-handoff wire format for disaggregated prefill/decode serving.
+
+A prefill worker finishes a prompt's chunked prefill holding the
+request's working KV cache — the exact-token device object the
+shared-prefix snapshot machinery already captures (docs/SERVING.md
+"Fleet"). Phase-split serving ships that object to a DECODE worker
+over ``POST /v1/kv/{handle}`` on the engine front-end, and this module
+is the wire format: the same idiom as the checkpoint peer-shard wire
+(:mod:`k8s_tpu.ckpt.peer` — plain bytes over stdlib HTTP, integrity
+checked per chunk), shaped for a pytree of cache leaves instead of a
+single shard.
+
+Frame layout (all integers little-endian uint32)::
+
+    [manifest_len][manifest JSON utf-8]
+    repeat per chunk: [chunk_len][crc32][chunk bytes]
+
+The manifest carries the handle metadata (``plen``, ``rows``,
+``first_token``, the prompt token ids) plus per-leaf ``shape``/
+``dtype`` specs in CACHE-TREE FLATTEN ORDER — both ends run the same
+model config, so ``jax.tree_util`` flattening orders the leaves
+identically and no treedef crosses the wire. Leaf payloads are
+concatenated into fixed-size chunks, each with its own crc32 — a
+truncated or bit-flipped transfer fails loudly at the receiver (the
+sender then takes the local-prefill fallback instead of handing the
+decode pool a corrupt cache).
+
+Stdlib + numpy only: this rides in the same ConfigMap-shipped image as
+the launcher.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# 1 MiB chunks: big enough that framing overhead vanishes, small
+# enough that a mid-transfer kill is detected within one crc window
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+_U32 = struct.Struct("<I")
+
+
+def _dtype_of(name: str) -> np.dtype:
+    """Resolve a dtype NAME, falling back to the ml_dtypes extension
+    types (bfloat16 etc.) numpy proper doesn't know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_kv(meta: Dict, leaves: List[np.ndarray],
+            chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> bytes:
+    """Serialize ``meta`` + cache leaves into one framed body.
+    ``meta`` must be JSON-serializable; ``leaves`` are the host-side
+    cache arrays in tree-flatten order.
+
+    Chunks frame directly from each leaf's contiguous byte view — a
+    handoff is potentially hundreds of MB, so intermediate
+    whole-payload copies (leaf → payload buffer → output) would spike
+    host memory ~3x per push on a pod already holding the model;
+    the single copy here is the append into the output buffer."""
+    specs = []
+    flats = []
+    total = 0
+    for leaf in leaves:
+        a = np.ascontiguousarray(leaf)
+        # dtype by NAME, not .str: extension dtypes (bfloat16 via
+        # ml_dtypes — the serving cache's common dtype) stringify to
+        # an opaque void spec under .str and would not round-trip
+        specs.append({"shape": list(a.shape), "dtype": a.dtype.name})
+        flat = a.reshape(-1).view(np.uint8)
+        flats.append(flat)
+        total += flat.size
+    manifest = dict(meta)
+    manifest["leaves"] = specs
+    manifest["total_bytes"] = total
+    mbytes = json.dumps(manifest).encode()
+    out = bytearray()
+    out += _U32.pack(len(mbytes))
+    out += mbytes
+    wrote = 0
+    for flat in flats:
+        for off in range(0, flat.size, chunk_bytes):
+            chunk = flat[off:off + chunk_bytes]
+            out += _U32.pack(chunk.size)
+            out += _U32.pack(zlib.crc32(chunk) & 0xFFFFFFFF)
+            out += memoryview(chunk)
+            wrote += chunk.size
+    if wrote == 0:
+        # zero-byte payloads still get one (empty) framed chunk so the
+        # receiver's loop shape is uniform
+        out += _U32.pack(0)
+        out += _U32.pack(zlib.crc32(b"") & 0xFFFFFFFF)
+    return bytes(out)
+
+
+def unpack_kv(body: bytes) -> Tuple[Dict, List[np.ndarray]]:
+    """Parse one framed body back into ``(meta, leaves)``. Raises
+    ``ValueError`` on any framing/crc/shape inconsistency — the
+    receiver maps that to HTTP 400 and the sender falls back."""
+    if len(body) < _U32.size:
+        raise ValueError("kv transfer: truncated (no manifest length)")
+    (mlen,) = _U32.unpack_from(body, 0)
+    off = _U32.size
+    if off + mlen > len(body):
+        raise ValueError("kv transfer: truncated manifest")
+    try:
+        manifest = json.loads(body[off:off + mlen])
+    except Exception as e:
+        raise ValueError(f"kv transfer: bad manifest: {e}")
+    off += mlen
+    # walk the frames verifying crcs against VIEWS of the body (no
+    # payload-wide copy — the sender-side rationale in pack_kv), and
+    # record each chunk's (start, len) range for the fill pass below
+    view = memoryview(body)
+    ranges = []
+    total_seen = 0
+    while off < len(body):
+        if off + 2 * _U32.size > len(body):
+            raise ValueError("kv transfer: truncated chunk header")
+        (clen,) = _U32.unpack_from(body, off)
+        (crc,) = _U32.unpack_from(body, off + _U32.size)
+        off += 2 * _U32.size
+        if off + clen > len(body):
+            raise ValueError("kv transfer: truncated chunk body")
+        if zlib.crc32(view[off:off + clen]) & 0xFFFFFFFF != crc:
+            raise ValueError("kv transfer: chunk crc32 mismatch")
+        ranges.append((off, clen))
+        total_seen += clen
+        off += clen
+    total = int(manifest.get("total_bytes", -1))
+    if total != total_seen:
+        raise ValueError(
+            f"kv transfer: payload {total_seen} bytes != manifest "
+            f"total {total}")
+    specs = manifest.pop("leaves", [])
+    leaves: List[np.ndarray] = []
+    # fill pass: ONE copy, body ranges → each leaf's own buffer (a
+    # leaf may span chunk boundaries; a chunk never spans leaves the
+    # way pack_kv frames, but tolerating it here keeps the format
+    # boundary-agnostic)
+    ri, rpos = 0, 0
+    for spec in specs:
+        dt = _dtype_of(spec["dtype"])
+        shape = tuple(int(d) for d in spec["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        arr = np.empty(shape, dt)
+        flat = arr.reshape(-1).view(np.uint8)
+        pos = 0
+        while pos < n:
+            if ri >= len(ranges):
+                raise ValueError("kv transfer: leaf overruns payload")
+            start, clen = ranges[ri]
+            take = min(n - pos, clen - rpos)
+            flat[pos:pos + take] = np.frombuffer(
+                view[start + rpos:start + rpos + take], np.uint8)
+            pos += take
+            rpos += take
+            if rpos == clen:
+                ri, rpos = ri + 1, 0
+        leaves.append(arr)
+    while ri < len(ranges) and ranges[ri][1] == rpos:
+        ri, rpos = ri + 1, 0  # fully-consumed / empty trailing frames
+    if ri < len(ranges):
+        raise ValueError("kv transfer: trailing payload bytes")
+    return manifest, leaves

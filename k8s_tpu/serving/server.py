@@ -41,10 +41,14 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.request
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 import numpy as np
+
+from k8s_tpu.serving import kv_transfer
 
 
 class Overloaded(RuntimeError):
@@ -59,10 +63,10 @@ class Overloaded(RuntimeError):
 class _Result:
     """One finished request's payload + timing, resolved to a waiter."""
 
-    __slots__ = ("tokens", "ttft_s", "itl_ms", "spans")
+    __slots__ = ("tokens", "ttft_s", "itl_ms", "spans", "kv")
 
     def __init__(self, tokens, ttft_s: float, itl_ms: float,
-                 spans=None):
+                 spans=None, kv=None):
         self.tokens = tokens
         self.ttft_s = ttft_s
         self.itl_ms = itl_ms
@@ -71,6 +75,9 @@ class _Result:
         # three derive from the same request timestamps), decode_s is
         # the stream tail after the first token
         self.spans = spans or {}
+        # prefill-only requests: the working-cache KV snapshot +
+        # handoff metadata (docs/SERVING.md "Disaggregation")
+        self.kv = kv
 
 
 class ServingFrontend:
@@ -87,11 +94,25 @@ class ServingFrontend:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  request_timeout: float = 300.0,
-                 max_queue_depth: int = 0, retry_after_s: float = 1.0):
+                 max_queue_depth: int = 0, retry_after_s: float = 1.0,
+                 role: str = "", kv_store_max: int = 32,
+                 kv_store_max_bytes: int = 1 << 30,
+                 kv_ttl_s: float = 120.0,
+                 kv_push_timeout: float = 30.0):
         self.engine = engine
         self.request_timeout = float(request_timeout)
         self.max_queue_depth = int(max_queue_depth)
         self.retry_after_s = float(retry_after_s)
+        # disaggregation (docs/SERVING.md "Disaggregation"): "" =
+        # interleaved (today's fleet), "prefill"/"decode" = phase pool
+        # membership. Steering-only: every replica keeps the full
+        # route surface so the fallback ladder always has somewhere
+        # to land.
+        self.role = str(role or "")
+        self.kv_store_max = int(kv_store_max)
+        self.kv_store_max_bytes = int(kv_store_max_bytes)
+        self.kv_ttl_s = float(kv_ttl_s)
+        self.kv_push_timeout = float(kv_push_timeout)
         self._lock = threading.Lock()
         self._waiters: Dict[int, threading.Event] = {}
         self._results: Dict[int, object] = {}
@@ -101,6 +122,20 @@ class ServingFrontend:
         self.abandoned = 0               # finished after the waiter timed out
         self.rejected = 0                # refused by backpressure (429s)
         self._healthz_faults = 0         # armed stats-endpoint failures (chaos)
+        # received-KV handle store (decode pool): handle -> (meta,
+        # leaves, nbytes); single-use (popped by /v1/decode) and
+        # bounded by COUNT and BYTES — each entry is a full per-
+        # request KV snapshot (hundreds of MB for a long prompt), so a
+        # count bound alone would let orphaned handoffs (router died,
+        # decode leg fell back) pin tens of GB of dead host buffers
+        # (the prefix-LRU bytes-accounting lesson)
+        self._kv_store: "OrderedDict[str, tuple]" = OrderedDict()
+        self._kv_store_bytes = 0
+        self.kv_received = 0
+        self.kv_bytes_in = 0
+        self.kv_pushed = 0
+        self.kv_push_failures = 0
+        self.kv_bytes_out = 0
 
         frontend = self
 
@@ -161,6 +196,13 @@ class ServingFrontend:
                     # — capacity planning reads this next to
                     # stats.prefix_cache_bytes
                     **({"hbm": hbm} if hbm else {}),
+                    # phase-pool membership + KV-handoff counters
+                    # (docs/SERVING.md "Disaggregation"); absent for
+                    # interleaved replicas so the pre-disagg healthz
+                    # shape is byte-identical
+                    **({"role": frontend.role,
+                        "kv": frontend._kv_store_stats()}
+                       if frontend.role else {}),
                     "draining": frontend._draining,
                     "in_flight": in_flight,
                     "served": frontend.served,
@@ -185,16 +227,7 @@ class ServingFrontend:
                               for k, v in frontend.engine.stats.items()},
                 })
 
-            def do_POST(self):  # noqa: N802
-                if self.path != "/v1/generate":
-                    return self._json(404, {"error": "not found"})
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    prompt = np.asarray(req["prompt"], np.int32)
-                    max_new = int(req.get("max_new_tokens", 16))
-                except Exception as e:  # malformed request → caller's 400
-                    return self._json(400, {"error": f"bad request: {e}"})
+            def _trace_id(self):
                 # trace propagation: honor the caller's id (the router
                 # forwards one), mint one otherwise — every response
                 # carries the id its spans are attributable under
@@ -203,6 +236,31 @@ class ServingFrontend:
                     import uuid
 
                     trace_id = "req-" + uuid.uuid4().hex[:12]
+                return trace_id
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
+            def do_POST(self):  # noqa: N802
+                if self.path == "/v1/generate":
+                    return self._generate()
+                if self.path == "/v1/prefill":
+                    return self._prefill()
+                if self.path == "/v1/decode":
+                    return self._decode()
+                if self.path.startswith("/v1/kv/"):
+                    return self._kv_put(self.path[len("/v1/kv/"):])
+                return self._json(404, {"error": "not found"})
+
+            def _generate(self):
+                try:
+                    req = json.loads(self._body())
+                    prompt = np.asarray(req["prompt"], np.int32)
+                    max_new = int(req.get("max_new_tokens", 16))
+                except Exception as e:  # malformed request → caller's 400
+                    return self._json(400, {"error": f"bad request: {e}"})
+                trace_id = self._trace_id()
                 t0 = time.perf_counter()
                 try:
                     result = frontend.submit_and_wait(prompt, max_new)
@@ -233,6 +291,117 @@ class ServingFrontend:
                               for k, v in result.spans.items()},
                 })
 
+            def _prefill(self):
+                """Disaggregation, prefill leg: chunked-prefill the
+                prompt to completion, push the finished working KV to
+                the router-chosen decode target, return the handle +
+                spans. A failed push degrades to serving the WHOLE
+                request locally (the local-prefill fallback) — a lost
+                transfer costs latency, never the request."""
+                try:
+                    req = json.loads(self._body())
+                    prompt = np.asarray(req["prompt"], np.int32)
+                    max_new = int(req.get("max_new_tokens", 16))
+                    kv_target = str(req.get("kv_target") or "")
+                    handle = str(req.get("handle") or "")
+                    if not kv_target or not handle:
+                        raise ValueError("kv_target and handle required")
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                trace_id = self._trace_id()
+                try:
+                    code, payload = frontend.prefill_and_push(
+                        prompt, max_new, kv_target, handle)
+                except Overloaded as e:
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After":
+                                 f"{frontend.retry_after_s:g}"})
+                except RuntimeError as e:
+                    return self._json(503, {"error": str(e)})
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except TimeoutError as e:
+                    return self._json(504, {"error": str(e)})
+                payload["trace_id"] = trace_id
+                return self._json(code, payload)
+
+            def _decode(self):
+                """Disaggregation, decode leg: seed a slot from a
+                received KV handle and stream to completion. 404 on an
+                unknown handle — the router's cue to fall back."""
+                try:
+                    req = json.loads(self._body())
+                    handle = str(req.get("handle") or "")
+                    max_new = int(req.get("max_new_tokens", 16))
+                    if not handle:
+                        raise ValueError("handle required")
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                trace_id = self._trace_id()
+                entry = frontend._kv_pop(handle)
+                if entry is None:
+                    return self._json(
+                        404, {"error": f"unknown kv handle {handle!r}"})
+                meta, leaves, nbytes = entry
+                t0 = time.perf_counter()
+                try:
+                    result = frontend.submit_and_wait_kv(
+                        {**meta, "leaves": leaves}, max_new)
+                except Overloaded as e:
+                    # admission never happened and the snapshot is
+                    # intact: restore it so a post-backoff retry costs
+                    # nothing instead of a full interleaved re-prefill
+                    frontend._kv_restore(handle, meta, leaves, nbytes)
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After":
+                                 f"{frontend.retry_after_s:g}"})
+                except RuntimeError as e:
+                    frontend._kv_restore(handle, meta, leaves, nbytes)
+                    return self._json(503, {"error": str(e)})
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except TimeoutError as e:
+                    return self._json(504, {"error": str(e)})
+                return self._json(200, {
+                    "tokens": [int(t) for t in result.tokens],
+                    "latency_s": round(time.perf_counter() - t0, 4),
+                    "ttft_s": round(result.ttft_s, 4),
+                    "itl_ms": round(result.itl_ms, 3),
+                    "trace_id": trace_id,
+                    "handle": handle,
+                    "spans": {k: round(v, 4)
+                              for k, v in result.spans.items()},
+                })
+
+            def _kv_put(self, handle: str):
+                """Receive one KV handoff (the peer-shard-wire idiom:
+                framed bytes, crc32 per chunk). A corrupt/truncated
+                body is the SENDER's 400 — it then takes the local
+                fallback instead of poisoning the decode pool."""
+                if not handle:
+                    return self._json(400, {"error": "empty handle"})
+                body = self._body()
+                if len(body) > frontend.kv_store_max_bytes:
+                    # reject BEFORE unpack: accepting a snapshot the
+                    # store cannot hold would 200 the push and then
+                    # self-evict it — every decode leg 404s and the
+                    # request pays prefill TWICE. A 413 here makes the
+                    # sender take its local-prefill fallback instead
+                    # (decode from the snapshot it already holds).
+                    return self._json(413, {
+                        "error": f"kv body {len(body)} bytes exceeds "
+                                 f"store capacity "
+                                 f"{frontend.kv_store_max_bytes}"})
+                try:
+                    meta, leaves = kv_transfer.unpack_kv(body)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                frontend._kv_store_put(handle, meta, leaves, len(body))
+                return self._json(200, {
+                    "ok": True, "handle": handle, "bytes": len(body)})
+
         class Server(ThreadingHTTPServer):
             daemon_threads = True
             # stock backlog is 5: a burst of concurrent clients (a
@@ -262,6 +431,17 @@ class ServingFrontend:
 
             M.SERVING_PREFIX_CACHE_BYTES.set(float(
                 self.engine.stats.get("prefix_cache_bytes", 0) or 0))
+            stats = self.engine.stats
+            if stats.get("spec_decode_rounds"):
+                # self-speculative decode telemetry (docs/SERVING.md
+                # "Disaggregation"): lifetime totals exported as
+                # gauges the fleet scrape reads per replica
+                M.SERVING_SPEC_DECODE_ROUNDS.set(
+                    float(stats.get("spec_decode_rounds", 0) or 0))
+                M.SERVING_SPEC_DECODE_DRAFTED.set(
+                    float(stats.get("spec_decode_drafted", 0) or 0))
+                M.SERVING_SPEC_DECODE_ACCEPTED.set(
+                    float(stats.get("spec_decode_accepted", 0) or 0))
         except Exception:
             pass
         try:
@@ -297,6 +477,23 @@ class ServingFrontend:
         load balancer retries another replica during rollout, and
         :class:`Overloaded` (429) when backpressure is on and the
         engine queue is at the threshold."""
+        return self._submit_and_wait(
+            lambda: self.engine.submit(prompt, max_new_tokens))
+
+    def submit_and_wait_kv(self, kv: dict, max_new_tokens: int) -> _Result:
+        """Decode-pool intake: same contract as :meth:`submit_and_wait`
+        over a received KV seed instead of a prompt."""
+        return self._submit_and_wait(
+            lambda: self.engine.submit_with_kv(kv, max_new_tokens))
+
+    def submit_and_wait_prefill(self, prompt,
+                                max_new_tokens: int) -> _Result:
+        """Prefill-pool intake: the result's ``kv`` field carries the
+        finished working-cache snapshot (``Request.kv_result``)."""
+        return self._submit_and_wait(
+            lambda: self.engine.submit_prefill(prompt, max_new_tokens))
+
+    def _submit_and_wait(self, submit_fn) -> _Result:
         with self._lock:
             if self._draining:
                 raise RuntimeError("draining: not accepting new requests")
@@ -306,7 +503,7 @@ class ServingFrontend:
                 raise Overloaded(
                     f"engine queue depth {self._queue_depth()} >= "
                     f"max_queue_depth {self.max_queue_depth}")
-            rid = self.engine.submit(prompt, max_new_tokens)
+            rid = submit_fn()
             ev = threading.Event()
             self._waiters[rid] = ev
         self._work.set()
@@ -324,6 +521,167 @@ class ServingFrontend:
         if isinstance(result, Exception):
             raise result
         return result
+
+    # -- disaggregation: KV handoff ---------------------------------------
+
+    def _kv_expire_locked(self) -> None:
+        """Drop entries older than ``kv_ttl_s`` (caller holds the
+        lock). Size bounds alone only reclaim on NEW pushes — an
+        orphaned handoff (router gave up after the retry, or died
+        between legs) on a then-quiet pod would pin its hundreds of
+        MB of host snapshot indefinitely; the TTL bounds retention in
+        TIME as well as bytes."""
+        if self.kv_ttl_s <= 0:
+            return
+        cutoff = time.monotonic() - self.kv_ttl_s
+        while self._kv_store:
+            handle = next(iter(self._kv_store))
+            if self._kv_store[handle][3] > cutoff:
+                break  # ordered by insert time: the rest are younger
+            _, _, nb, _ = self._kv_store.pop(handle)
+            self._kv_store_bytes -= nb
+
+    def _kv_insert(self, handle: str, meta: dict, leaves,
+                   nbytes: int) -> None:
+        """Shared insert/evict (count AND bytes bounds); caller holds
+        no lock."""
+        with self._lock:
+            self._kv_expire_locked()
+            old = self._kv_store.pop(handle, None)
+            if old is not None:
+                self._kv_store_bytes -= old[2]
+            self._kv_store[handle] = (meta, leaves, int(nbytes),
+                                      time.monotonic())
+            self._kv_store_bytes += int(nbytes)
+            while self._kv_store and (
+                    len(self._kv_store) > self.kv_store_max
+                    or self._kv_store_bytes > self.kv_store_max_bytes):
+                _, (_, _, nb, _) = self._kv_store.popitem(last=False)
+                self._kv_store_bytes -= nb
+
+    def _kv_store_put(self, handle: str, meta: dict, leaves,
+                      nbytes: int) -> None:
+        self._kv_insert(handle, meta, leaves, nbytes)
+        with self._lock:
+            self.kv_received += 1
+            self.kv_bytes_in += int(nbytes)
+
+    def _kv_pop(self, handle: str):
+        """Single-use handle lookup: ``(meta, leaves, nbytes)`` —
+        popped so a replayed decode call can't double-seed a slot from
+        a stale snapshot. An expired handle is a miss (→ 404 → the
+        router's fallback cue)."""
+        with self._lock:
+            self._kv_expire_locked()
+            entry = self._kv_store.pop(handle, None)
+            if entry is None:
+                return None
+            self._kv_store_bytes -= entry[2]
+            return entry[:3]
+
+    def _kv_restore(self, handle: str, meta: dict, leaves,
+                    nbytes: int) -> None:
+        """Re-insert a popped handle whose admission never happened
+        (transient Overloaded/draining) — the snapshot is intact, so a
+        retried decode call must not cost a full re-prefill. Does NOT
+        recount kv_received."""
+        self._kv_insert(handle, meta, leaves, nbytes)
+
+    def _kv_store_stats(self) -> dict:
+        with self._lock:
+            self._kv_expire_locked()
+            return {
+                "handles": len(self._kv_store),
+                "bytes_held": self._kv_store_bytes,
+                "received": self.kv_received,
+                "bytes_in": self.kv_bytes_in,
+                "pushed": self.kv_pushed,
+                "push_failures": self.kv_push_failures,
+                "bytes_out": self.kv_bytes_out,
+            }
+
+    def prefill_and_push(self, prompt, max_new_tokens: int,
+                         kv_target: str, handle: str):
+        """The prefill leg, end to end: chunked prefill to completion,
+        then stream the finished KV to ``kv_target``'s
+        ``/v1/kv/{handle}`` (crc32-framed, the peer-shard-wire idiom).
+        Returns ``(http_code, payload)``:
+
+        - push landed → ``{"kv_pushed": true, handle, kv_bytes,
+          first_token, ttft_s, spans{engine_queue_s, prefill_s,
+          kv_transfer_s}}`` — the router then runs the decode leg.
+        - push failed (dead/slow decode peer, crc reject) → the
+          LOCAL-PREFILL FALLBACK: the snapshot this worker already
+          holds seeds its own decode slot and the complete generation
+          returns with ``{"local_fallback": true, tokens, ...}`` — a
+          lost transfer degrades latency, never the request."""
+        t_req0 = time.perf_counter()
+        result = self.submit_and_wait_prefill(prompt, max_new_tokens)
+        kv = result.kv or {}
+        meta = {k: v for k, v in kv.items() if k != "leaves"}
+        meta["handle"] = handle
+        body = kv_transfer.pack_kv(meta, kv.get("leaves") or [])
+        t0 = time.perf_counter()
+        pushed, push_err = True, ""
+        try:
+            req = urllib.request.Request(
+                kv_target.rstrip("/") + f"/v1/kv/{handle}", data=body,
+                headers={"Content-Type": "application/octet-stream"})
+            with urllib.request.urlopen(
+                    req, timeout=self.kv_push_timeout) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"kv push HTTP {resp.status}")
+        except Exception as e:  # noqa: BLE001 - any failure falls back
+            pushed, push_err = False, str(e)
+        transfer_s = time.perf_counter() - t0
+        with self._lock:
+            if pushed:
+                self.kv_pushed += 1
+                self.kv_bytes_out += len(body)
+            else:
+                self.kv_push_failures += 1
+        spans = {
+            "engine_queue_s": round(
+                result.spans.get("engine_queue_s", 0.0), 4),
+            "prefill_s": round(result.spans.get("prefill_s", 0.0), 4),
+            "kv_transfer_s": round(transfer_s, 4),
+        }
+        if pushed:
+            return 200, {
+                "kv_pushed": True, "handle": handle,
+                "kv_bytes": len(body),
+                "first_token": int(kv.get("first_token", 0)),
+                "plen": int(kv.get("plen", 0)),
+                "ttft_s": round(result.ttft_s, 4),
+                "latency_s": round(time.perf_counter() - t_req0, 4),
+                "spans": spans,
+            }
+        # local-prefill fallback: decode HERE from the snapshot we
+        # still hold — no recompute, bit-identical tokens
+        res2 = self._submit_and_wait(
+            lambda: self.engine.submit_with_kv(kv, max_new_tokens))
+        spans["decode_s"] = round(
+            res2.spans.get("prefill_s", 0.0)
+            + res2.spans.get("decode_s", 0.0), 4)
+        spans["engine_queue_s"] = round(
+            spans["engine_queue_s"]
+            + res2.spans.get("engine_queue_s", 0.0), 4)
+        return 200, {
+            "local_fallback": True, "kv_pushed": False,
+            "push_error": push_err, "handle": handle,
+            "kv_bytes": len(body),
+            "tokens": [int(t) for t in res2.tokens],
+            "ttft_s": round(spans["engine_queue_s"]
+                            + spans["prefill_s"]
+                            + spans["kv_transfer_s"], 4),
+            "itl_ms": round(res2.itl_ms, 3),
+            # WALL latency incl. the fallback decode: the router
+            # derives its own router_s by subtracting this from its
+            # measured elapsed — omitting the decode phase here showed
+            # up as phantom seconds of "router overhead" per fallback
+            "latency_s": round(time.perf_counter() - t_req0, 4),
+            "spans": spans,
+        }
 
     # -- pump side ---------------------------------------------------------
 
@@ -363,7 +721,8 @@ class ServingFrontend:
                         np.asarray(req.tokens, np.int32), ttft, itl_ms,
                         spans={"engine_queue_s": queue_s,
                                "prefill_s": prefill_s,
-                               "decode_s": decode_s})
+                               "decode_s": decode_s},
+                        kv=getattr(req, "kv_result", None))
                     ev.set()
                 else:
                     # no waiter ⇒ the client timed out and left: drop

@@ -454,6 +454,55 @@ class ElasticSpec(K8sObject):
 
 @register_type
 @dataclass
+class DisaggregationSpec(K8sObject):
+    """Phase-split serving (docs/SERVING.md "Disaggregation"): the
+    fleet's WORKER replicas divide into a PREFILL pool (indices
+    ``[0, prefillReplicas)``) and a DECODE pool (the rest). The router
+    steers new prompts to the prefill pool, the finished working KV
+    streams to the least-loaded decode replica over
+    ``/v1/kv/{handle}``, and the decode pool streams tokens —
+    prefill interference on inter-token latency is REMOVED, not
+    budget-bounded (the PR 2 endgame).
+
+    ``specDecodeTokens`` > 0 additionally turns on the decode pool's
+    self-speculative fast path: an n-gram drafter proposes that many
+    tokens per round and ONE ragged verify step accepts the matching
+    prefix — bit-identical to greedy decode (greedy serving configs
+    only; the engine refuses it under sampling).
+
+    Absent block ⇒ today's interleaved fleet, byte-identical
+    materialization and routing (regression-guarded)."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    spec_decode_tokens: int = 0
+
+    def total(self) -> int:
+        return self.prefill_replicas + self.decode_replicas
+
+    def role_of(self, index: int) -> str:
+        return "prefill" if index < self.prefill_replicas else "decode"
+
+    def roles_env(self) -> str:
+        """``KTPU_SERVING_ROLES`` value: ``"0=prefill,1=decode,..."``
+        over the whole fleet range (the peers-env shape)."""
+        return ",".join(f"{i}={self.role_of(i)}"
+                        for i in range(self.total()))
+
+    def validate(self) -> None:
+        if self.prefill_replicas < 1:
+            raise ValidationError(
+                "disaggregation: prefillReplicas must be >= 1")
+        if self.decode_replicas < 1:
+            raise ValidationError(
+                "disaggregation: decodeReplicas must be >= 1")
+        if self.spec_decode_tokens < 0:
+            raise ValidationError(
+                "disaggregation: specDecodeTokens must be >= 0")
+
+
+@register_type
+@dataclass
 class ServingSpec(K8sObject):
     """Serving-fleet block (docs/SERVING.md "Fleet"): the operator
     materializes ``replicas`` INDEPENDENT engine pods (each its own
@@ -484,6 +533,9 @@ class ServingSpec(K8sObject):
     router_port: int = 8080
     prefix_tokens: int = 16
     max_queue_depth: int = 0
+    # Phase-split prefill/decode pools with live KV handoff
+    # (docs/SERVING.md "Disaggregation"). None → interleaved fleet.
+    disaggregation: Optional[DisaggregationSpec] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def bounds(self) -> "tuple[int, int]":
@@ -519,6 +571,24 @@ class ServingSpec(K8sObject):
             raise ValidationError("serving: prefixTokens must be >= 0")
         if self.max_queue_depth < 0:
             raise ValidationError("serving: maxQueueDepth must be >= 0")
+        if self.disaggregation is not None:
+            self.disaggregation.validate()
+            if self.autoscale_enabled():
+                # pool membership is positional (index ranges): the
+                # SLO autoscaler's replica-count movement would shift
+                # the role boundary under live traffic — reject until
+                # per-pool scaling exists rather than silently resize
+                # the wrong pool
+                raise ValidationError(
+                    "serving: disaggregation does not compose with "
+                    "the SLO autoscaler yet — drop sloTtftMs/sloItlMs "
+                    "or the min/maxReplicas range")
+            if self.replicas != self.disaggregation.total():
+                raise ValidationError(
+                    f"serving: replicas {self.replicas} != "
+                    f"prefillReplicas + decodeReplicas = "
+                    f"{self.disaggregation.total()} (set_defaults "
+                    "derives replicas from the pools; don't fight it)")
 
 
 @register_type
@@ -820,6 +890,11 @@ class TpuJobSpec(K8sObject):
         if self.tpu is not None and self.tpu.num_slices < 1:
             self.tpu.num_slices = 1
         if self.serving is not None:
+            if self.serving.disaggregation is not None:
+                # phase-split fleets size themselves from the pools:
+                # the WORKER range is prefill + decode, and the role
+                # of an index is its position in that range
+                self.serving.replicas = self.serving.disaggregation.total()
             # normalize the autoscale bounds once, so everything
             # downstream (validation, operator env, autoscaler) reads
             # concrete numbers

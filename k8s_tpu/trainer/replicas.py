@@ -512,6 +512,7 @@ class TpuReplicaSet:
         serving = self.job.job.spec.serving
         own = f"{self.job_name(index)}:{self.spec.port}"
         env: Dict[str, str] = {}
+        disagg = serving.disaggregation
         if self.spec.replica_type == WORKER:
             env["KTPU_SERVING_REPLICA"] = str(index)
             env["KTPU_SERVING_ADVERTISE"] = \
@@ -522,6 +523,17 @@ class TpuReplicaSet:
             if serving.max_queue_depth:
                 env["KTPU_SERVING_MAX_QUEUE"] = \
                     str(serving.max_queue_depth)
+            if disagg is not None:
+                # phase-pool membership is positional: indices below
+                # prefillReplicas prefill, the rest decode — Services
+                # exist for BOTH ranges up front (the create() path's
+                # maxReplicas pre-creation), so role boundaries never
+                # churn DNS
+                role = disagg.role_of(index)
+                env["KTPU_SERVING_ROLE"] = role
+                if role == "decode" and disagg.spec_decode_tokens:
+                    env["KTPU_SERVING_SPEC_DECODE"] = \
+                        str(disagg.spec_decode_tokens)
         else:  # ROUTER
             worker_set = next(
                 (r for r in self.job.replicas
@@ -541,6 +553,8 @@ class TpuReplicaSet:
             if serving.prefix_tokens:
                 env["KTPU_ROUTER_PREFIX_TOKENS"] = \
                     str(serving.prefix_tokens)
+            if disagg is not None:
+                env["KTPU_SERVING_ROLES"] = disagg.roles_env()
         return RendezvousSpec(
             coordinator_address=own,
             process_id=0,

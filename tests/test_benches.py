@@ -98,6 +98,38 @@ class TestBenches:
         assert out["affinity_hit_rate"] > 0, out
         assert out["prefix_tokens_saved"] > 0, out
 
+    def test_serving_disagg_bench_smoke(self, capsys):
+        """``--disagg --smoke`` must emit the A/B JSON shape AND meet
+        the phase-split acceptance bar under the adversarial
+        long-prompt mix (ISSUE 13): ITL p95 no worse than the
+        interleaved fleet's (the interference the split removes —
+        measured win ~1.2x at p99), aggregate throughput within noise
+        of parity, real KV handoffs on the wire, and tokens
+        bit-identical across paths."""
+        from benches import serving_bench
+
+        assert serving_bench.main(["--smoke", "--disagg"]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "serving_disagg_itl_p99_ms"
+        for k in ("value", "itl_p99_win", "throughput_ratio",
+                  "itl_p95_ms", "interleaved_itl_p95_ms",
+                  "kv_transfers", "kv_fallbacks", "kv_bytes_per_sec",
+                  "prefill_replicas", "decode_replicas",
+                  "tokens_identical"):
+            assert k in out, k
+        # the acceptance bar: ITL p95 no worse than interleaved WITH a
+        # 10% timing tolerance — two wall-clock runs on a shared 2-core
+        # CI box can each eat a descheduling blip, and the measured
+        # headroom (~1.2x win) must not make a strict comparison the
+        # flake source; aggregate throughput no worse than ~parity,
+        # and handoffs really happened
+        assert out["itl_p95_ms"] <= \
+            out["interleaved_itl_p95_ms"] * 1.1, out
+        assert out["throughput_ratio"] >= 0.8, out
+        assert out["kv_transfers"] > 0, out
+        assert out["kv_bytes_per_sec"] > 0, out
+        assert out["tokens_identical"] is True, out
+
     def test_decode_bench_int8_serving(self, capsys):
         from benches import decode_bench
 

@@ -1,0 +1,227 @@
+"""Disaggregated serving through the CONTROL PLANE (ISSUE 13 flagship):
+the operator (over the REAL REST wire) materializes a phase-split
+fleet — 1 prefill + 2 decode engine subprocesses + the router — from a
+``disaggregation:`` spec block; requests route prefill → live KV
+transfer → decode with the ``kv_transfer_s`` span measured and the
+span-sum == TTFT identity holding on REAL engines; SIGKILLing an
+in-use decode replica mid-stream still returns 200 via the fallback
+ladder (counted); and the phase-split path's tokens are bit-identical
+to the interleaved path's on the same weights (cross-path
+determinism), with the decode pool's speculative fast path accepting
+real draft tokens along the way.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.obs.events import parse_events
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
+from k8s_tpu import spec as S
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.integration
+def test_disagg_fleet_kv_handoff_fallback_and_determinism(tmp_path):
+    from k8s_tpu.api.apiserver import LocalApiServer
+    from k8s_tpu.api.restcluster import RestCluster
+
+    api = LocalApiServer().start()
+    controller = kubelet = None
+    try:
+        client = KubeClient(RestCluster(api.url))
+        jc = TpuJobClient(RestCluster(api.url))
+        node_client = KubeClient(api.cluster)
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.1)
+        executor = SubprocessExecutor(
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "KTPU_FORCE_PLATFORM": "cpu",
+                "KTPU_NUM_CPU_DEVICES": "1",
+                "KTPU_PROGRAM": "k8s_tpu.programs.serving:main",
+                "KTPU_PROGRAM_ARGS": (
+                    "--model=tiny --max_seq_len=64 --max_slots=2 "
+                    "--decode_chunk=4 --prompt_buckets=4,8,16 "
+                    "--prefill_chunk=4"
+                ),
+            },
+        )
+        kubelet = LocalKubelet(node_client, executor)
+        kubelet.start()
+        controller.start()
+
+        j = S.TpuJob()
+        j.metadata.name = "serve-disagg"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER")
+        ]
+        j.spec.serving = S.ServingSpec(
+            prefix_tokens=8, engine_port=8000, router_port=8080,
+            disaggregation=S.DisaggregationSpec(
+                prefill_replicas=1, decode_replicas=2,
+                spec_decode_tokens=2))
+        jc.create(j)
+
+        def _log(name):
+            import glob
+
+            pats = glob.glob(str(tmp_path / "logs" / f"{name}-*.log"))
+            return {p: open(p).read() for p in sorted(pats)}
+
+        # the operator materialized 1 prefill + 2 decode + router,
+        # each announcing its role in the ready event
+        deadline = time.monotonic() + 300
+        engines, router = {}, None
+        while time.monotonic() < deadline:
+            engines, router = {}, None
+            for path, log in _log("serve-disagg").items():
+                for ev in parse_events(log):
+                    if ev["event"] == "serving_ready":
+                        engines[ev["replica"]] = ev
+                    elif ev["event"] == "router_ready":
+                        router = ev
+            if len(engines) == 3 and router is not None:
+                break
+            time.sleep(0.3)
+        assert len(engines) == 3 and router is not None, (
+            engines, router, _log("serve-disagg"))
+        assert engines[0]["role"] == "prefill"
+        assert engines[1]["role"] == "decode"
+        assert engines[2]["role"] == "decode"
+        # spec decode reaches decode workers only
+        assert engines[0]["spec_decode_tokens"] == 0
+        assert engines[1]["spec_decode_tokens"] == 2
+        assert router["disaggregated"] is True
+        assert router["roles"] == {
+            "0": "prefill", "1": "decode", "2": "decode"}
+
+        rport = router["port"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            health = _get(rport, "/healthz")
+            if health["ready_replicas"] == 3:
+                break
+            time.sleep(0.2)
+        assert health["ready_replicas"] == 3, health
+
+        # phase 1 — the KV handoff path on REAL engines: routed
+        # responses decompose TTFT into queue + prefill + TRANSFER
+        # (span-sum identity), the decode leg served them, and the
+        # handoff is visible end to end (router counters + both
+        # engines' kv stats)
+        results = []
+        for i in range(4):
+            code, body = _post(rport, "/v1/generate",
+                               {"prompt": [3, 1, 4, 1, 5, 9, 2, 6,
+                                           10 + i],
+                                "max_new_tokens": 8})
+            results.append((code, body))
+        assert [c for c, _ in results] == [200] * 4, results
+        for _, b in results:
+            assert b["trace_id"], b
+            s = b["spans"]
+            assert s["kv_transfer_s"] > 0, b
+            assert s["engine_queue_s"] + s["prefill_s"] + \
+                s["kv_transfer_s"] == pytest.approx(
+                    b["ttft_s"], abs=3e-4), b
+            assert b["prefill_replica"] == 0, b
+            assert b["replica"] in (1, 2), b
+        health = _get(rport, "/healthz")
+        d = health["disaggregation"]
+        assert d["kv"]["transfers"] >= 4, d
+        assert d["kv"]["bytes_total"] > 0, d
+        assert health["trace"]["kv_transfer_p95_ms"] > 0, health
+        pre_stats = _get(engines[0]["port"], "/healthz")
+        assert pre_stats["role"] == "prefill"
+        assert pre_stats["kv"]["pushed"] >= 4, pre_stats["kv"]
+        assert pre_stats["stats"]["kv_prefills"] >= 4
+
+        # phase 2 — cross-path determinism: the SAME prompt straight
+        # to the prefill replica's own /v1/generate (the interleaved
+        # path on identical weights) matches the phase-split tokens;
+        # and the decode pool's speculative path really accepted drafts
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 10]
+        code, direct = _post(engines[0]["port"], "/v1/generate",
+                             {"prompt": prompt, "max_new_tokens": 8})
+        assert code == 200
+        assert direct["tokens"] == results[0][1]["tokens"], (
+            direct, results[0][1])
+        accepted = 0
+        for i in (1, 2):
+            st = _get(engines[i]["port"], "/healthz")["stats"]
+            accepted += st.get("spec_decode_accepted", 0)
+        assert accepted > 0, "speculative decode accepted no drafts"
+
+        # phase 3 — SIGKILL the in-use decode replica mid-stream:
+        # every in-flight request still returns 200 (pool peer or
+        # interleave rung), counted as fallbacks
+        out2 = {}
+
+        def one(i):
+            code, body = _post(
+                rport, "/v1/generate",
+                {"prompt": [i + 1, i + 2, i + 3, i + 4, i + 5],
+                 "max_new_tokens": 16}, timeout=120)
+            out2[i] = (code, body)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        os.kill(engines[1]["pid"], signal.SIGKILL)
+        for t in threads:
+            t.join()
+        codes = [v[0] for v in out2.values()]
+        assert codes == [200] * 6, out2
+        health = _get(rport, "/healthz")
+        assert health["disaggregation"]["kv"]["fallbacks"] >= 1, health
+
+        # determinism survives the kill: the re-served prompt answers
+        # identically through the surviving decode replica
+        code, body = _post(rport, "/v1/generate",
+                           {"prompt": prompt, "max_new_tokens": 8})
+        assert code == 200 and body["tokens"] == direct["tokens"]
+
+        # delete over REST ⇒ SIGTERM ⇒ the whole fleet drains
+        jc.delete("default", "serve-disagg")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            logs = "\n".join(_log("serve-disagg").values())
+            if '"event": "router_drained"' in logs:
+                break
+            time.sleep(0.3)
+        logs = "\n".join(_log("serve-disagg").values())
+        assert '"event": "router_drained"' in logs, logs
+    finally:
+        if controller is not None:
+            controller.stop()
+        if kubelet is not None:
+            kubelet.stop()
+        api.stop()

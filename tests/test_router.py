@@ -190,6 +190,88 @@ class TestAffinity:
         assert r.pick_replica([1, 2])[1] == "none"
 
 
+class TestAffinityRoleInteraction:
+    """Prefix affinity × phase routing (ISSUE 13 satellite): affinity
+    must pin within the PREFILL pool only — a binding to a decode
+    replica is dead weight (its prefix KV never warms: decode-pool
+    replicas don't prefill on the steady path)."""
+
+    ROLES = {0: "prefill", 1: "prefill", 2: "decode", 3: "decode"}
+
+    def _router(self, **kw):
+        kw.setdefault("roles", dict(self.ROLES))
+        r = _bare_router(4, **kw)
+        for i in range(4):
+            r.note_stats(i, _stats())
+        return r
+
+    def test_new_prefix_binds_within_prefill_pool(self):
+        r = self._router()
+        # decode replicas idle, prefill replica 1 loaded — the pick
+        # must STILL come from the prefill pool
+        r.note_stats(1, _stats(queue_depth=5))
+        idx, verdict = r.pick_replica([1, 2, 3, 4, 5])
+        assert idx == 0 and verdict == "miss"
+        assert r._affinity and set(r._affinity.values()) <= {0, 1}
+
+    def test_affinity_hit_requires_prefill_pool_membership(self):
+        r = self._router()
+        prompt = [1, 2, 3, 4, 5]
+        key = router_mod.prefix_key(prompt, r.prefix_tokens)
+        # a stale binding to a DECODE replica (e.g. roles changed
+        # across a router restart) must fall back and re-bind inside
+        # the prefill pool, never "hit" on the dead-weight replica
+        with r._lock:
+            r._affinity[key] = 2
+        idx, verdict = r.pick_replica(prompt)
+        assert verdict == "fallback"
+        assert idx in (0, 1)
+        assert r._affinity[key] == idx  # re-bound in-pool
+
+    def test_saturated_affine_prefill_falls_back_in_pool(self):
+        r = self._router()
+        prompt = [9, 8, 7, 6, 5]
+        idx0, _ = r.pick_replica(prompt)
+        assert idx0 == 0
+        # saturate the affine replica: fallback must land on the OTHER
+        # prefill replica, not an idle decode one
+        r.note_stats(0, _stats(queue_depth=50))
+        idx, verdict = r.pick_replica(prompt)
+        assert verdict == "fallback" and idx == 1
+
+    def test_whole_prefill_pool_down_yields_none(self):
+        # decode replicas alone cannot take new prompts on the happy
+        # path — pick_replica refuses, which routes the request into
+        # the interleave-fallback rung (exercised in test_disagg)
+        r = self._router()
+        r.note_poll_failure(0, "dead")
+        r.note_poll_failure(0, "dead")
+        r.note_poll_failure(1, "dead")
+        r.note_poll_failure(1, "dead")
+        assert r.pick_replica([1, 2, 3, 4, 5]) == (None, "none")
+
+    def test_pick_decode_scores_without_backlog_term(self):
+        r = self._router()
+        # decode replica 2 carries a huge (fallback-path) prefill
+        # backlog but an empty queue; replica 3 has a real queue.
+        # Decode scoring must IGNORE the backlog term and still pick 2.
+        r.note_stats(2, _stats(progress={"5": {"done": 0, "total": 80}}))
+        r.note_stats(3, _stats(queue_depth=2))
+        assert r.pick_decode() == 2
+        # ...and never pick outside the decode pool or the exclusions
+        assert r.pick_decode(exclude={2}) == 3
+        assert r.pick_decode(exclude={2, 3}) is None
+
+    def test_no_roles_keeps_interleaved_behavior(self):
+        # regression guard: without roles the pool filter is inert —
+        # every replica is a candidate and affinity binds anywhere
+        r = _bare_router(4)
+        for i in range(4):
+            r.note_stats(i, _stats(queue_depth=3 - i))
+        assert not r.disaggregated
+        assert r.pick_replica([1, 2, 3, 4, 5])[0] == 3
+
+
 class TestAutoscalerHysteresis:
     def _as(self, **kw):
         clock = {"t": 0.0}

@@ -443,3 +443,139 @@ class TestSharedPrefixReuse:
         rid = legacy.submit(np.arange(1, 12, dtype=np.int32), 3)
         assert len(legacy.run()[rid]) == 3
         legacy.close()
+
+
+class TestSpeculativeDecode:
+    """Self-speculative decode (ISSUE 13, docs/SERVING.md
+    "Disaggregation"): the n-gram draft + one-step ragged verify must
+    be BIT-IDENTICAL to plain greedy decode — the accept-prefix rule
+    only keeps tokens whose entire input prefix matched the sequential
+    stream, so any divergence is a positions/mask/acceptance bug.
+    Mirrors the chunked-prefill identity suite above: same trained
+    weights, same solo-generate oracle."""
+
+    @pytest.fixture(scope="class")
+    def fixture(self):
+        cfg, params = trained_tiny()
+        dec = dataclasses.replace(
+            cfg, decode=True, ragged_decode=True, max_seq_len=64)
+        oracle_dec = dataclasses.replace(cfg, decode=True, max_seq_len=64)
+        return (LlamaForCausalLM(dec), LlamaForCausalLM(oracle_dec),
+                params)
+
+    def _refs(self, m_oracle, params, prompts, news):
+        return [np.asarray(generate(m_oracle, params,
+                                    jnp.asarray(p)[None], n))[0]
+                for p, n in zip(prompts, news)]
+
+    def test_greedy_equivalence_across_draft_lengths(self, fixture):
+        """The acceptance oracle: identical streams at K=1,3,5 vs
+        plain decode vs solo generate — accepted drafts, bonus
+        corrections, and budget-cut rounds all land on the sequential
+        tokens."""
+        model, m_oracle, params = fixture
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 512, size=n).astype(np.int32)
+                   for n in (3, 9, 13, 16)]
+        news = [6, 4, 8, 5]
+        refs = self._refs(m_oracle, params, prompts, news)
+
+        def run(**kw):
+            eng = _mk_engine(model, params, **kw)
+            rids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+            out = eng.run()
+            stats = dict(eng.stats)
+            eng.close()
+            return [out[r] for r in rids], stats
+
+        plain, _ = run()
+        for k in (1, 3, 5):
+            spec, stats = run(spec_decode_k=k)
+            for i in range(len(prompts)):
+                assert np.array_equal(spec[i], refs[i]), (k, i)
+                assert np.array_equal(plain[i], refs[i]), i
+            assert stats["spec_decode_rounds"] > 0, stats
+
+    def test_int8_kv_spec_decode_identity(self, fixture):
+        """The verify step's vmapped per-row scale writes compose with
+        the int8 KV cache exactly like chunked continuation does."""
+        _, _, params = fixture
+        cfg, _ = trained_tiny()
+        dec = dataclasses.replace(
+            cfg, decode=True, ragged_decode=True, max_seq_len=64,
+            kv_quant="int8")
+        oracle = LlamaForCausalLM(dataclasses.replace(
+            cfg, decode=True, max_seq_len=64, kv_quant="int8"))
+        model = LlamaForCausalLM(dec)
+        p = np.array([2, 3, 5, 7, 11, 13, 17, 19, 23, 29], np.int32)
+        ref = np.asarray(
+            generate(oracle, params, jnp.asarray(p)[None], 8))[0]
+        eng = _mk_engine(model, params, spec_decode_k=3)
+        rid = eng.submit(p, 8)
+        out = eng.run()
+        eng.close()
+        assert np.array_equal(out[rid], ref)
+
+    def test_batch_boundaries_and_slot_reuse(self, fixture):
+        """Staggered finishes: more requests than slots, different
+        max_new per request — a freed slot's stale verify rows must
+        never leak into its next occupant's stream (the garbage-
+        tolerance contract under speculative writes)."""
+        model, m_oracle, params = fixture
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, 512, size=3 + (i % 5)).astype(np.int32)
+                   for i in range(6)]
+        news = [2, 7, 3, 9, 1, 6]
+        refs = self._refs(m_oracle, params, prompts, news)
+        eng = _mk_engine(model, params, max_slots=2, spec_decode_k=3)
+        rids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+        out = eng.run()
+        eng.close()
+        for i, r in enumerate(rids):
+            assert np.array_equal(out[r], refs[i]), i
+
+    def test_near_cache_end_falls_back_to_plain_rounds(self, fixture):
+        """A stream within K+1 rows of max_seq must NOT speculate (a
+        clamped verify DUS would corrupt EARLIER rows) — the pump runs
+        plain chunk rounds instead, counted, still bit-identical."""
+        model, m_oracle, params = fixture
+        rng = np.random.RandomState(8)
+        p = rng.randint(0, 512, size=40).astype(np.int32)
+        n = 23  # 40 + 1 + 23 = 64 = max_seq: the last rounds can't fit K+1
+        ref = self._refs(m_oracle, params, [p], [n])[0]
+        eng = _mk_engine(model, params, spec_decode_k=6)
+        rid = eng.submit(p, n)
+        out = eng.run()
+        stats = dict(eng.stats)
+        eng.close()
+        assert np.array_equal(out[rid], ref)
+        assert stats["spec_decode_fallbacks"] > 0, stats
+
+    def test_ngram_draft_accepts_on_repetitive_stream(self, fixture):
+        """On a looping context the n-gram drafter must actually
+        propose (and the verifier accept) tokens — the speed half of
+        the contract, asserted via the accepted counter and the
+        tokens-accepted>0 acceptance bar."""
+        from k8s_tpu.serving.engine import _ngram_draft
+
+        ctx = np.array([5, 6, 7, 5, 6], np.int32)
+        d = _ngram_draft(ctx, 3, 2)
+        assert list(d) == [7, 5, 6]
+        assert _ngram_draft(np.array([1, 2], np.int32), 3, 2).size == 0
+        # end to end: a trained model on its own greedy continuation
+        # repeats itself enough that SOME drafts are accepted
+        model, m_oracle, params = fixture
+        rng = np.random.RandomState(9)
+        prompts = [rng.randint(0, 64, size=8).astype(np.int32)
+                   for _ in range(3)]
+        news = [16, 16, 16]
+        refs = self._refs(m_oracle, params, prompts, news)
+        eng = _mk_engine(model, params, spec_decode_k=3)
+        rids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+        out = eng.run()
+        stats = dict(eng.stats)
+        eng.close()
+        for i, r in enumerate(rids):
+            assert np.array_equal(out[r], refs[i]), i
+        assert stats["spec_decode_drafted"] > 0, stats
+        assert stats["spec_decode_accepted"] > 0, stats
