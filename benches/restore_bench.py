@@ -1,0 +1,272 @@
+"""Fast-restart bench: restore-pipeline A/B + compile-cache A/B.
+
+MTTR — kill → first post-restore train step — decomposes into
+``restore (plan + fetch + device)`` plus the restarted gang's XLA
+compile (docs/CHECKPOINT.md "Restore critical path"). This bench
+measures both legs on the CPU backend with stand-in shards:
+
+1. **Serial vs parallel restore** — a replaced host restores a
+   multi-leaf state entirely from a peer whose transport carries a
+   fixed per-fetch latency (the stand-in for disk/HTTP round-trips, so
+   the fan-out is what's measured, not tmpfs speed). Asserable win:
+   the pipeline overlaps fetches near-linearly in the pool width.
+   Bit-identity between the arms is verified, not assumed.
+2. **Cold vs warm compile cache** — the same jitted stand-in train
+   step compiled against a fresh persistent-cache dir (cold, writes
+   the cache) and again after ``jax.clear_caches()`` (warm, reads it)
+   — exactly what ``spec.training.compileCacheDir`` buys a restarted
+   or resized gang.
+
+The JSON line carries the A/B plus the restore phase breakdown and the
+in-flight-bytes-cap evidence; ``--smoke`` shrinks everything for the
+CI ``restore-perf`` stage (tests/test_benches.py asserts the ≥2x
+restore speedup and the warm-«-cold compile hit there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class SlowTransport:
+    """A peer transport with a fixed per-call latency — the stand-in
+    for real disk/HTTP shard reads, making the serial/parallel A/B
+    deterministic on any box (the win is overlap, which tmpfs-speed
+    reads would hide in noise)."""
+
+    def __init__(self, inner, delay_s: float):
+        self.inner = inner
+        self.delay_s = delay_s
+
+    def steps(self):
+        return self.inner.steps()
+
+    def manifest(self, step, host):
+        return self.inner.manifest(step, host)
+
+    def progress(self):
+        return self.inner.progress()
+
+    def fetch(self, step, leaf, key, host):
+        time.sleep(self.delay_s)
+        return self.inner.fetch(step, leaf, key, host)
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(fa) == len(fb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(fa, fb))
+
+
+def _restore_ab(leaves: int, shard_kb: int, delay_ms: float,
+                parallel: int):
+    """Peer-restore the same multi-leaf state serially and pipelined;
+    returns the A/B row (+ a capped re-run proving the in-flight gate
+    bounds host bytes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from k8s_tpu.ckpt import (
+        FilesystemPeerTransport,
+        LocalTier,
+        RestorePlanner,
+        SOURCE_LOCAL_PEER,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    n = max(1, (shard_kb << 10) // 4)
+    tree = {
+        f"leaf{i:02d}": jax.device_put(
+            (jnp.arange(n, dtype=jnp.float32) + 31.0 * i),
+            NamedSharding(mesh, P()))
+        for i in range(leaves)
+    }
+    template = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=a.sharding), tree)
+    leaf_bytes = n * 4
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="ktpu-restore-bench-") as root:
+        LocalTier(root, host_id=1, sync=True).save(7, tree)
+
+        def run(par, inflight_bytes=0):
+            planner = RestorePlanner(
+                LocalTier(root, host_id=0, sync=True), None,
+                transport=SlowTransport(
+                    FilesystemPeerTransport(root, self_host=0),
+                    delay_ms / 1e3),
+                parallel=par, inflight_bytes=inflight_bytes)
+            t0 = time.perf_counter()
+            restored, plan = planner.restore(template)
+            wall = time.perf_counter() - t0
+            assert restored is not None and plan.source == SOURCE_LOCAL_PEER
+            return wall, restored, dict(planner.last_restore_stats)
+
+        serial_s, serial_tree, _ = run(1)
+        parallel_s, parallel_tree, stats = run(parallel)
+        # the gate A/B: a tiny cap (2 leaves) must bound peak in-flight
+        # bytes where the uncapped run holds (nearly) everything
+        cap = 2 * leaf_bytes + 64
+        _, capped_tree, capped = run(parallel, inflight_bytes=cap)
+        out = {
+            "restore_serial_s": round(serial_s, 4),
+            "restore_parallel_s": round(parallel_s, 4),
+            "restore_speedup": round(serial_s / max(parallel_s, 1e-9), 2),
+            "bit_identical": (
+                _tree_equal(serial_tree, tree)
+                and _tree_equal(parallel_tree, tree)
+                and _tree_equal(capped_tree, tree)),
+            "restore_phases_s": {
+                k: round(stats[k], 4)
+                for k in ("plan_s", "fetch_s", "device_s")},
+            "uncapped_peak_inflight_bytes": stats["peak_inflight_bytes"],
+            "inflight_cap_bytes": cap,
+            "capped_peak_inflight_bytes": capped["peak_inflight_bytes"],
+            "capped_gate_waits": capped["gate_waits"],
+        }
+    return out
+
+
+def _compile_ab(layers: int, width: int):
+    """Cold-vs-warm persistent-compile-cache A/B on a stand-in train
+    step.
+
+    The jax config knob is consumed LAZILY at the first compile, so a
+    process that already touched the backend (this bench's restore arm
+    did) must re-point the cache through the compilation_cache module
+    directly — ``reset_cache() + set_cache_dir()``; afterwards the
+    previous state is restored the same way (the test harness points
+    jax at a shared suite cache). A warmup compile of a different
+    program runs first so the cold number measures the cache miss, not
+    one-time process warmup."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.compilation_cache import (
+        compilation_cache as cc,
+    )
+
+    old_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    old_min = getattr(jax.config,
+                      "jax_persistent_cache_min_compile_time_secs", None)
+
+    def step(params, x):
+        # a train-step-shaped pile of matmuls + nonlinearities: big
+        # enough that the cold compile is measurable, small enough for
+        # a CI smoke
+        h = x
+        for w in params:
+            h = jnp.tanh(h @ w) + jnp.sin(h)
+        loss = (h * h).mean()
+        return loss, [jnp.cos(h) @ w for w in params]
+
+    params = [jnp.full((width, width), 0.01, jnp.float32)
+              for _ in range(layers)]
+    x = jnp.ones((64, width), jnp.float32)
+    # warmup: compile a DIFFERENT program so LLVM/backends are hot
+    # before the measured pair
+    jax.jit(lambda v: jnp.tanh(v @ v.T).sum()).lower(
+        jnp.ones((32, 32), jnp.float32)).compile()
+    with tempfile.TemporaryDirectory(prefix="ktpu-compile-bench-") as cache:
+        try:
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            except (AttributeError, ValueError):
+                pass
+            cc.reset_cache()
+            cc.set_cache_dir(cache)
+            # time ONLY .compile(): tracing + lowering happen either
+            # way on a restart and the persistent cache cannot help
+            # them — the A/B must isolate the term the cache changes
+            lowered = jax.jit(step).lower(params, x)
+            t0 = time.perf_counter()
+            lowered.compile()
+            cold_s = time.perf_counter() - t0
+            cached_entries = sum(
+                1 for f in os.listdir(cache) if f.endswith("-cache"))
+            # drop the in-memory executables: the SECOND compile of a
+            # restarted process only has the on-disk cache — exactly
+            # the restart situation compileCacheDir exists for
+            jax.clear_caches()
+            lowered = jax.jit(step).lower(params, x)
+            t0 = time.perf_counter()
+            lowered.compile()
+            warm_s = time.perf_counter() - t0
+        finally:
+            if old_min is not None:
+                try:
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs",
+                        old_min)
+                except (AttributeError, ValueError):
+                    pass
+            try:
+                cc.reset_cache()  # lazily re-inits from jax.config
+                if old_dir:
+                    cc.set_cache_dir(old_dir)
+            except Exception:
+                pass
+    return {
+        "compile_cold_s": round(cold_s, 4),
+        "compile_warm_s": round(warm_s, 4),
+        "compile_warm_speedup": round(cold_s / max(warm_s, 1e-9), 2),
+        "compile_cache_entries": cached_entries,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="restore-bench")
+    p.add_argument("--leaves", type=int, default=32)
+    p.add_argument("--shard-kb", type=int, default=256)
+    p.add_argument("--fetch-delay-ms", type=float, default=10.0)
+    p.add_argument("--parallel", type=int, default=8)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--width", type=int, default=256)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for the CI restore-perf stage")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.leaves = min(args.leaves, 16)
+        args.shard_kb = min(args.shard_kb, 16)
+        args.fetch_delay_ms = min(args.fetch_delay_ms, 8.0)
+        args.layers = min(args.layers, 6)
+        args.width = min(args.width, 192)
+
+    restore = _restore_ab(args.leaves, args.shard_kb,
+                          args.fetch_delay_ms, args.parallel)
+    compile_ab = _compile_ab(args.layers, args.width)
+    # the headline: a fast restart (pipelined restore + warm cache)
+    # vs the old one (serial restore + cold compile)
+    slow = restore["restore_serial_s"] + compile_ab["compile_cold_s"]
+    fast = restore["restore_parallel_s"] + compile_ab["compile_warm_s"]
+    print(json.dumps({
+        "metric": "restore_mttr_speedup",
+        "value": round(slow / max(fast, 1e-9), 2),
+        "mttr_serial_cold_s": round(slow, 4),
+        "mttr_parallel_warm_s": round(fast, 4),
+        **restore,
+        **compile_ab,
+        "leaves": args.leaves,
+        "shard_kb": args.shard_kb,
+        "fetch_delay_ms": args.fetch_delay_ms,
+        "parallel": args.parallel,
+        "mode": "smoke" if args.smoke else "full",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

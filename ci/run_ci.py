@@ -221,6 +221,28 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_ckpt_tiers.xml"],
             args.artifacts_dir, cases,
         )
+        # fast-restart gate (ISSUE 14): the parallel pipelined restore
+        # (serial≡parallel bit-identity, reroute under parallelism,
+        # the in-flight-bytes cap, the MTTR goodput/metrics/span
+        # surfaces, the compileCacheDir spec→env→launcher contract)
+        # plus the restore bench's --smoke A/B (parallel ≥2x serial;
+        # warm compile-cache hit « cold). Always on and fast,
+        # mirroring the ckpt-tiers stage: a restore-path regression —
+        # a pipeline that wedges on a dead peer, a cap that stops
+        # bounding host RAM, a cache contract that stops round-
+        # tripping — fails in seconds.
+        ok = ok and stage(
+            "restore-perf",
+            [py, "-m", "pytest",
+             "tests/test_ckpt_tiers.py::TestParallelRestore",
+             "tests/test_ckpt_tiers.py::TestCompileCacheContract",
+             "tests/test_ckpt_tiers.py::TestRestPeerWire",
+             "tests/test_benches.py::TestBenches"
+             "::test_restore_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_restore_perf.xml"],
+            args.artifacts_dir, cases,
+        )
         # collective-budget gate (ISSUE 3): compile the stand-in sharded
         # train steps on the 8-device virtual CPU mesh and enforce their
         # golden budget manifests (ci/hlo_budgets/) — a sharding
@@ -260,6 +282,8 @@ def main(argv=None) -> int:
                       "::test_serving_fleet_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_disagg_bench_smoke",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_restore_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(

@@ -401,9 +401,14 @@ class LocalTier:
         in flight — a new save first drains the previous one). Returns
         False if the step is already committed.
         """
+        # drain the previous in-flight write FIRST (double buffer), so
+        # the committed check sees its outcome: a force save at the
+        # step the async writer is still committing must be the no-op,
+        # not a doomed re-write (rename onto the fresh commit fails and
+        # was miscounted as a local_save_failure every final save)
+        self.wait()
         if step in self.committed_steps():
             return False
-        self.wait()  # drain the previous in-flight write (double buffer)
         host_buffers: Dict[str, Dict[str, np.ndarray]] = {}
         meta: Dict[str, Dict[str, Any]] = {}
         for path, leaf in _leaf_paths(tree):

@@ -58,6 +58,11 @@ class CheckpointPolicy:
     # restarted gang so planning never targets a NaN checkpoint
     # (docs/OBSERVABILITY.md "Training health", docs/CHECKPOINT.md)
     max_restore_step: Optional[int] = None
+    # restore pipeline (docs/CHECKPOINT.md "Restore critical path"):
+    # fetch-pool width (1 = the serial schedule, byte-identical either
+    # way) and the in-flight host-bytes cap on fetched shard buffers
+    restore_parallel: int = 8
+    restore_inflight_mb: int = 1024
 
     @classmethod
     def from_env(cls, env=None) -> "CheckpointPolicy":
@@ -83,6 +88,8 @@ class CheckpointPolicy:
             peer_fetch=env.get("KTPU_CKPT_PEER_FETCH", "1")
             not in ("0", "false"),
             max_restore_step=max_restore,
+            restore_parallel=max(1, num("KTPU_CKPT_RESTORE_PARALLEL", 8)),
+            restore_inflight_mb=num("KTPU_CKPT_RESTORE_INFLIGHT_MB", 1024),
         )
 
     @property
@@ -110,6 +117,14 @@ class GoodputStats:
     persistent_saves: int = 0
     save_seconds_total: float = 0.0
     loop_seconds_total: float = 0.0
+    # MTTR accounting (docs/CHECKPOINT.md "Restore critical path"):
+    # restart latency in SECONDS, not just lost steps — the quantity
+    # the scheduler/resize cost models price a restart at. The phase
+    # breakdown (plan_s / fetch_s / device_s) mirrors the planner's
+    # pipeline; fetch and device overlap, so phases may sum past the
+    # total.
+    restore_seconds_total: float = 0.0
+    restore_phase_seconds: Dict[str, float] = field(default_factory=dict)
 
     def overhead_fraction(self) -> float:
         if self.loop_seconds_total <= 0:
@@ -134,6 +149,10 @@ class GoodputStats:
             "local_save_failures": self.local_save_failures,
             "persistent_saves": self.persistent_saves,
             "ckpt_overhead_fraction": round(self.overhead_fraction(), 5),
+            "restore_seconds_total": round(self.restore_seconds_total, 6),
+            "restore_phases_s": {
+                k: round(v, 6)
+                for k, v in sorted(self.restore_phase_seconds.items())},
         }
 
 
@@ -187,6 +206,8 @@ class MultiTierCheckpointManager:
             self.local, self.persistent, transport=transport,
             consensus=consensus, gang_consistent=gang_consistent,
             max_step=policy.max_restore_step,
+            parallel=policy.restore_parallel,
+            inflight_bytes=max(0, policy.restore_inflight_mb) << 20,
         )
         self.last_restore_plan: Optional[RestorePlan] = None
 
@@ -281,7 +302,10 @@ class MultiTierCheckpointManager:
         if step is not None and self.persistent is not None:
             # explicit-step restore bypasses planning (debug surface)
             return self.persistent.restore(state_template, step=step)
+        t0 = time.monotonic()
         tree, plan = self.planner.restore(state_template)
+        restore_s = time.monotonic() - t0
+        phases = dict(getattr(self.planner, "last_restore_stats", {}) or {})
         self.last_restore_plan = plan
         if plan.source != SOURCE_NONE:
             if plan.step is not None:
@@ -304,10 +328,34 @@ class MultiTierCheckpointManager:
                 self.stats.lost_steps_last = lost
                 self.stats.lost_steps_total += lost
                 self._metric("CKPT_LOST_STEPS").inc(by=lost)
+            # MTTR: restart latency as a first-class measured quantity
+            # — goodput seconds + per-phase gauge + tracer spans that
+            # land in the flight recorder next to the step spans
+            # (docs/CHECKPOINT.md "Restore critical path")
+            phase_s = {k: float(phases.get(k, 0.0))
+                       for k in ("plan_s", "fetch_s", "device_s")}
+            self.stats.restore_seconds_total += restore_s
+            for k, v in phase_s.items():
+                self.stats.restore_phase_seconds[k] = (
+                    self.stats.restore_phase_seconds.get(k, 0.0) + v)
+            gauge = self._metric("CKPT_RESTORE_SECONDS")
+            gauge.set(restore_s, {"phase": "total"})
+            for k, v in phase_s.items():
+                gauge.set(v, {"phase": k[:-2]})
+            from k8s_tpu.obs.trace import default_tracer
+
+            tracer = default_tracer()
+            if tracer is not None:
+                for k, v in phase_s.items():
+                    tracer.note_span(
+                        f"restore_{k[:-2]}", v,
+                        step=plan.step, source=plan.source)
             print(json.dumps({
                 "event": "ckpt_restore", "step": plan.step,
                 "source": plan.source, "peer_shards": plan.peer_fetches,
                 "lost_steps": self.stats.lost_steps_last,
+                "seconds": round(restore_s, 6),
+                "phases_s": {k: round(v, 6) for k, v in phase_s.items()},
             }), flush=True)
         self._update_gauges()
         return tree

@@ -16,7 +16,9 @@ planner sources missing shards through one of two transports:
   production-shaped wire: every host serves its local tier over the
   same HTTP/JSON(+bytes) stack the control plane already speaks
   (:mod:`k8s_tpu.api.apiserver` idiom; ``metav1.Status``-style error
-  bodies, plain urllib client), and restarted pods fetch from the
+  bodies, stdlib client with per-thread kept-alive connections — one
+  TCP setup per peer per restore worker, not per shard), and
+  restarted pods fetch from the
   per-index Service DNS names the operator already maintains
   (``KTPU_CKPT_PEERS`` env, injected by
   :meth:`k8s_tpu.trainer.replicas.TpuReplicaSet.rendezvous`).
@@ -29,13 +31,12 @@ persistent tier, not wedge it.
 
 from __future__ import annotations
 
+import http.client
 import io
 import json
 import logging
 import threading
-import urllib.error
 import urllib.parse
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -108,6 +109,13 @@ class FilesystemPeerTransport:
 class _ShardHandler(BaseHTTPRequestHandler):
     server: "_ShardServer"
 
+    # keep-alive: a parallel restore fetches hundreds of shards from
+    # the same few peers — HTTP/1.1 persistent connections turn that
+    # into one TCP setup per (peer, client thread) instead of one per
+    # shard. Every response already carries Content-Length, which is
+    # what makes 1.1 keep-alive legal here.
+    protocol_version = "HTTP/1.1"
+
     def do_GET(self):  # noqa: N802 (http.server API)
         tier: LocalTier = self.server.tier
         parsed = urllib.parse.urlsplit(self.path)
@@ -151,6 +159,10 @@ class _ShardHandler(BaseHTTPRequestHandler):
         except BrokenPipeError:
             pass
         except Exception as e:  # a bad request must not kill the server
+            # headers/partial body may already be on the wire: a 500
+            # appended behind them would desynchronize a kept-alive
+            # client — close this connection instead of reusing it
+            self.close_connection = True
             try:
                 self._status(500, "InternalError", str(e))
             except Exception:
@@ -219,7 +231,26 @@ class RestPeerTransport:
     ``"0=http://svc-0:port,1=http://svc-1:port"``). Every failure is a
     miss; a peer that errors is skipped until the next :meth:`reset`
     (one timeout per dead peer per restore, not one per shard — the
-    planner resets at the top of every plan)."""
+    planner resets at the top of every plan).
+
+    Connections are **kept alive** per (peer, calling thread): a
+    parallel restore pulls hundreds of shards from the same few peers,
+    and a fresh TCP connection per shard is both slow (handshake per
+    fetch) and a SYN-backlog hazard under fan-out (the PR 13 lesson).
+    Thread-local pooling makes the transport safe under the restore
+    pipeline's worker pool with zero locking on the hot path; error
+    bodies are always drained so a 404 miss never poisons the reused
+    socket. A stale kept-alive socket (peer restarted, idle close) is
+    retried ONCE on a fresh connection before the peer is declared
+    dead — refused connections and timeouts fail immediately as
+    before. Sockets die with their threads (the pool is per-restore)."""
+
+    # stale-socket error classes worth one fresh-connection retry; a
+    # refused connect or a timeout means the peer itself is the problem
+    _RETRY_ERRORS = (http.client.BadStatusLine,
+                     http.client.CannotSendRequest,
+                     http.client.ResponseNotReady,
+                     ConnectionResetError, BrokenPipeError)
 
     def __init__(self, endpoints: Dict[int, str], self_host: int,
                  timeout: float = DEFAULT_TIMEOUT):
@@ -230,11 +261,53 @@ class RestPeerTransport:
         self.self_host = self_host
         self.timeout = timeout
         self._dead: set = set()
+        self._local = threading.local()  # per-thread {host: connection}
+        self.reused_connections = 0  # requests served over a kept socket
+        self._reused_lock = threading.Lock()  # counted from pool workers
 
     def reset(self) -> None:
         """Forget blacklisted peers (a recovered peer must be reachable
         again on the next restore)."""
         self._dead.clear()
+
+    # -------------------------------------------------- connection pool
+
+    def _conns(self) -> Dict[int, http.client.HTTPConnection]:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        return conns
+
+    def _conn(self, host: int) -> Optional[http.client.HTTPConnection]:
+        conns = self._conns()
+        c = conns.get(host)
+        if c is None:
+            parsed = urllib.parse.urlsplit(self.endpoints[host])
+            if not parsed.hostname:
+                return None
+            if parsed.scheme == "https":
+                c = http.client.HTTPSConnection(
+                    parsed.hostname, parsed.port, timeout=self.timeout)
+            else:
+                c = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port or 80,
+                    timeout=self.timeout)
+            conns[host] = c
+        return c
+
+    def _base_path(self, host: int) -> str:
+        """Any path prefix baked into the endpoint URL (a peer behind
+        a routing proxy) — prepended to every request path, as the old
+        urlopen(url + path) client did."""
+        return urllib.parse.urlsplit(self.endpoints[host]).path
+
+    def _drop_conn(self, host: int) -> None:
+        c = self._conns().pop(host, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     @classmethod
     def from_env_value(cls, raw: str, self_host: int,
@@ -253,25 +326,40 @@ class RestPeerTransport:
         return cls(eps, self_host, timeout=timeout)
 
     def _get(self, host: int, path: str) -> Optional[bytes]:
-        if host in self._dead:
+        if host in self._dead or host not in self.endpoints:
             return None
-        url = self.endpoints.get(host)
-        if not url:
-            return None
-        try:
-            with urllib.request.urlopen(url + path,
-                                        timeout=self.timeout) as r:
-                return r.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None  # an honest miss, peer is alive
-            self._dead.add(host)
-            return None
-        except Exception as e:
-            log.warning("peer-shard host %d unreachable (%s); skipping "
-                        "for this restore", host, e)
-            self._dead.add(host)
-            return None
+        for attempt in (0, 1):
+            conn = self._conn(host)
+            if conn is None:
+                return None
+            reused = conn.sock is not None
+            try:
+                conn.request("GET", self._base_path(host) + path)
+                resp = conn.getresponse()
+                # ALWAYS drain the body — an unread error body on a
+                # kept-alive socket would desynchronize every later
+                # request on it
+                body = resp.read()
+                if reused:
+                    with self._reused_lock:
+                        self.reused_connections += 1
+                if resp.status == 200:
+                    return body
+                if resp.status == 404:
+                    return None  # an honest miss, peer is alive
+                self._dead.add(host)
+                self._drop_conn(host)
+                return None
+            except Exception as e:
+                self._drop_conn(host)
+                if attempt == 0 and reused \
+                        and isinstance(e, self._RETRY_ERRORS):
+                    continue  # stale kept-alive socket: one fresh retry
+                log.warning("peer-shard host %d unreachable (%s); "
+                            "skipping for this restore", host, e)
+                self._dead.add(host)
+                return None
+        return None
 
     def peers(self) -> List[int]:
         return sorted(self.endpoints)

@@ -40,11 +40,24 @@ silent divergence. Two mechanisms compose:
 The chosen step is only a *plan* — if sourcing fails mid-way (a peer
 died between planning and fetching), the planner degrades to the
 persistent tier instead of wedging.
+
+Execution is a **pipeline** (docs/CHECKPOINT.md "Restore critical
+path"): shard fetches fan out across a bounded thread pool (I/O-bound
+disk/HTTP reads, so near-linear in workers), admission is leaf-granular
+against an in-flight-bytes gate so a multi-GB restore cannot blow host
+RAM, and the consumer materializes device arrays in template order
+while later leaves are still streaming — the ``data/prefetch.py``
+double-buffer idiom applied to restore. Per-shard crc verification and
+single-shard reroute-on-failure are unchanged from the serial path
+(each worker runs the same sourcing ladder), so a parallel restore is
+byte-identical to a serial one by construction.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -89,6 +102,71 @@ SOURCE_LOCAL_PEER = "local+peer"
 SOURCE_PERSISTENT = "persistent"
 SOURCE_NONE = "none"
 
+# pipeline defaults (overridable via CheckpointPolicy /
+# KTPU_CKPT_RESTORE_PARALLEL / KTPU_CKPT_RESTORE_INFLIGHT_MB)
+DEFAULT_RESTORE_PARALLEL = 8
+DEFAULT_INFLIGHT_BYTES = 1 << 30  # 1 GiB of host shard buffers
+
+
+def _est_shard_bytes(leaf, key: str) -> int:
+    """Host bytes one fetched shard will hold — geometry × itemsize
+    from the template, no payload read. An estimate (a peer may serve a
+    containing shard that is cut down after load), good enough for the
+    admission gate."""
+    dtype = getattr(leaf, "dtype", None)
+    try:
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    except TypeError:
+        itemsize = 4
+    try:
+        slices = parse_index_key(key)
+    except ValueError:
+        return itemsize
+    n = 1
+    for s in slices or ():
+        n *= max(0, int(s.stop) - int(s.start))
+    return max(1, n) * itemsize
+
+
+class _InflightGate:
+    """Bounds the host bytes a parallel restore holds at once.
+
+    Admission is LEAF-granular (the device-transfer unit): the
+    scheduler acquires a whole leaf's estimated shard bytes before any
+    of its fetches start, and the consumer releases them after the
+    leaf's device array is materialized and the host buffers dropped.
+    Per-shard accounting would deadlock — a leaf bigger than the cap
+    could never complete because release only happens per finished
+    leaf — so a single leaf may exceed the cap alone (``inflight == 0``
+    always admits), and the cap bounds everything beyond it.
+    ``cap <= 0`` disables the bound (peak still tracked)."""
+
+    def __init__(self, cap_bytes: int):
+        self.cap = int(cap_bytes)
+        self._cond = threading.Condition()
+        self.inflight = 0
+        self.peak = 0
+        self.waits = 0
+
+    def acquire(self, n: int, abort: threading.Event) -> None:
+        n = int(n)
+        with self._cond:
+            if self.cap > 0:
+                waited = False
+                while (self.inflight > 0 and self.inflight + n > self.cap
+                       and not abort.is_set()):
+                    if not waited:
+                        waited = True
+                        self.waits += 1
+                    self._cond.wait(timeout=0.1)
+            self.inflight += n
+            self.peak = max(self.peak, self.inflight)
+
+    def release(self, n: int) -> None:
+        with self._cond:
+            self.inflight -= int(n)
+            self._cond.notify_all()
+
 
 @dataclass
 class RestorePlan:
@@ -118,6 +196,8 @@ class RestorePlanner:
         devices=None,
         gang_consistent: bool = False,
         max_step: Optional[int] = None,
+        parallel: int = DEFAULT_RESTORE_PARALLEL,
+        inflight_bytes: int = DEFAULT_INFLIGHT_BYTES,
     ):
         self.local = local
         self.persistent = persistent  # train.checkpoint.CheckpointManager
@@ -137,6 +217,16 @@ class RestorePlanner:
         # NaN checkpoint is never the restore target. Deterministic
         # like the gang rule: every host gets the same ceiling env.
         self.max_step = max_step
+        # restore pipeline knobs: fetch-pool width and the in-flight
+        # host-bytes cap (parallel=1 degrades to the serial schedule;
+        # results are byte-identical either way)
+        self.parallel = max(1, int(parallel))
+        self.inflight_bytes = int(inflight_bytes)
+        # phase timings + pipeline counters of the LAST restore() —
+        # the MTTR evidence the manager exports (docs/CHECKPOINT.md
+        # "Restore critical path"). fetch_s and device_s overlap by
+        # design; their sum can exceed the restore wall time.
+        self.last_restore_stats: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ planning
 
@@ -361,7 +451,16 @@ class RestorePlanner:
             raise ValueError(
                 "RestorePlanner(devices=...) is planning-only; execute "
                 "the restore with a full-device planner")
+        t0 = time.perf_counter()
         plan = self.plan(template)
+        self.last_restore_stats = {
+            "plan_s": time.perf_counter() - t0,
+            "fetch_s": 0.0,
+            "device_s": 0.0,
+            "parallel": self.parallel,
+            "peak_inflight_bytes": 0,
+            "gate_waits": 0,
+        }
         if plan.source in (SOURCE_LOCAL, SOURCE_LOCAL_PEER):
             tree = self._restore_local(plan, template)
             if tree is not None:
@@ -371,100 +470,199 @@ class RestorePlanner:
                 "falling back to the persistent tier", plan.step)
             plan = self._persistent_plan(self._persistent_step())
         if plan.source == SOURCE_PERSISTENT:
+            t1 = time.perf_counter()
             tree = self.persistent.restore(template, step=plan.step)
+            # the orbax read is opaque to us: its whole wall time lands
+            # in the fetch phase (there is no overlap to decompose)
+            self.last_restore_stats["fetch_s"] += time.perf_counter() - t1
             if tree is None:
                 return None, RestorePlan(step=None, source=SOURCE_NONE)
             return tree, plan
         return None, plan
 
-    def _restore_local(self, plan: RestorePlan, template) -> Optional[Any]:
-        import jax
-
+    def _fetch_shard(self, plan: RestorePlan, path: str,
+                     key: str) -> Optional[np.ndarray]:
+        """The per-shard sourcing ladder — IDENTICAL to the old serial
+        path, now also run from pool workers: tiled union pieces, else
+        the planned peer (reroute to ANY peer when it died between
+        planning and fetching), else own disk (reroute to any peer on a
+        crc miss). crc validation lives in read_shard/the wire loaders;
+        a None return fails the whole restore (degrade, never wedge)."""
         step = plan.step
-        leaves_out = []
-        for path, leaf in _leaf_paths(template):
-            shape = tuple(getattr(leaf, "shape", ()))
-            dtype = getattr(leaf, "dtype", None)
-            sharding = getattr(leaf, "sharding", None)
-            shard_data: Dict[str, np.ndarray] = {}
-            for key in required_indices(leaf):
-                arr = None
-                pieces = plan.tiled.get(path, {}).get(key)
-                if pieces is not None:
-                    # assembled from shards no single manifest covers:
-                    # own tiles read locally, peer tiles fetched by
-                    # their EXACT stored key (read_shard serves exact
-                    # keys trivially), composed into the template slice
-                    src_of = dict(pieces)
+        pieces = plan.tiled.get(path, {}).get(key)
+        if pieces is not None:
+            # assembled from shards no single manifest covers: own
+            # tiles read locally, peer tiles fetched by their EXACT
+            # stored key (read_shard serves exact keys trivially),
+            # composed into the template slice
+            src_of = dict(pieces)
 
-                    def load(k, _src=src_of, _step=step, _path=path):
-                        h = _src[k]
-                        if h is None:
-                            return (self.local.read_shard(_step, _path, k)
-                                    if self.local is not None else None)
-                        return self.transport.fetch(_step, _path, k, h)
+            def load(k, _src=src_of, _step=step, _path=path):
+                h = _src[k]
+                if h is None:
+                    return (self.local.read_shard(_step, _path, k)
+                            if self.local is not None else None)
+                return self.transport.fetch(_step, _path, k, h)
 
-                    arr = compose_shard(key, [k for k, _ in pieces], load)
-                    if arr is None:
-                        return None
-                    shard_data[key] = arr
-                    continue
-                peer = plan.peer_shards.get(path, {}).get(key)
-                if peer is None and self.local is not None:
-                    arr = self.local.read_shard(step, path, key)
-                    if arr is None and self.transport is not None:
-                        # own shard corrupt/raced away — any peer will do
-                        for h in sorted(self.transport.steps()):
-                            arr = self.transport.fetch(step, path, key, h)
-                            if arr is not None:
-                                break
-                elif peer is not None:
-                    arr = self.transport.fetch(step, path, key, peer)
-                    if arr is None:
-                        # planned peer died: try the others
-                        for h in sorted(self.transport.steps()):
-                            if h == peer:
-                                continue
-                            arr = self.transport.fetch(step, path, key, h)
-                            if arr is not None:
-                                break
-                if arr is None:
-                    return None
-                shard_data[key] = arr
-            if sharding is None or not shape:
-                # replicated / host / scalar leaf: the single full shard
-                arr = next(iter(shard_data.values()))
-                if dtype is not None:
-                    arr = np.asarray(arr, dtype=dtype)
-                if sharding is not None:
-                    # honor the template placement — a committed
-                    # single-device scalar next to mesh-committed
-                    # arrays would poison the next jit call
-                    arr = jax.device_put(arr, sharding)
-                leaves_out.append(arr)
-                continue
+            return compose_shard(key, [k for k, _ in pieces], load)
+        arr = None
+        peer = plan.peer_shards.get(path, {}).get(key)
+        if peer is None and self.local is not None:
+            arr = self.local.read_shard(step, path, key)
+            if arr is None and self.transport is not None:
+                # own shard corrupt/raced away — any peer will do
+                for h in sorted(self.transport.steps()):
+                    arr = self.transport.fetch(step, path, key, h)
+                    if arr is not None:
+                        break
+        elif peer is not None:
+            arr = self.transport.fetch(step, path, key, peer)
+            if arr is None:
+                # planned peer died: try the others
+                for h in sorted(self.transport.steps()):
+                    if h == peer:
+                        continue
+                    arr = self.transport.fetch(step, path, key, h)
+                    if arr is not None:
+                        break
+        return arr
 
-            def cb(idx, _data=shard_data, _shape=shape):
-                from k8s_tpu.ckpt.local import index_key
-
-                return _data[index_key(idx, _shape)]
-
-            leaves_out.append(
-                jax.make_array_from_callback(shape, sharding, cb)
-            )
-        flat, treedef = jax.tree_util.tree_flatten(template)
-        tree = jax.tree_util.tree_unflatten(treedef, leaves_out)
-        # re-buffer through XLA-allocated storage: the train step
-        # DONATES the restored state, and on jax 0.4.x CPU gloo
-        # runtimes donating externally-created buffers
-        # (make_array_from_callback) corrupts the heap — the known
-        # "restored gloo worker" container bug, which surfaces either
-        # as a glibc abort or as SILENT corruption a step later
-        # (observed: bit-identical first post-restore step, garbage
-        # second). One device-side copy per restore is noise next to
-        # the disk reads it follows.
+    def _materialize_leaf(self, leaf, shard_data: Dict[str, np.ndarray]):
+        """Host shards → one device-resident leaf in the TEMPLATE's
+        placement. The jnp.copy re-buffers through XLA-allocated
+        storage: the train step DONATES the restored state, and on jax
+        0.4.x CPU gloo runtimes donating externally-created buffers
+        (make_array_from_callback) corrupts the heap — the known
+        "restored gloo worker" container bug, which surfaces either as
+        a glibc abort or as SILENT corruption a step later (observed:
+        bit-identical first post-restore step, garbage second). One
+        device-side copy per leaf is noise next to the reads it
+        follows."""
+        import jax
         import jax.numpy as jnp
 
-        return jax.tree_util.tree_map(
-            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
-            tree)
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not shape:
+            # replicated / host / scalar leaf: the single full shard
+            arr = next(iter(shard_data.values()))
+            if dtype is not None:
+                arr = np.asarray(arr, dtype=dtype)
+            if sharding is not None:
+                # honor the template placement — a committed
+                # single-device scalar next to mesh-committed
+                # arrays would poison the next jit call
+                arr = jnp.copy(jax.device_put(arr, sharding))
+            return arr
+
+        def cb(idx, _data=shard_data, _shape=shape):
+            from k8s_tpu.ckpt.local import index_key
+
+            return _data[index_key(idx, _shape)]
+
+        return jnp.copy(jax.make_array_from_callback(shape, sharding, cb))
+
+    def _restore_local(self, plan: RestorePlan, template) -> Optional[Any]:
+        """Execute a local/local+peer plan as a fetch→device pipeline.
+
+        A scheduler thread admits leaves in template order against the
+        in-flight-bytes gate and fans their shard fetches onto a
+        bounded pool; the calling thread consumes leaves in the same
+        order, materializing leaf N's device array while leaf N+1..
+        stream from disk/peers (the prefetch.py double-buffer shape).
+        Any failed shard aborts the whole pipeline promptly — the
+        caller degrades to the persistent tier, never a wedge."""
+        import jax
+        from concurrent.futures import ThreadPoolExecutor
+        from queue import Queue
+
+        specs = []
+        for path, leaf in _leaf_paths(template):
+            keys = required_indices(leaf)
+            est = sum(_est_shard_bytes(leaf, k) for k in keys)
+            specs.append((path, leaf, keys, est))
+        gate = _InflightGate(self.inflight_bytes)
+        abort = threading.Event()
+        fetch_t0 = time.perf_counter()
+        fetch_end = [fetch_t0]
+        fetch_end_lock = threading.Lock()
+
+        def task(path, key):
+            if abort.is_set():
+                return None
+            try:
+                arr = self._fetch_shard(plan, path, key)
+            except Exception as e:
+                log.warning("restore: shard fetch %s[%s] raised (%s: %s)",
+                            path, key, type(e).__name__, e)
+                arr = None
+            if arr is None:
+                abort.set()  # fail fast: later fetches become no-ops
+            now = time.perf_counter()
+            with fetch_end_lock:  # last-finish max across pool workers
+                if now > fetch_end[0]:
+                    fetch_end[0] = now
+            return arr
+
+        ready: Queue = Queue()
+        pool = ThreadPoolExecutor(
+            max_workers=self.parallel, thread_name_prefix="ckpt-restore")
+
+        def schedule():
+            try:
+                for path, leaf, keys, est in specs:
+                    if abort.is_set():
+                        break
+                    gate.acquire(est, abort)
+                    futs = [(k, pool.submit(task, path, k)) for k in keys]
+                    ready.put((leaf, est, futs))
+            finally:
+                ready.put(None)
+
+        sched = threading.Thread(target=schedule, daemon=True,
+                                 name="ckpt-restore-sched")
+        sched.start()
+        leaves_out = []
+        device_s = 0.0
+        ok = True
+        aborted = True  # stays True if the consumer loop dies mid-way
+        try:
+            while True:
+                item = ready.get()
+                if item is None:
+                    aborted = abort.is_set()
+                    break
+                leaf, est, futs = item
+                shard_data: Dict[str, np.ndarray] = {}
+                for key, fut in futs:
+                    arr = fut.result()
+                    if arr is None:
+                        ok = False
+                    shard_data[key] = arr
+                if ok and not abort.is_set():
+                    t0 = time.perf_counter()
+                    leaves_out.append(
+                        self._materialize_leaf(leaf, shard_data))
+                    device_s += time.perf_counter() - t0
+                # drop host buffers BEFORE releasing their bytes — the
+                # gate models host RAM, not queue slots
+                shard_data.clear()
+                gate.release(est)
+        finally:
+            # an exception escaping the consumer (a materialize
+            # failure) must not strand the scheduler in gate.acquire
+            # or leak the pool's threads — abort unblocks both
+            # (aborted was captured first: a clean drain stays clean)
+            abort.set()
+            sched.join()
+            pool.shutdown(wait=True)
+        stats = self.last_restore_stats
+        stats["fetch_s"] = max(0.0, fetch_end[0] - fetch_t0)
+        stats["device_s"] = device_s
+        stats["peak_inflight_bytes"] = gate.peak
+        stats["gate_waits"] = gate.waits
+        if not ok or aborted:
+            return None
+        flat, treedef = jax.tree_util.tree_flatten(template)
+        return jax.tree_util.tree_unflatten(treedef, leaves_out)

@@ -197,6 +197,12 @@ CKPT_OVERHEAD_FRACTION = REGISTRY.gauge(
     "ktpu_ckpt_overhead_fraction",
     "Fraction of loop wall-clock spent in checkpoint saves",
 )
+CKPT_RESTORE_SECONDS = REGISTRY.gauge(
+    "ktpu_ckpt_restore_seconds",
+    "Wall seconds of the last restore, by phase (plan / fetch / device "
+    "/ total; compile = the first post-restore step incl. XLA compile) "
+    "— the MTTR breakdown, docs/CHECKPOINT.md 'Restore critical path'",
+)
 # Serving fleet (k8s_tpu/router, docs/SERVING.md "Fleet"). Registered
 # process-global like the ckpt series: the router program's /metrics
 # and any operator health port expose them without new plumbing.

@@ -66,13 +66,15 @@ class Rendezvous:
         except ValueError:
             self.ckpt_peer_port = 0
         # trainer-mode contract (spec.training → operator env): ZeRO-1
-        # sharded weight update (consumed by the training programs) and
-        # the latency-hiding scheduler (ALSO consumed pre-init by
-        # configure_platform — parsed here so it is visible at the
-        # launch boundary like the checkpoint contract above)
+        # sharded weight update (consumed by the training programs),
+        # the latency-hiding scheduler, and the persistent XLA compile
+        # cache (both ALSO consumed pre-init by configure_platform —
+        # parsed here so the contract is visible at the launch boundary
+        # like the checkpoint contract above)
         self.zero1 = env.get("KTPU_ZERO1", "") in ("1", "true")
         self.latency_hiding = env.get(
             "KTPU_LATENCY_HIDING", "") in ("1", "true")
+        self.compile_cache_dir = env.get("KTPU_COMPILE_CACHE_DIR", "")
         # observability contract (spec.observability + the job trace id
         # — consumed by k8s_tpu.obs via programs.common; parsed here so
         # the contract is visible at the launch boundary)
@@ -107,6 +109,29 @@ def configure_platform(env=None):
         from k8s_tpu.parallel.mesh import enable_latency_hiding
 
         enable_latency_hiding(env)
+    cache_dir = env.get("KTPU_COMPILE_CACHE_DIR", "")
+    if cache_dir:
+        # persistent XLA compilation cache (spec.training
+        # compileCacheDir; docs/CHECKPOINT.md "Restore critical
+        # path"): a restarted or resized gang re-lowers the same train
+        # step — with the cache on a node-local or shared dir the cold
+        # recompile, the biggest serial term of restart MTTR, becomes
+        # a disk read. Thresholds drop to zero so EVERY executable is
+        # cached: restart latency is exactly the sum of the small
+        # compiles a default threshold would skip. Same pre-init
+        # contract as the latency-hiding flags above.
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except AttributeError:
+            pass  # jax too old for the persistent cache: run uncached
+        for opt, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(opt, val)
+            except (AttributeError, ValueError):
+                pass  # knob not present on this jax line
     n_cpu = env.get("KTPU_NUM_CPU_DEVICES", "")
     if n_cpu and platform == "cpu":
         try:

@@ -53,7 +53,12 @@ from typing import Dict, List, Optional
 # (Device-compute slowness is indistinguishable from a host's
 # perspective — every peer's collective stretches identically; that
 # diagnosis needs device profiles, out of this layer's scope.)
-GANG_PHASES = ("step_compute", "host_sync")
+# `compile` (the first step of an incarnation: trace + XLA compile +
+# the step) is excluded too: it is one-shot bring-up, not steady-state
+# slowness — and with a node-local compile cache a replaced pod
+# compiles COLD next to warm-cache survivors, which busy attribution
+# would misread as a straggler on its very first heartbeat.
+GANG_PHASES = ("step_compute", "host_sync", "compile")
 
 # -- chaos slow-host hook (process-local arm; see runtime/chaos.py) ------
 
@@ -359,6 +364,16 @@ class Tracer:
         if not self.enabled:
             return _NULL_PHASE
         return _SpanCtx(self, name, attrs)
+
+    def note_span(self, name: str, wall_s: float, **attrs) -> None:
+        """Record an externally-timed span — phases a subsystem measures
+        itself (the checkpoint manager's restore_plan/fetch/device
+        breakdown, the program's first-step compile) and reports after
+        the fact. Same record shape as :meth:`span`, so the flight
+        recorder and /debug/flightrecorder render both identically."""
+        if not self.enabled:
+            return
+        self._record_span(name, float(wall_s), attrs)
 
     def _finish_step(self, step: int, wall_s: float,
                      phases: Dict[str, float]) -> None:

@@ -368,8 +368,43 @@ def main(rdzv) -> None:
                 if poison and rdzv.process_id <= 0:
                     print(json.dumps({"event": "chaos_nan_grad",
                                       "step": step}), flush=True)
-            with st.phase("step_compute"):
-                state, metrics = step_fn(state, batch, rng)
+            if step == start + 1:
+                # the FIRST step of this incarnation: trace + XLA
+                # compile dominate its wall, so it is timed as its own
+                # `compile` phase (block_until_ready keeps async
+                # dispatch from hiding the compile in a later sync) —
+                # the last leg of restart MTTR next to the restore
+                # phases, shrunk by spec.training.compileCacheDir
+                # (docs/CHECKPOINT.md "Restore critical path")
+                import time as _time
+
+                _c0 = _time.perf_counter()  # independent of the
+                # tracer: the MTTR gauge/event must be real even with
+                # tracing off (st is the null step then — no phases)
+                with st.phase("compile"):
+                    state, metrics = step_fn(state, batch, rng)
+                    jax.block_until_ready(metrics["loss"])
+                compile_s = _time.perf_counter() - _c0
+                from k8s_tpu.controller.metrics import CKPT_RESTORE_SECONDS
+
+                CKPT_RESTORE_SECONDS.set(compile_s, {"phase": "compile"})
+                tracer.note_span("compile", compile_s, step=step)
+                if rdzv.process_id <= 0:
+                    # the launcher already parsed the cache contract
+                    # (Rendezvous.compile_cache_dir); bare rdzv stubs
+                    # fall back to the env, the _rdzv_flag pattern
+                    cache_dir = getattr(rdzv, "compile_cache_dir", None)
+                    if cache_dir is None:
+                        cache_dir = os.environ.get(
+                            "KTPU_COMPILE_CACHE_DIR", "")
+                    print(json.dumps({
+                        "event": "compile_phase", "step": step,
+                        "seconds": round(compile_s, 6),
+                        "cache": bool(cache_dir),
+                    }), flush=True)
+            else:
+                with st.phase("step_compute"):
+                    state, metrics = step_fn(state, batch, rng)
             final_loss = metrics["loss"]
             if first_loss is None:
                 first_loss = final_loss

@@ -207,7 +207,12 @@ class CheckpointPolicySpec(K8sObject):
     its missing local shards from a data-parallel peer before falling
     back to the persistent tier; ``peerPort`` > 0 additionally serves
     each host's local tier over the REST shard wire on that port (0 =
-    shared-filesystem peers only). The whole block flows operator →
+    shared-filesystem peers only). ``restoreParallel`` is the restore
+    pipeline's shard-fetch pool width (1 = serial, byte-identical
+    results either way) and ``restoreInflightMb`` caps the host bytes
+    of fetched-but-not-yet-device-resident shards, so a multi-GB
+    restore streams instead of ballooning host RAM (docs/CHECKPOINT.md
+    "Restore critical path"). The whole block flows operator →
     kubelet env (``KTPU_CKPT_*``) → launcher → training program."""
 
     local_dir: str = ""
@@ -217,6 +222,8 @@ class CheckpointPolicySpec(K8sObject):
     persistent_interval_steps: int = 0
     peer_fetch: bool = True
     peer_port: int = 0
+    restore_parallel: int = 8
+    restore_inflight_mb: int = 1024
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -235,6 +242,13 @@ class CheckpointPolicySpec(K8sObject):
                 "checkpointPolicy: localMaxToKeep must be >= 1")
         if self.peer_port < 0 or self.peer_port > 65535:
             raise ValidationError("checkpointPolicy: peerPort out of range")
+        if self.restore_parallel < 1:
+            raise ValidationError(
+                "checkpointPolicy: restoreParallel must be >= 1")
+        if self.restore_inflight_mb < 0:
+            raise ValidationError(
+                "checkpointPolicy: restoreInflightMb must be >= 0 "
+                "(0 disables the in-flight-bytes cap)")
         if (
             self.persistent_interval_steps > 0
             and self.local_interval_steps > self.persistent_interval_steps
@@ -258,6 +272,8 @@ class CheckpointPolicySpec(K8sObject):
         env["KTPU_CKPT_PEER_FETCH"] = "1" if self.peer_fetch else "0"
         if self.peer_port:
             env["KTPU_CKPT_PEER_PORT"] = str(self.peer_port)
+        env["KTPU_CKPT_RESTORE_PARALLEL"] = str(self.restore_parallel)
+        env["KTPU_CKPT_RESTORE_INFLIGHT_MB"] = str(self.restore_inflight_mb)
         return env
 
 
@@ -274,26 +290,38 @@ class TrainingSpec(K8sObject):
     ``latencyHiding`` compiles train steps with XLA's latency-hiding
     scheduler so the ZeRO gather/scatter (and every other collective)
     overlaps with compute; the env lands before backend init via the
-    launcher pre-init hook."""
+    launcher pre-init hook.
+    ``compileCacheDir`` points XLA's persistent compilation cache at a
+    node-local or shared path (docs/CHECKPOINT.md "Restore critical
+    path"): a restarted or resized gang re-lowers the same train step,
+    so the cold recompile — the biggest serial term of restart MTTR —
+    becomes a disk read. Same pre-init plumbing as ``latencyHiding``."""
 
     zero1: bool = False
     latency_hiding: bool = False
+    compile_cache_dir: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
         for name in ("zero1", "latency_hiding"):
             if not isinstance(getattr(self, name), bool):
                 raise ValidationError(f"training: {name} must be a boolean")
+        if not isinstance(self.compile_cache_dir, str):
+            raise ValidationError(
+                "training: compileCacheDir must be a string path")
 
     def to_env(self) -> Dict[str, str]:
         """The launcher/program contract (``KTPU_ZERO1`` read by
-        ``programs.llama_train``; ``KTPU_LATENCY_HIDING`` by the
-        launcher's ``configure_platform`` pre-init hook)."""
+        ``programs.llama_train``; ``KTPU_LATENCY_HIDING`` and
+        ``KTPU_COMPILE_CACHE_DIR`` by the launcher's
+        ``configure_platform`` pre-init hook)."""
         env: Dict[str, str] = {}
         if self.zero1:
             env["KTPU_ZERO1"] = "1"
         if self.latency_hiding:
             env["KTPU_LATENCY_HIDING"] = "1"
+        if self.compile_cache_dir:
+            env["KTPU_COMPILE_CACHE_DIR"] = self.compile_cache_dir
         return env
 
 

@@ -581,6 +581,59 @@ class TestRestPeerWire:
             "0=http://a:9,1=http://b:9,junk", self_host=1)
         assert t.peers() == [0]  # self excluded, junk dropped
 
+    def test_keep_alive_reuses_connection_and_drains_misses(self, tmp_path):
+        """The wire keeps one connection per (peer, thread) across
+        shards — and a 404 miss (error body drained) must not poison
+        the reused socket for the next fetch."""
+        mesh = small_mesh()
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(4, make_tree(mesh, scale=1.5))
+        srv = PeerShardServer(tier, port=0).start()
+        try:
+            t = RestPeerTransport({0: srv.url}, self_host=1)
+            man = t.manifest(4, 0)
+            requests = 1  # the manifest call itself
+            for path, entry in man["leaves"].items():
+                # interleave honest misses with real fetches on the
+                # SAME kept-alive socket
+                assert t.fetch(4, path, "9:9", 0) is None
+                requests += 1
+                for key in entry["shards"]:
+                    assert t.fetch(4, path, key, 0) is not None
+                    requests += 1
+            # every request after the first rode the kept socket
+            assert requests >= 4
+            assert t.reused_connections == requests - 1, (
+                requests, t.reused_connections)
+        finally:
+            srv.stop()
+
+    def test_stale_kept_socket_retries_once_then_succeeds(self, tmp_path):
+        """Peer restarts between fetches: the client's kept-alive
+        socket is stale (server side closed). The transport must retry
+        ONCE on a fresh connection instead of declaring the live peer
+        dead — the restart-storm case where every peer pod recycled."""
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=2.5)
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(6, tree)
+        srv = PeerShardServer(tier, port=0).start()
+        t = RestPeerTransport({0: srv.url}, self_host=1)
+        assert t.steps() == {0: [6]}  # connection now kept alive
+        port = srv.port
+        srv.stop()  # peer dies; client still holds the dead socket
+        srv2 = PeerShardServer(tier, port=port).start()  # ...and returns
+        try:
+            # the stale socket surfaces as a reset/closed-connection
+            # error on the next request — the retry must absorb it
+            assert t.steps() == {0: [6]}, "stale socket not retried"
+            assert 0 not in t._dead
+            man = t.manifest(6, 0)
+            key = next(iter(man["leaves"]["w"]["shards"]))
+            assert t.fetch(6, "w", key, 0) is not None
+        finally:
+            srv2.stop()
+
     def test_full_restore_over_rest(self, tmp_path):
         mesh = small_mesh()
         tree = make_tree(mesh, scale=9.0)
@@ -597,6 +650,291 @@ class TestRestPeerWire:
             assert_tree_equal(restored, tree)
         finally:
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# parallel pipelined restore (ISSUE 14): the fetch pool + in-flight
+# gate must preserve the serial path's semantics exactly — the CI
+# restore-perf stage runs this class plus the restore bench smoke
+# ---------------------------------------------------------------------------
+
+
+class _SlowFsTransport:
+    """FilesystemPeerTransport with a per-fetch sleep: makes the
+    scheduler deterministically outrun the consumer, so gate-wait
+    assertions can't flake on timing."""
+
+    def __init__(self, inner, delay_s=0.0):
+        self.inner = inner
+        self.delay_s = delay_s
+
+    def steps(self):
+        return self.inner.steps()
+
+    def manifest(self, step, host):
+        return self.inner.manifest(step, host)
+
+    def progress(self):
+        return self.inner.progress()
+
+    def fetch(self, step, leaf, key, host):
+        if self.delay_s:
+            import time
+
+            time.sleep(self.delay_s)
+        return self.inner.fetch(step, leaf, key, host)
+
+
+class _DyingTransport(_SlowFsTransport):
+    """Delegate whose ``dying`` host serves ``allow`` fetches then
+    fails every later one — a peer dying BETWEEN planning and (part of)
+    fetching, under parallel workers."""
+
+    def __init__(self, inner, dying, allow=1):
+        super().__init__(inner)
+        self.dying = dying
+        self.allow = allow
+        self._n = 0
+        import threading
+
+        self._lock = threading.Lock()
+
+    def fetch(self, step, leaf, key, host):
+        if host == self.dying:
+            with self._lock:
+                self._n += 1
+                if self._n > self.allow:
+                    return None
+        return self.inner.fetch(step, leaf, key, host)
+
+
+class TestParallelRestore:
+    class FakePersistent:
+        def latest_step(self):
+            return None
+
+        def restore(self, template, step=None):
+            return None
+
+    def _multi_leaf_tree(self, mesh, leaves=4, n=2048, scale=1.0):
+        """``leaves`` replicated float32 leaves of ``n`` elements each
+        (4n bytes) — enough independent leaves for the pipeline to
+        overlap and the gate to bite."""
+        return {
+            f"leaf{i}": jax.device_put(
+                (jnp.arange(n, dtype=jnp.float32) + 100.0 * i) * scale,
+                NamedSharding(mesh, P()))
+            for i in range(leaves)
+        }
+
+    def test_serial_and_parallel_restores_bit_identical(self, tmp_path):
+        """parallel=1 (the serial schedule) and parallel=8 must produce
+        byte-identical trees — the acceptance bar that lets every
+        existing restore consumer ride the pipeline unchanged."""
+        mesh = small_mesh()
+        tree = {**make_tree(mesh, scale=3.0),
+                **self._multi_leaf_tree(mesh, leaves=3, n=512)}
+        LocalTier(str(tmp_path), host_id=1, sync=True).save(9, tree)
+        restored = {}
+        for par in (1, 8):
+            planner = RestorePlanner(
+                LocalTier(str(tmp_path), host_id=0, sync=True),
+                self.FakePersistent(),
+                transport=FilesystemPeerTransport(str(tmp_path),
+                                                  self_host=0),
+                parallel=par)
+            out, plan = planner.restore(template_of(tree))
+            assert plan.source == SOURCE_LOCAL_PEER and plan.step == 9
+            assert planner.last_restore_stats["parallel"] == par
+            restored[par] = out
+        assert_tree_equal(restored[1], tree)
+        assert_tree_equal(restored[8], tree)
+        assert_tree_equal(restored[1], restored[8])
+
+    def test_peer_dies_mid_parallel_restore_reroutes(self, tmp_path):
+        """The planned peer serves ONE shard then dies under a
+        parallel restore: every remaining shard must reroute to the
+        surviving peer — bit-identical result, no wedge, no fallback
+        to the persistent tier."""
+        mesh = small_mesh()
+        tree = self._multi_leaf_tree(mesh, leaves=5, n=1024, scale=2.0)
+        for h in (1, 2):  # two donors, same SPMD-invariant bytes
+            LocalTier(str(tmp_path), host_id=h, sync=True).save(6, tree)
+        transport = _DyingTransport(
+            FilesystemPeerTransport(str(tmp_path), self_host=0),
+            dying=1, allow=1)
+        planner = RestorePlanner(
+            LocalTier(str(tmp_path), host_id=0, sync=True),
+            self.FakePersistent(), transport=transport, parallel=4)
+        restored, plan = planner.restore(template_of(tree))
+        assert restored is not None, "reroute wedged/failed"
+        assert plan.source == SOURCE_LOCAL_PEER and plan.step == 6
+        assert_tree_equal(restored, tree)
+
+    def test_inflight_bytes_cap_honored(self, tmp_path):
+        """Under a tiny cap the gate must bound peak in-flight host
+        bytes (and visibly make the scheduler wait); uncapped, the
+        same restore holds every leaf at once. The slow transport
+        guarantees fetches outlive admission, so the waits are
+        deterministic."""
+        mesh = small_mesh()
+        leaf_bytes = 2048 * 4
+        tree = self._multi_leaf_tree(mesh, leaves=4, n=2048)
+        LocalTier(str(tmp_path), host_id=1, sync=True).save(4, tree)
+
+        def run(inflight_bytes):
+            planner = RestorePlanner(
+                LocalTier(str(tmp_path), host_id=0, sync=True),
+                self.FakePersistent(),
+                transport=_SlowFsTransport(
+                    FilesystemPeerTransport(str(tmp_path), self_host=0),
+                    delay_s=0.02),
+                parallel=4, inflight_bytes=inflight_bytes)
+            restored, plan = planner.restore(template_of(tree))
+            assert plan.source == SOURCE_LOCAL_PEER
+            assert_tree_equal(restored, tree)
+            return planner.last_restore_stats
+
+        cap = leaf_bytes + 64  # one leaf at a time
+        capped = run(cap)
+        assert capped["peak_inflight_bytes"] <= cap, capped
+        assert capped["gate_waits"] > 0, capped
+        uncapped = run(0)
+        assert uncapped["peak_inflight_bytes"] == 4 * leaf_bytes, uncapped
+        assert uncapped["gate_waits"] == 0, uncapped
+
+    def test_shard_failure_degrades_to_persistent_not_wedge(
+            self, tmp_path):
+        """Every peer dead mid-parallel-restore (no reroute target):
+        the pipeline must abort promptly and the planner degrade to
+        the persistent tier — the no-wedge contract under threads."""
+        mesh = small_mesh()
+        tree = self._multi_leaf_tree(mesh, leaves=4, n=256, scale=5.0)
+        LocalTier(str(tmp_path), host_id=1, sync=True).save(8, tree)
+
+        class Persistent(self.FakePersistent):
+            def __init__(self, tree):
+                self._tree = tree
+                self.restored = 0
+
+            def latest_step(self):
+                return 5  # older than the local step, so the local
+                # plan is attempted first and fails mid-way
+
+            def restore(self, template, step=None):
+                self.restored += 1
+                return self._tree
+
+        persistent = Persistent(tree)
+        planner = RestorePlanner(
+            LocalTier(str(tmp_path), host_id=0, sync=True), persistent,
+            transport=_DyingTransport(
+                FilesystemPeerTransport(str(tmp_path), self_host=0),
+                dying=1, allow=0),
+            parallel=4)
+        restored, plan = planner.restore(template_of(tree))
+        # the local plan failed mid-way; the persistent tier answered
+        assert persistent.restored == 1
+        assert restored is not None
+        assert_tree_equal(restored, tree)
+
+    def test_restore_phase_goodput_metrics_and_spans(self, tmp_path,
+                                                     capsys):
+        """MTTR telemetry end to end in-process: goodput carries
+        restore_seconds_total + the phase breakdown, the
+        ktpu_ckpt_restore_seconds gauge is set per phase, the
+        ckpt_restore event carries seconds, and the restore_* spans
+        land in the default tracer's flight recorder."""
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.obs.trace import Tracer, set_default_tracer
+
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        tree = make_tree(mesh, scale=2.0)
+        mgr.save(3, tree)
+        mgr.note_step(3)
+        tracer = Tracer(trace_id="t-restore", task="worker-0")
+        set_default_tracer(tracer)
+        try:
+            assert mgr.restore(template_of(tree)) is not None
+        finally:
+            set_default_tracer(None)
+        g = mgr.goodput()
+        assert g["restore_seconds_total"] > 0, g
+        assert set(g["restore_phases_s"]) == {
+            "plan_s", "fetch_s", "device_s"}, g
+        assert M.CKPT_RESTORE_SECONDS.get({"phase": "total"}) > 0
+        for phase in ("plan", "fetch", "device"):
+            assert ({"phase": phase} in [dict(k) for k in
+                                         M.CKPT_RESTORE_SECONDS.values]), \
+                phase
+        from k8s_tpu.obs.events import last_event
+
+        ev = last_event(capsys.readouterr().out, "ckpt_restore")
+        assert ev is not None and ev["seconds"] > 0, ev
+        assert set(ev["phases_s"]) == {"plan_s", "fetch_s", "device_s"}
+        spans = {e["name"] for e in tracer.recorder.snapshot()
+                 if e.get("kind") == "span"}
+        assert {"restore_plan", "restore_fetch",
+                "restore_device"} <= spans, spans
+        mgr.close()
+
+    def test_restore_knobs_env_roundtrip(self, tmp_path):
+        """restoreParallel / restoreInflightMb flow spec → env →
+        policy → planner, like every other checkpointPolicy knob."""
+        from k8s_tpu.spec import CheckpointPolicySpec, ValidationError
+
+        spec = CheckpointPolicySpec(
+            local_dir=str(tmp_path), local_interval_steps=2,
+            restore_parallel=3, restore_inflight_mb=7)
+        spec.validate()
+        env = spec.to_env()
+        assert env["KTPU_CKPT_RESTORE_PARALLEL"] == "3"
+        assert env["KTPU_CKPT_RESTORE_INFLIGHT_MB"] == "7"
+        policy = CheckpointPolicy.from_env(env)
+        assert policy.restore_parallel == 3
+        assert policy.restore_inflight_mb == 7
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        assert mgr.planner.parallel == 3
+        assert mgr.planner.inflight_bytes == 7 << 20
+        mgr.close()
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(
+                local_dir="/x", local_interval_steps=2,
+                restore_parallel=0).validate()
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(
+                local_dir="/x", local_interval_steps=2,
+                restore_inflight_mb=-1).validate()
+
+
+class TestCompileCacheContract:
+    def test_training_spec_env_and_launcher_roundtrip(self):
+        """compileCacheDir rides the same spec→env→launcher contract
+        as zero1/latencyHiding (the launcher's pre-init hook consumes
+        KTPU_COMPILE_CACHE_DIR before backend init)."""
+        from k8s_tpu.launcher.spmd_launcher import Rendezvous
+        from k8s_tpu.spec import TrainingSpec
+
+        spec = TrainingSpec(zero1=True, compile_cache_dir="/scratch/xla")
+        spec.validate()
+        env = spec.to_env()
+        assert env["KTPU_COMPILE_CACHE_DIR"] == "/scratch/xla"
+        assert env["KTPU_ZERO1"] == "1"
+        rdzv = Rendezvous(env={**env, "KTPU_PROCESS_ID": "0"})
+        assert rdzv.compile_cache_dir == "/scratch/xla"
+        assert rdzv.zero1 is True
+        # absent → absent (no empty-string env pollution)
+        assert "KTPU_COMPILE_CACHE_DIR" not in TrainingSpec().to_env()
+
+    def test_validation_rejects_non_string(self):
+        from k8s_tpu.spec import TrainingSpec, ValidationError
+
+        with pytest.raises(ValidationError):
+            TrainingSpec(compile_cache_dir=123).validate()
 
 
 # ---------------------------------------------------------------------------

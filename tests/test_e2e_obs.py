@@ -421,7 +421,15 @@ def test_nan_divergence_restores_and_succeeds(tmp_path):
         for r in restores:
             assert r["step"] < NAN_STEP, r
             assert r["source"] in ("local", "local+peer"), r
+            # each restore reports its wall time (MTTR telemetry)
+            assert r["seconds"] > 0, r
         assert any(r["lost_steps"] > 0 for r in restores), restores
+        # ...and the restarted incarnation's goodput accumulates
+        # restart latency in seconds, not just lost steps
+        goodputs = events_of(logs, "ckpt_goodput")
+        assert goodputs and any(
+            g.get("restore_seconds_total", 0) > 0
+            for g in goodputs), goodputs
         # step_health events bracket the divergence: a non-finite block
         # at/after the NaN step, healthy blocks after the restore, and
         # the final step completed

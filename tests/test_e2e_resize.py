@@ -242,6 +242,9 @@ def test_permanent_loss_resize_shrink_grow_e2e(tmp_path):
         assert 0 <= shrink_restore["lost_steps"] <= LOCAL_EVERY + 2, (
             shrink_restore)
         assert shrink_restore["source"] in ("local", "local+peer")
+        # resize restores ride the same MTTR telemetry: the event
+        # carries its measured wall time
+        assert shrink_restore["seconds"] > 0, shrink_restore
         # the re-derived world: mesh event from the DP=1 incarnation
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:

@@ -264,6 +264,7 @@ def test_two_jobs_contend_preempt_flush_resume(tmp_path):
         # the local tier's own commit is asserted on disk below.
         assert restores[0]["step"] == flush_step, (restores, flush_step)
         assert 0 <= restores[0]["lost_steps"] <= 2, restores
+        assert restores[0]["seconds"] > 0, restores  # MTTR measured
         assert '"step": 40' in log_low  # trained to completion
         assert any(c.type == "Admitted"
                    for c in low.status.conditions)  # re-admission landed
