@@ -1,0 +1,168 @@
+"""Zero-stall save bench: serial vs pipelined save-critical-path A/B.
+
+The save tax every healthy step pays is the SYNCHRONOUS slice of
+``LocalTier.save`` — the device→host snapshot (docs/CHECKPOINT.md
+"Save critical path"); serialization, crc, and the atomic commit run
+behind it on the writer thread. This bench measures that critical path
+with stand-in shards whose D2H copy carries a fixed injected latency
+(the stand-in for real DMA/transfer time — tmpfs-speed memcpys would
+hide the fan-out in noise, the restore bench's SlowTransport idiom):
+
+1. **Serial vs pipelined snapshot** — the same multi-leaf state saved
+   with a width-1 pool (the old serial schedule) and the default
+   bounded pool. Asserable win: copies overlap near-linearly in the
+   pool width. The two committed checkpoints must be byte-identical —
+   same manifests, same per-shard crcs — verified, not assumed.
+2. **Bounded staging** — a re-run with ``saveBufferBytes`` capped at
+   two leaves proves the gate bounds peak staged host bytes (with gate
+   waits reported) while still committing the identical checkpoint.
+
+The JSON line carries the A/B + the background phase split; ``--smoke``
+shrinks everything for the CI ``save-perf`` stage
+(tests/test_benches.py asserts the ≥3x critical-path win and the
+manifest identity there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class _SlowShard:
+    """One addressable shard whose ``.data`` read (the D2H copy source)
+    carries a fixed latency — deterministic on any box."""
+
+    device = None  # the bench tier never narrows by device
+
+    def __init__(self, index, data, delay_s):
+        self.index = index
+        self._data = data
+        self.delay_s = delay_s
+
+    @property
+    def data(self):
+        time.sleep(self.delay_s)
+        return self._data
+
+
+class _SlowLeaf:
+    """A stand-in sharded array: one full-coverage shard with injected
+    copy latency. Walks the same ``addressable_shards`` path a real jax
+    array takes through ``shard_copy_jobs``."""
+
+    def __init__(self, arr: np.ndarray, delay_s: float):
+        self._arr = arr
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+        self._delay_s = delay_s
+
+    @property
+    def addressable_shards(self):
+        idx = tuple(slice(0, d) for d in self.shape)
+        return [_SlowShard(idx, self._arr, self._delay_s)]
+
+
+def _make_tree(leaves: int, shard_kb: int, delay_ms: float):
+    n = max(1, (shard_kb << 10) // 4)
+    return {
+        f"leaf{i:02d}": _SlowLeaf(
+            (np.arange(n, dtype=np.float32) + 31.0 * i),
+            delay_ms / 1e3)
+        for i in range(leaves)
+    }
+
+
+def _save_ab(leaves: int, shard_kb: int, delay_ms: float, parallel: int):
+    from k8s_tpu.ckpt import LocalTier
+
+    tree = _make_tree(leaves, shard_kb, delay_ms)
+    leaf_bytes = max(1, (shard_kb << 10) // 4) * 4
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="ktpu-save-bench-") as root:
+        # warmup: the first save of a process pays jax's import inside
+        # the leaf walk — burn it on a throwaway tier so the serial arm
+        # (which runs first) measures the schedule, not the import
+        LocalTier(os.path.join(root, "warmup"), host_id=0).save(
+            1, _make_tree(2, 1, 0.0))
+
+        def run(name, par, buffer_bytes=0):
+            tier = LocalTier(
+                os.path.join(root, name), host_id=0,
+                parallel=par, buffer_bytes=buffer_bytes)
+            t0 = time.perf_counter()
+            assert tier.save(7, tree) is True
+            crit = time.perf_counter() - t0  # save() return == the
+            # step-critical-path: every copy done, caller may donate
+            tier.wait()  # background serialize+commit drained
+            man = tier.manifest(7)
+            assert man is not None, "save did not commit"
+            return crit, man, dict(tier.last_save_stats)
+
+        serial_s, serial_man, _ = run("serial", 1)
+        pipelined_s, pipelined_man, stats = run("pipelined", parallel)
+        # the gate A/B: a tiny cap (2 leaves) must bound peak staged
+        # bytes where the uncapped run stages (nearly) everything
+        cap = 2 * leaf_bytes + 64
+        _, capped_man, capped = run("capped", parallel, buffer_bytes=cap)
+        identical = (serial_man["leaves"] == pipelined_man["leaves"]
+                     == capped_man["leaves"])
+        out = {
+            "save_serial_s": round(serial_s, 4),
+            "save_pipelined_s": round(pipelined_s, 4),
+            "save_critical_path_speedup": round(
+                serial_s / max(pipelined_s, 1e-9), 2),
+            "manifests_identical": identical,
+            "shard_crcs": sorted(
+                sh["crc"]
+                for e in serial_man["leaves"].values()
+                for sh in e["shards"].values())[:4],
+            "background_phases_s": {
+                "snapshot": round(stats.get("snapshot_s", 0.0), 4)},
+            "uncapped_peak_staged_bytes": stats["peak_staged_bytes"],
+            "staged_cap_bytes": cap,
+            "capped_peak_staged_bytes": capped["peak_staged_bytes"],
+            "capped_gate_waits": capped["gate_waits"],
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="save-bench")
+    p.add_argument("--leaves", type=int, default=32)
+    p.add_argument("--shard-kb", type=int, default=256)
+    p.add_argument("--copy-delay-ms", type=float, default=10.0)
+    p.add_argument("--parallel", type=int, default=8)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for the CI save-perf stage")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.leaves = min(args.leaves, 16)
+        args.shard_kb = min(args.shard_kb, 16)
+        args.copy_delay_ms = min(args.copy_delay_ms, 8.0)
+
+    ab = _save_ab(args.leaves, args.shard_kb, args.copy_delay_ms,
+                  args.parallel)
+    print(json.dumps({
+        "metric": "save_critical_path_speedup",
+        "value": ab["save_critical_path_speedup"],
+        **ab,
+        "leaves": args.leaves,
+        "shard_kb": args.shard_kb,
+        "copy_delay_ms": args.copy_delay_ms,
+        "parallel": args.parallel,
+        "mode": "smoke" if args.smoke else "full",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

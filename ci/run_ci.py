@@ -243,6 +243,27 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_restore_perf.xml"],
             args.artifacts_dir, cases,
         )
+        # zero-stall-save gate (ISSUE 15): the pipelined save path —
+        # serial≡pipelined byte-identical committed manifests, the
+        # donate-after contract under overlap (a scribbled device
+        # buffer must never reach disk), the staged-bytes gate, the
+        # zero-stall busy-skip accounting, the streaming-crc no-copy
+        # guarantee, and the saveConcurrency/saveBufferBytes
+        # spec→env→policy round trip — plus the save bench's --smoke
+        # A/B (pipelined critical path ≥3x lower than serial). Always
+        # on and fast, mirroring restore-perf: the save tax sits on
+        # EVERY healthy step, so a regression here is a fleet-wide
+        # goodput leak.
+        ok = ok and stage(
+            "save-perf",
+            [py, "-m", "pytest",
+             "tests/test_ckpt_tiers.py::TestPipelinedSave",
+             "tests/test_benches.py::TestBenches"
+             "::test_save_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_save_perf.xml"],
+            args.artifacts_dir, cases,
+        )
         # collective-budget gate (ISSUE 3): compile the stand-in sharded
         # train steps on the 8-device virtual CPU mesh and enforce their
         # golden budget manifests (ci/hlo_budgets/) — a sharding
@@ -284,6 +305,8 @@ def main(argv=None) -> int:
                       "::test_serving_disagg_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_restore_bench_smoke",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_save_bench_smoke",
                       f"--junitxml={args.artifacts_dir}/junit_pytest.xml"]
         ok = ok and stage("unit-tests", pytest_cmd, args.artifacts_dir, cases)
         ok = ok and stage(

@@ -13,8 +13,15 @@ from k8s_tpu.ckpt.local import (  # noqa: F401
     compose_shard,
     covering_plan,
     index_key,
+    local_shards_of,
     parse_index_key,
+    shard_copy_jobs,
     union_covering_plan,
+)
+from k8s_tpu.ckpt.pipeline import (  # noqa: F401
+    InflightGate,
+    crc32_array,
+    stage_tree,
 )
 from k8s_tpu.ckpt.peer import (  # noqa: F401
     FilesystemPeerTransport,

@@ -7,6 +7,18 @@ orders of magnitude cheaper than a durable-store save — so a gang
 restart loses at most ``local_interval`` steps instead of
 ``persistent_interval``.
 
+The save itself is a **pipeline** (docs/CHECKPOINT.md "Save critical
+path"): the step-critical-path slice is ONE parallel device→host
+snapshot — per-shard copies fan out across a bounded pool, admitted
+leaf-by-leaf against an in-flight-bytes gate so a multi-GB state stages
+through bounded host RAM — and everything after it (npy serialization,
+streaming crc, manifest, barrier, atomic commit) runs on a background
+writer thread that only ever touches the staged copies, never device
+views. ``save()`` returns once every copy has completed, so the caller
+may donate the live arrays immediately (the donate-after contract); a
+``block=False`` caller that finds the previous writer still committing
+gets a counted skip instead of a stall.
+
 Crash-safety is a **two-phase commit**:
 
 1. *Write phase*: shards + a per-host manifest land in
@@ -44,10 +56,14 @@ import logging
 import os
 import shutil
 import threading
+import time
 import zlib
+from queue import Queue
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from k8s_tpu.ckpt.pipeline import InflightGate, crc32_array, est_leaf_bytes
 
 log = logging.getLogger(__name__)
 
@@ -289,28 +305,51 @@ def local_shards_of(leaf, devices=None) -> Dict[str, np.ndarray]:
     copy is enough). Plain numpy/python leaves are treated as one
     fully-replicated shard. ``devices`` narrows "this host" to a device
     subset — how the in-process soak simulates multiple hosts on one
-    runtime."""
-    shards: Dict[str, np.ndarray] = {}
+    runtime. Eager spelling of :func:`shard_copy_jobs` (the save
+    pipeline's deferred form)."""
+    jobs, _ = shard_copy_jobs(leaf, devices=devices)
+    return {key: materialize() for key, materialize in jobs}
+
+
+def shard_copy_jobs(leaf, devices=None):
+    """This host's shards of ``leaf`` as DEFERRED copy jobs: a list of
+    ``(index_key, materialize)`` pairs plus the estimated host bytes
+    the copies will stage. Enumeration reads geometry only, so the
+    save pipeline can gate-admit and pool-fan the copies without
+    touching payloads on the calling thread.
+
+    Each ``materialize()`` is ``np.array(..., copy=True)`` — save()'s
+    contract is that the device→host copy happens before it returns so
+    the caller may donate immediately. ``np.asarray`` of a CPU-backend
+    jax array can be a ZERO-COPY view of the device buffer, and the
+    async writer would then serialize whatever the NEXT (donated) step
+    scribbled into it: a crc-consistent garbage checkpoint (found by
+    the divergence e2e — restored states differed nondeterministically
+    run to run)."""
     addressable = getattr(leaf, "addressable_shards", None)
     if addressable is None:
-        # copy=True everywhere in this function: save()'s contract is
-        # that the device→host copy happens NOW so the caller may
-        # donate immediately — but np.asarray of a CPU-backend jax
-        # array can be a ZERO-COPY view of the device buffer, and the
-        # async writer then serializes whatever the NEXT (donated)
-        # step scribbled into it: a crc-consistent garbage checkpoint
-        # (found by the divergence e2e — restored states differed
-        # nondeterministically run to run)
-        arr = np.array(leaf, copy=True)
-        full = index_key(tuple(slice(0, d) for d in arr.shape), arr.shape)
-        return {full: arr}
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            shape, dtype = tuple(leaf.shape), leaf.dtype
+        else:
+            as_np = np.asarray(leaf)
+            shape, dtype = as_np.shape, as_np.dtype
+        full = index_key(tuple(slice(0, d) for d in shape), shape)
+        return ([(full, lambda _l=leaf: np.array(_l, copy=True))],
+                est_leaf_bytes(shape, dtype))
+    jobs, est, seen = [], 0, set()
+    shape = tuple(leaf.shape)
     for sh in addressable:
         if devices is not None and sh.device not in devices:
             continue
-        key = index_key(sh.index, leaf.shape)
-        if key not in shards:
-            shards[key] = np.array(sh.data, copy=True)
-    return shards
+        key = index_key(sh.index, shape)
+        if key in seen:
+            continue
+        seen.add(key)
+        jobs.append((key, lambda _s=sh: np.array(_s.data, copy=True)))
+        sizes = [s.indices(d) for s, d in zip(sh.index, shape)]
+        est += est_leaf_bytes(
+            tuple(stop - start for start, stop, _ in sizes), leaf.dtype)
+    return jobs, est
 
 
 def required_indices(template_leaf, devices=None) -> List[str]:
@@ -365,6 +404,9 @@ class LocalTier:
         barrier: Optional[Callable[[int], None]] = None,
         sync: bool = False,
         devices=None,
+        parallel: int = 8,
+        buffer_bytes: int = 1 << 30,
+        on_phases: Optional[Callable[[int, Dict[str, float]], None]] = None,
     ):
         self.root = root
         self.host_id = int(host_id)
@@ -372,6 +414,16 @@ class LocalTier:
         self.barrier = barrier
         self.sync = sync
         self.devices = devices  # None = all of this process's devices
+        # save pipeline knobs (docs/CHECKPOINT.md "Save critical
+        # path"): snapshot-pool width (1 = serial copies, byte-
+        # identical committed output either way) and the staged-bytes
+        # cap shared between the snapshot and the background writer
+        self.parallel = max(1, int(parallel))
+        self.buffer_bytes = int(buffer_bytes)
+        # called by the WRITER thread after each successful commit with
+        # the background phase timings {"serialize": s, "commit": s} —
+        # the manager wires it into spans/gauges/goodput
+        self.on_phases = on_phases
         # created lazily on first WRITE: instantiating a tier (or a
         # peer transport / read-side probe) must not resurrect a
         # dropped host's dir as an empty husk — chaos drop_host and
@@ -381,6 +433,11 @@ class LocalTier:
         self._writer_error: Optional[BaseException] = None
         self.saves = 0
         self.commit_failures = 0
+        self.skipped_busy = 0
+        self.last_skip_reason: Optional[str] = None
+        # pipeline evidence of the LAST accepted save (gate peak/waits,
+        # snapshot seconds) — what the save bench and tests read
+        self.last_save_stats: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ paths
 
@@ -392,15 +449,38 @@ class LocalTier:
 
     # ------------------------------------------------------------ save
 
-    def save(self, step: int, tree: Any) -> bool:
+    def save(self, step: int, tree: Any, block: bool = True) -> bool:
         """Snapshot this host's shards of ``tree`` at ``step``.
 
-        The device→host copy happens NOW (so the caller may donate /
-        mutate the arrays immediately after); the disk write + commit
-        run on a background thread (double-buffered: at most one write
-        in flight — a new save first drains the previous one). Returns
-        False if the step is already committed.
+        The device→host copies happen NOW — fanned across a bounded
+        pool (``parallel``), leaf-admitted against the staged-bytes
+        gate (``buffer_bytes``) — and ALL complete before this returns,
+        so the caller may donate / mutate the arrays immediately after.
+        Serialization, crc, manifest, barrier and the atomic commit run
+        on a background writer thread that consumes the staged copies
+        leaf-by-leaf (releasing their gate bytes as each leaf lands on
+        disk) and never touches a device view.
+
+        ``block=True`` (the default, today's semantics) drains a still-
+        running previous writer first. ``block=False`` — the manager's
+        zero-stall routed path — returns False with
+        ``last_skip_reason="writer_busy"`` instead: a too-tight save
+        interval costs a counted skip, never a step stall. Returns
+        False (``"already_committed"``) if the step is committed.
         """
+        self.last_skip_reason = None
+        prev = self._writer
+        if prev is not None and prev.is_alive() and not block \
+                and self.barrier is None:
+            # zero-stall skip is only sound WITHOUT a commit barrier: a
+            # barrier-wired gang tier must participate symmetrically in
+            # every step's commit (a host that skips while a peer's
+            # writer is already blocked in barrier(step) would wedge
+            # that writer — and with it every later force/final save)
+            # — so barrier'd tiers keep the draining semantics
+            self.skipped_busy += 1
+            self.last_skip_reason = "writer_busy"
+            return False
         # drain the previous in-flight write FIRST (double buffer), so
         # the committed check sees its outcome: a force save at the
         # step the async writer is still committing must be the no-op,
@@ -408,12 +488,13 @@ class LocalTier:
         # was miscounted as a local_save_failure every final save)
         self.wait()
         if step in self.committed_steps():
+            self.last_skip_reason = "already_committed"
             return False
-        host_buffers: Dict[str, Dict[str, np.ndarray]] = {}
+        jobs = []  # (path, est_bytes, [(key, materialize), ...])
         meta: Dict[str, Dict[str, Any]] = {}
         for path, leaf in _leaf_paths(tree):
-            shards = local_shards_of(leaf, devices=self.devices)
-            host_buffers[path] = shards
+            shard_fns, est = shard_copy_jobs(leaf, devices=self.devices)
+            jobs.append((path, est, shard_fns))
             # NB: getattr with an eager np.asarray default would fetch
             # the GLOBAL array (explodes on multi-host shardings)
             if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
@@ -422,53 +503,180 @@ class LocalTier:
                 as_np = np.asarray(leaf)
                 shape, dtype = as_np.shape, as_np.dtype
             meta[path] = {"shape": list(shape), "dtype": str(dtype)}
+        from concurrent.futures import ThreadPoolExecutor
+
+        gate = InflightGate(self.buffer_bytes)
+        abort = threading.Event()
+        ready: Queue = Queue()
+        phases = {"serialize": 0.0, "commit": 0.0}
+        stats: Dict[str, Any] = {"parallel": self.parallel}
+        self.last_save_stats = stats
+        writer = threading.Thread(
+            target=self._write_pipeline,
+            args=(step, ready, meta, gate, abort, phases),
+            daemon=True,
+            name=f"ckpt-local-{self.host_id}",
+        )
+        self._writer = writer
+        writer.start()
+        pool = ThreadPoolExecutor(
+            max_workers=self.parallel,
+            thread_name_prefix=f"ckpt-snap-{self.host_id}")
+        snap0 = time.perf_counter()
+        all_futs = []
+        try:
+            for path, est, shard_fns in jobs:
+                # leaf-granular admission: with the cap below the tree
+                # size the snapshot throttles against the writer's
+                # releases — bounded host staging traded for stall,
+                # exactly what saveBufferBytes dials
+                gate.acquire(est, abort)
+                # copies DEPOSIT into a writer-owned dict and the
+                # futures resolve to None: a future that returned the
+                # array would pin every staged copy until save()
+                # dropped it at return, making the gate's cap cosmetic
+                # — with the dict, the writer's buffers.clear() after
+                # each leaf is the only liveness that matters
+                staged: Dict[str, np.ndarray] = {}
+                futs = [pool.submit(self._copy_shard, fn, staged, key,
+                                    abort)
+                        for key, fn in shard_fns]
+                all_futs.extend(futs)
+                ready.put((path, est, staged, futs))
+        finally:
+            ready.put(None)
+        # donate-after contract: EVERY copy has completed (or died)
+        # before save() returns — the writer owns only host buffers
+        err: Optional[BaseException] = None
+        for f in all_futs:
+            try:
+                f.result()
+            except BaseException as e:
+                if err is None:
+                    err = e
+                abort.set()
+        pool.shutdown(wait=True)
+        stats["snapshot_s"] = time.perf_counter() - snap0
+        stats["peak_staged_bytes"] = gate.peak
+        stats["gate_waits"] = gate.waits
+        if err is not None:
+            # the writer saw abort and dropped the partial dir. ONE
+            # failure must surface exactly once: when the WRITER died
+            # first (disk full at mkdir) the copies were aborted as a
+            # side effect — drain it and raise the root cause here
+            # instead of a contentless abort error now and the real
+            # one out of the NEXT save's wait()
+            try:
+                self.wait()
+            except BaseException as werr:
+                err = werr
+            raise err
         if self.sync:
-            self._write_and_commit(step, host_buffers, meta)
-        else:
-            t = threading.Thread(
-                target=self._write_guarded,
-                args=(step, host_buffers, meta),
-                daemon=True,
-                name=f"ckpt-local-{self.host_id}",
-            )
-            self._writer = t
-            t.start()
+            self.wait()  # deterministic tests/benches: commit, then return
         return True
 
-    def _write_guarded(self, step, host_buffers, meta) -> None:
-        try:
-            self._write_and_commit(step, host_buffers, meta)
-        except BaseException as e:  # surfaced by the next wait()/save()
-            self._writer_error = e
+    @staticmethod
+    def _copy_shard(materialize, staged: Dict[str, np.ndarray],
+                    key: str, abort: threading.Event) -> None:
+        if abort.is_set():
+            raise RuntimeError("ckpt save aborted")
+        staged[key] = materialize()
 
-    def _write_and_commit(self, step, host_buffers, meta) -> None:
-        os.makedirs(self.host_dir, exist_ok=True)
+    def _write_pipeline(self, step, ready: Queue, meta, gate, abort,
+                        phases) -> None:
+        """Background writer: staged copies → npy files + streaming crc
+        (serialize), then manifest + barrier + atomic rename + marker
+        (commit). Gate bytes are released leaf-by-leaf as buffers drop;
+        any failure drains the queue (so the snapshot side never wedges
+        in ``gate.acquire``) and removes the pending dir."""
         pending = self._pending_dir(step)
-        if os.path.exists(pending):
-            shutil.rmtree(pending, ignore_errors=True)
-        os.makedirs(pending)
         manifest: Dict[str, Any] = {
             "step": step,
             "host": self.host_id,
             "leaves": {},
         }
-        for path, shards in host_buffers.items():
-            leaf_dir = os.path.join(pending, _leaf_dirname(path))
-            os.makedirs(leaf_dir, exist_ok=True)
-            entry = dict(meta[path])
-            entry["shards"] = {}
-            for key, arr in shards.items():
-                fname = key.replace(":", "_").replace(",", "+") or "scalar"
-                fpath = os.path.join(leaf_dir, fname + ".npy")
-                with open(fpath, "wb") as f:
-                    np.save(f, arr)
-                    f.flush()
-                    os.fsync(f.fileno())
-                entry["shards"][key] = {
-                    "file": os.path.relpath(fpath, pending),
-                    "crc": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
-                }
-            manifest["leaves"][path] = entry
+        failed: Optional[BaseException] = None
+        try:
+            os.makedirs(self.host_dir, exist_ok=True)
+            if os.path.exists(pending):
+                shutil.rmtree(pending, ignore_errors=True)
+            os.makedirs(pending)
+        except BaseException as e:
+            failed = e
+            abort.set()
+        while True:
+            item = ready.get()
+            if item is None:
+                break
+            path, est, staged, futs = item
+            copies_ok = True
+            try:
+                for fut in futs:
+                    try:
+                        fut.result()  # join; arrays live in `staged`
+                    except BaseException:
+                        # snapshot-side failure: save() raises it on the
+                        # calling thread — not a writer error too
+                        abort.set()
+                        copies_ok = False
+                        break
+                if copies_ok and failed is None and not abort.is_set():
+                    t0 = time.perf_counter()
+                    self._write_leaf(pending, path, meta[path], staged,
+                                     manifest)
+                    phases["serialize"] += time.perf_counter() - t0
+            except BaseException as e:  # the WRITE died: writer-owned
+                if failed is None:
+                    failed = e
+                abort.set()
+            finally:
+                # drop the staged copies BEFORE releasing their bytes —
+                # the gate models host RAM, not queue slots (and this
+                # dict is the ONLY strong reference to the copies)
+                staged.clear()
+                gate.release(est)
+        if abort.is_set() or failed is not None:
+            shutil.rmtree(pending, ignore_errors=True)
+            if failed is not None:
+                self._writer_error = failed
+            return
+        try:
+            t0 = time.perf_counter()
+            self._commit(step, pending, manifest)
+            phases["commit"] += time.perf_counter() - t0
+        except BaseException as e:  # surfaced by the next wait()/save()
+            self._writer_error = e
+            return
+        if self.on_phases is not None:
+            try:
+                self.on_phases(step, dict(phases))
+            except Exception:
+                log.warning("ckpt save phase callback failed",
+                            exc_info=True)
+
+    def _write_leaf(self, pending, path, entry_meta, buffers,
+                    manifest) -> None:
+        leaf_dir = os.path.join(pending, _leaf_dirname(path))
+        os.makedirs(leaf_dir, exist_ok=True)
+        entry = dict(entry_meta)
+        entry["shards"] = {}
+        for key, arr in buffers.items():
+            fname = key.replace(":", "_").replace(",", "+") or "scalar"
+            fpath = os.path.join(leaf_dir, fname + ".npy")
+            with open(fpath, "wb") as f:
+                np.save(f, arr)
+                f.flush()
+                os.fsync(f.fileno())
+            entry["shards"][key] = {
+                "file": os.path.relpath(fpath, pending),
+                # streaming crc over the staged buffer — the old
+                # arr.tobytes() spelling held a SECOND full copy of
+                # every shard just to hash it (pipeline.crc32_array)
+                "crc": crc32_array(arr),
+            }
+        manifest["leaves"][path] = entry
+
+    def _commit(self, step, pending, manifest) -> None:
         mpath = os.path.join(pending, MANIFEST)
         with open(mpath, "w") as f:
             json.dump(manifest, f)
@@ -617,7 +825,9 @@ class LocalTier:
                 arr = np.load(fpath)
             except (OSError, ValueError):
                 return None
-            if (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF) != shard["crc"]:
+            # streaming verify — tobytes here doubled peak host RAM per
+            # shard on the restore path too (pipeline.crc32_array)
+            if crc32_array(arr) != shard["crc"]:
                 log.warning(
                     "local tier: crc mismatch for %s[%s] step %d host %s — "
                     "treating shard as lost",

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
@@ -63,6 +64,12 @@ class CheckpointPolicy:
     # way) and the in-flight host-bytes cap on fetched shard buffers
     restore_parallel: int = 8
     restore_inflight_mb: int = 1024
+    # save pipeline (docs/CHECKPOINT.md "Save critical path"):
+    # snapshot-pool width (1 = serial device→host copies, byte-
+    # identical committed output either way) and the cap on host bytes
+    # staged between the snapshot and the background writer
+    save_concurrency: int = 8
+    save_buffer_bytes: int = 1 << 30
 
     @classmethod
     def from_env(cls, env=None) -> "CheckpointPolicy":
@@ -90,6 +97,8 @@ class CheckpointPolicy:
             max_restore_step=max_restore,
             restore_parallel=max(1, num("KTPU_CKPT_RESTORE_PARALLEL", 8)),
             restore_inflight_mb=num("KTPU_CKPT_RESTORE_INFLIGHT_MB", 1024),
+            save_concurrency=max(1, num("KTPU_CKPT_SAVE_CONCURRENCY", 8)),
+            save_buffer_bytes=num("KTPU_CKPT_SAVE_BUFFER_BYTES", 1 << 30),
         )
 
     @property
@@ -115,7 +124,19 @@ class GoodputStats:
     local_saves: int = 0
     local_save_failures: int = 0
     persistent_saves: int = 0
+    persistent_save_failures: int = 0
+    # routed saves skipped because the previous one is still committing
+    # in the background, by reason — silent goodput loss made visible
+    # (a too-tight localIntervalSteps shows up HERE, not as a mystery
+    # gap in the committed-steps ladder)
+    save_skipped: Dict[str, int] = field(default_factory=dict)
+    # save_seconds_total is the STEP-CRITICAL-PATH wall only (the
+    # parallel device→host snapshot + routing) — what the overhead
+    # fraction prices. The background writer/committer phases land in
+    # save_phase_seconds (snapshot_s / serialize_s / commit_s), which
+    # overlap training and may sum past save_seconds_total.
     save_seconds_total: float = 0.0
+    save_phase_seconds: Dict[str, float] = field(default_factory=dict)
     loop_seconds_total: float = 0.0
     # MTTR accounting (docs/CHECKPOINT.md "Restore critical path"):
     # restart latency in SECONDS, not just lost steps — the quantity
@@ -148,6 +169,15 @@ class GoodputStats:
             "local_saves": self.local_saves,
             "local_save_failures": self.local_save_failures,
             "persistent_saves": self.persistent_saves,
+            "persistent_save_failures": self.persistent_save_failures,
+            # dict() first: the writer/committer threads add phase keys
+            # while heartbeat threads serialize this block — a plain
+            # sorted(d.items()) could observe the resize mid-iteration
+            "save_skipped": dict(self.save_skipped),
+            "save_seconds_total": round(self.save_seconds_total, 6),
+            "save_phases_s": {
+                k: round(v, 6)
+                for k, v in sorted(dict(self.save_phase_seconds).items())},
             "ckpt_overhead_fraction": round(self.overhead_fraction(), 5),
             "restore_seconds_total": round(self.restore_seconds_total, 6),
             "restore_phases_s": {
@@ -174,6 +204,19 @@ class MultiTierCheckpointManager:
         self.host_id = host_id
         self.stats = GoodputStats()
         self._loop_t0 = time.monotonic()
+        self._phase_lock = threading.Lock()
+        # background persistent committer (docs/CHECKPOINT.md "Save
+        # critical path"): ONE long-lived worker owns every orbax save
+        # — orbax's async finalize bookkeeping may only be reset by the
+        # thread that requested the previous save, so a thread-per-save
+        # committer trips `assert self._finalize_thread is None` on the
+        # second save. Routed saves hand the worker a staged host copy
+        # and return; force/non-stageable saves ride the same worker
+        # with the caller blocking on the drain.
+        self._persist_lock = threading.Lock()
+        self._persist_pending = 0
+        self._persist_q = None
+        self._persist_worker: Optional[threading.Thread] = None
         self.local: Optional[LocalTier] = None
         if policy.local_dir and policy.local_interval_steps > 0:
             self.local = LocalTier(
@@ -181,6 +224,9 @@ class MultiTierCheckpointManager:
                 host_id=host_id,
                 max_to_keep=policy.local_max_to_keep,
                 barrier=barrier,
+                parallel=policy.save_concurrency,
+                buffer_bytes=policy.save_buffer_bytes,
+                on_phases=self._note_background_phases,
             )
         self.persistent = persistent
         if self.persistent is None and policy.persistent_dir:
@@ -230,6 +276,17 @@ class MultiTierCheckpointManager:
         BOTH (the preemption-flush / final-save path must land durably
         AND be the newest local step so the restart restores it fast).
 
+        Routed (non-force) saves are ZERO-STALL (docs/CHECKPOINT.md
+        "Save critical path"): the step pays only the parallel
+        device→host snapshot — the local tier's writer and the
+        persistent tier's committer run in the background over staged
+        copies — and a save that arrives while the previous one is
+        still committing is a counted skip
+        (``ktpu_ckpt_save_skipped_total{reason}``), never a stall.
+        ``force`` keeps today's synchronous both-tiers semantics: the
+        preempt flush / final save drains the writer and commits before
+        the process may exit.
+
         ``unhealthy`` (optional callable) gates every write: evaluated
         ONLY on steps a tier would actually write (it may sync the
         device — e.g. reading the in-step health block), and a True
@@ -263,10 +320,18 @@ class MultiTierCheckpointManager:
                 # cost, never the training job — the persistent tier is
                 # the correctness floor
                 try:
-                    if self.local.save(step, state):
+                    if self.local.save(step, state, block=force):
                         self.stats.local_saves += 1
                         self._metric("CKPT_LOCAL_SAVES").inc()
                         wrote = True
+                        # optimistic for the async local writer (the
+                        # established local-tier semantics): a rare
+                        # background write failure is already surfaced
+                        # via local_save_failures
+                        self.stats.last_saved_step = max(
+                            self.stats.last_saved_step, step)
+                    elif self.local.last_skip_reason == "writer_busy":
+                        self._count_skip(step, "writer_busy")
                 except Exception as e:
                     self.stats.local_save_failures += 1
                     log.warning(
@@ -274,16 +339,190 @@ class MultiTierCheckpointManager:
                         "local tier degraded this interval",
                         step, type(e).__name__, e)
             if wants_persistent:
-                if self.persistent.save(step, state, force=force):
-                    self.stats.persistent_saves += 1
-                    wrote = True
-            if wrote:
-                self.stats.last_saved_step = max(
-                    self.stats.last_saved_step, step)
+                # NB: a STAGED persistent handoff does not advance
+                # last_saved_step here — the committer does so only
+                # when the orbax write actually lands, so the
+                # scheduler's preemption pricing never believes in a
+                # checkpoint a store outage swallowed
+                wrote = self._save_persistent(step, state, force) or wrote
         finally:
-            self.stats.save_seconds_total += time.monotonic() - t0
+            crit = time.monotonic() - t0
+            self.stats.save_seconds_total += crit
+            if wrote and not force:
+                # the snapshot phase IS the step-critical-path slice of
+                # a ROUTED save (everything else runs behind it). A
+                # force save's wall includes the drain + synchronous
+                # commits — already reported as serialize/commit by the
+                # writer — so labeling it "snapshot" would double-count
+                # the same seconds under the wrong phase; the full
+                # flush wall stays visible in save_seconds_total.
+                self._note_save_phase(step, "snapshot", crit)
             self._update_gauges()
         return wrote
+
+    def _save_persistent(self, step: int, state: Any, force: bool) -> bool:
+        """Persistent-tier leg of the routing.
+
+        With ``KTPU_SYNC_CHECKPOINT=1`` (the gloo-unsafe-thread escape
+        hatch) every save stays on the calling thread — the committer
+        worker is never spawned. Otherwise ALL orbax saves run on the
+        single committer worker (orbax's async finalize requires one
+        save thread): routed saves stage a host copy (the
+        step-critical-path slice; NB this is a WHOLE-TREE copy — the
+        same peak orbax's own async save always staged, not governed
+        by saveBufferBytes, which bounds the local tier's leaf-by-leaf
+        staging window) and return immediately; ``force``
+        (preempt flush / final save) and non-stageable states
+        (multi-host shardings — orbax's collective path must see the
+        live arrays, and the caller must not donate them mid-write)
+        ride the same worker with the caller BLOCKING until the commit
+        landed, preserving today's synchronous semantics."""
+        if os.environ.get("KTPU_SYNC_CHECKPOINT", "") == "1":
+            if self.persistent.save(step, state, force=force):
+                self.stats.persistent_saves += 1
+                self.stats.last_saved_step = max(
+                    self.stats.last_saved_step, step)
+                return True
+            return False
+        if not force and self._persist_busy():
+            self._count_skip(step, "committer_busy")
+            return False
+        staged = None
+        if not force:
+            from k8s_tpu.ckpt.pipeline import stage_tree
+
+            staged = stage_tree(state,
+                                parallel=self.policy.save_concurrency)
+        if staged is not None:
+            self._persist_enqueue(step, staged, force=False,
+                                  blocking=False)
+            # the handoff counts as a write for routing purposes (same
+            # optimism as the local tier's async writer);
+            # persistent_saves increments when the commit lands
+            return True
+        box = self._persist_enqueue(step, state, force=force,
+                                    blocking=True)
+        self._persist_drain()
+        err = box.get("err")
+        if err is not None:
+            raise err  # today's contract: a failed forced flush raises
+        if box.get("ok"):
+            self.stats.persistent_saves += 1
+            self.stats.last_saved_step = max(
+                self.stats.last_saved_step, step)
+            return True
+        return False
+
+    # ---- committer worker plumbing ------------------------------------
+
+    def _persist_busy(self) -> bool:
+        with self._persist_lock:
+            return self._persist_pending > 0
+
+    def _persist_enqueue(self, step, state, force, blocking) -> Dict:
+        from queue import Queue
+
+        with self._persist_lock:
+            if self._persist_q is None:
+                self._persist_q = Queue()
+                t = threading.Thread(
+                    target=self._persist_loop, args=(self._persist_q,),
+                    daemon=True,
+                    name=f"ckpt-persist-{self.host_id}")
+                self._persist_worker = t
+                t.start()
+            self._persist_pending += 1
+        box: Dict[str, Any] = {"blocking": blocking}
+        self._persist_q.put((step, state, force, box))
+        return box
+
+    def _persist_loop(self, q) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                q.task_done()
+                return
+            step, state, force, box = item
+            t0 = time.monotonic()
+            try:
+                ok = self.persistent.save(step, state, force=force)
+                box["ok"] = ok
+                if ok:
+                    if not box["blocking"]:
+                        # blocking callers count on their own thread
+                        self.stats.persistent_saves += 1
+                    self.stats.last_saved_step = max(
+                        self.stats.last_saved_step, step)
+                    self._note_save_phase(
+                        step, "commit", time.monotonic() - t0)
+            except BaseException as e:
+                box["err"] = e
+                if not box["blocking"]:
+                    # degraded-not-fatal, like the local tier: the
+                    # force path at preempt/final save re-writes (and
+                    # re-raises) synchronously
+                    self.stats.persistent_save_failures += 1
+                    log.warning(
+                        "background persistent checkpoint save failed "
+                        "at step %d (%s: %s); persistent tier degraded "
+                        "this interval", step, type(e).__name__, e)
+            finally:
+                with self._persist_lock:
+                    self._persist_pending -= 1
+                q.task_done()
+                self._update_gauges()
+
+    def _persist_drain(self) -> None:
+        if self._persist_q is not None:
+            self._persist_q.join()
+
+    def _persist_shutdown(self) -> None:
+        with self._persist_lock:
+            q, self._persist_q = self._persist_q, None
+            t, self._persist_worker = self._persist_worker, None
+        if q is not None:
+            q.put(None)
+        if t is not None:
+            t.join(timeout=10)
+
+    def _count_skip(self, step: int, reason: str) -> None:
+        self.stats.save_skipped[reason] = (
+            self.stats.save_skipped.get(reason, 0) + 1)
+        self._metric("CKPT_SAVE_SKIPPED").inc({"reason": reason})
+        log.warning(
+            "checkpoint save skipped at step %d (%s): the previous save "
+            "is still committing in the background; tier degraded this "
+            "interval — localIntervalSteps/persistentIntervalSteps may "
+            "be too tight for the disk/store", step, reason)
+
+    # ------------------------------------------------------------ phases
+
+    def _note_save_phase(self, step: int, phase: str, seconds: float
+                         ) -> None:
+        """One save phase → goodput accumulation + the
+        ktpu_ckpt_save_seconds gauge + a save_<phase> span on the
+        process tracer (flight recorder). Called from the step path
+        (snapshot) and from the writer/committer threads (serialize /
+        commit) — mirrors the restore-side MTTR telemetry."""
+        seconds = float(seconds)
+        with self._phase_lock:
+            key = f"{phase}_s"
+            self.stats.save_phase_seconds[key] = (
+                self.stats.save_phase_seconds.get(key, 0.0) + seconds)
+        self._metric("CKPT_SAVE_SECONDS").set(seconds, {"phase": phase})
+        from k8s_tpu.obs.trace import default_tracer
+
+        tracer = default_tracer()
+        if tracer is not None:
+            tracer.note_span(f"save_{phase}", seconds, step=step)
+
+    def _note_background_phases(self, step: int,
+                                phases: Dict[str, float]) -> None:
+        """LocalTier writer callback: the background serialize/commit
+        legs of a committed local save."""
+        for phase in ("serialize", "commit"):
+            if phase in phases:
+                self._note_save_phase(step, phase, phases[phase])
 
     def note_step(self, step: int) -> None:
         """Per-step bookkeeping (cheap): progress marker for
@@ -400,6 +639,11 @@ class MultiTierCheckpointManager:
                 self.stats.local_save_failures += 1  # not fatal
                 log.warning("local checkpoint flush failed (%s: %s)",
                             type(e).__name__, e)
+        # drain the background persistent committer (its own failures
+        # were already counted/logged on the committer thread) before
+        # orbax's wait, so "wait() returned" still means "every handed-
+        # off save is on disk or accounted as failed"
+        self._persist_drain()
         if self.persistent is not None:
             self.persistent.wait()
 
@@ -407,6 +651,7 @@ class MultiTierCheckpointManager:
         try:
             self.wait()
         finally:
+            self._persist_shutdown()
             if self.persistent is not None:
                 self.persistent.close()
 

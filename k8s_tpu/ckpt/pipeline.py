@@ -1,0 +1,157 @@
+"""Shared checkpoint-pipeline primitives: bounded staging + streaming crc.
+
+Both halves of the checkpoint data path are pipelines over the same two
+building blocks (docs/CHECKPOINT.md "Restore critical path" / "Save
+critical path"):
+
+- :class:`InflightGate` — the leaf-granular host-bytes admission gate.
+  The restore planner acquires a leaf's estimated shard bytes before its
+  fetches start and releases them once the device array is materialized;
+  the save path acquires before a leaf's device→host copies start and
+  releases once the background writer has flushed that leaf to disk and
+  dropped the buffers. Either way the cap bounds the host RAM a multi-GB
+  checkpoint can stage at once.
+- a bounded ``ThreadPoolExecutor`` fanning out the per-shard work
+  (I/O-bound reads on restore, device→host copies + nothing else on
+  save — the writer thread owns all disk I/O).
+
+:func:`crc32_array` is the shared integrity primitive: a chunked
+``zlib.crc32`` over a contiguous memoryview of the array. The old
+``zlib.crc32(arr.tobytes())`` spelling materialized a SECOND full copy
+of every shard — doubling peak host RAM per shard on the save path and
+again on the restore-verify path — for bytes that already sat
+contiguous in memory. Chunking keeps each crc call's working set small
+without ever copying the payload.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+# Default chunk for streaming crc: big enough that the Python-loop
+# overhead vanishes, small enough to stay cache-friendly.
+CRC_CHUNK_BYTES = 1 << 20
+
+
+def crc32_array(arr: np.ndarray, chunk_bytes: int = CRC_CHUNK_BYTES) -> int:
+    """crc32 of an ndarray's payload bytes WITHOUT the tobytes copy.
+
+    Identical value to ``zlib.crc32(arr.tobytes())`` for any array
+    (tobytes serializes in C order; so does the contiguous view), but
+    zero-copy for contiguous input — ``memoryview(...).cast("B")`` is a
+    view, and each ``zlib.crc32`` call reads a bounded slice of it. A
+    non-contiguous array (never produced by the save/restore paths,
+    which only handle fresh copies and ``np.load`` results) pays one
+    compaction copy and nothing else.
+    """
+    a = np.ascontiguousarray(arr)
+    mv = memoryview(a).cast("B") if a.ndim else memoryview(a.tobytes())
+    crc = 0
+    step = max(1, int(chunk_bytes))
+    for off in range(0, len(mv), step):
+        crc = zlib.crc32(mv[off:off + step], crc)
+    return crc & 0xFFFFFFFF
+
+
+class InflightGate:
+    """Bounds the host bytes a checkpoint pipeline holds at once.
+
+    Admission is LEAF-granular (the device-transfer unit): the
+    scheduler acquires a whole leaf's estimated bytes before any of its
+    per-shard work starts, and the consumer releases them when the
+    leaf's buffers are dropped. Per-shard accounting would deadlock — a
+    leaf bigger than the cap could never complete because release only
+    happens per finished leaf — so a single leaf may exceed the cap
+    alone (``inflight == 0`` always admits), and the cap bounds
+    everything beyond it. ``cap <= 0`` disables the bound (peak still
+    tracked)."""
+
+    def __init__(self, cap_bytes: int):
+        self.cap = int(cap_bytes)
+        self._cond = threading.Condition()
+        self.inflight = 0
+        self.peak = 0
+        self.waits = 0
+
+    def acquire(self, n: int, abort: threading.Event) -> None:
+        n = int(n)
+        with self._cond:
+            # n == 0 admits immediately: a leaf with no local shards
+            # (device-narrowed tiers) must not queue behind an
+            # oversized in-flight leaf just to stage zero bytes
+            if self.cap > 0 and n > 0:
+                waited = False
+                while (self.inflight > 0 and self.inflight + n > self.cap
+                       and not abort.is_set()):
+                    if not waited:
+                        waited = True
+                        self.waits += 1
+                    self._cond.wait(timeout=0.1)
+            self.inflight += n
+            self.peak = max(self.peak, self.inflight)
+
+    def release(self, n: int) -> None:
+        with self._cond:
+            self.inflight -= int(n)
+            self._cond.notify_all()
+
+
+def stage_tree(tree, parallel: int = 8):
+    """Host-staged deep copy of a pytree for a background committer.
+
+    Every array leaf is copied device→host NOW (``np.array(copy=True)``
+    — the donate-after contract: the caller may donate/mutate the live
+    arrays the moment this returns; the committer only ever sees the
+    copies), fanned across a bounded pool. Returns ``None`` when
+    staging is unsafe: a leaf that is not fully addressable (multi-host
+    sharding) cannot be host-copied by one process — those saves must
+    go through orbax's own collective path synchronously.
+    """
+    import jax
+    from concurrent.futures import ThreadPoolExecutor
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        if (hasattr(leaf, "is_fully_addressable")
+                and not leaf.is_fully_addressable):
+            return None
+
+    def copy(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return np.array(leaf, copy=True)
+        return leaf  # plain python scalar/str: immutable, pass through
+
+    if len(leaves) <= 1 or parallel <= 1:
+        copies = [copy(x) for x in leaves]
+    else:
+        with ThreadPoolExecutor(
+                max_workers=max(1, int(parallel)),
+                thread_name_prefix="ckpt-stage") as pool:
+            copies = list(pool.map(copy, leaves))
+    return jax.tree_util.tree_unflatten(treedef, copies)
+
+
+def est_leaf_bytes(shape, dtype) -> int:
+    """Host bytes a staged copy of ``shape``/``dtype`` will hold —
+    geometry only, no payload read (the admission-gate estimate)."""
+    try:
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    except TypeError:
+        itemsize = 4
+    n = 1
+    for d in shape or ():
+        n *= max(0, int(d))
+    return max(1, n) * itemsize
+
+
+__all__ = [
+    "CRC_CHUNK_BYTES",
+    "InflightGate",
+    "crc32_array",
+    "est_leaf_bytes",
+    "stage_tree",
+]

@@ -72,6 +72,7 @@ from k8s_tpu.ckpt.local import (
     required_indices,
     union_covering_plan,
 )
+from k8s_tpu.ckpt.pipeline import InflightGate
 
 log = logging.getLogger(__name__)
 
@@ -128,44 +129,10 @@ def _est_shard_bytes(leaf, key: str) -> int:
     return max(1, n) * itemsize
 
 
-class _InflightGate:
-    """Bounds the host bytes a parallel restore holds at once.
-
-    Admission is LEAF-granular (the device-transfer unit): the
-    scheduler acquires a whole leaf's estimated shard bytes before any
-    of its fetches start, and the consumer releases them after the
-    leaf's device array is materialized and the host buffers dropped.
-    Per-shard accounting would deadlock — a leaf bigger than the cap
-    could never complete because release only happens per finished
-    leaf — so a single leaf may exceed the cap alone (``inflight == 0``
-    always admits), and the cap bounds everything beyond it.
-    ``cap <= 0`` disables the bound (peak still tracked)."""
-
-    def __init__(self, cap_bytes: int):
-        self.cap = int(cap_bytes)
-        self._cond = threading.Condition()
-        self.inflight = 0
-        self.peak = 0
-        self.waits = 0
-
-    def acquire(self, n: int, abort: threading.Event) -> None:
-        n = int(n)
-        with self._cond:
-            if self.cap > 0:
-                waited = False
-                while (self.inflight > 0 and self.inflight + n > self.cap
-                       and not abort.is_set()):
-                    if not waited:
-                        waited = True
-                        self.waits += 1
-                    self._cond.wait(timeout=0.1)
-            self.inflight += n
-            self.peak = max(self.peak, self.inflight)
-
-    def release(self, n: int) -> None:
-        with self._cond:
-            self.inflight -= int(n)
-            self._cond.notify_all()
+# The leaf-granular host-bytes admission gate, shared with the save
+# pipeline since the zero-stall-save PR extracted it (ckpt/pipeline.py
+# holds the class + its deadlock-avoidance contract).
+_InflightGate = InflightGate
 
 
 @dataclass

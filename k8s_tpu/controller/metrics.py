@@ -203,6 +203,20 @@ CKPT_RESTORE_SECONDS = REGISTRY.gauge(
     "/ total; compile = the first post-restore step incl. XLA compile) "
     "— the MTTR breakdown, docs/CHECKPOINT.md 'Restore critical path'",
 )
+CKPT_SAVE_SECONDS = REGISTRY.gauge(
+    "ktpu_ckpt_save_seconds",
+    "Wall seconds of the last save, by phase (snapshot = the step-"
+    "critical-path parallel device-to-host staging; serialize / commit "
+    "= the background writer/committer legs, which overlap training) — "
+    "docs/CHECKPOINT.md 'Save critical path'",
+)
+CKPT_SAVE_SKIPPED = REGISTRY.counter(
+    "ktpu_ckpt_save_skipped_total",
+    "Routed checkpoint saves skipped because the previous save was "
+    "still committing in the background, by reason (writer_busy = "
+    "local tier, committer_busy = persistent tier) — the visible cost "
+    "of a save interval tighter than the disk/store can drain",
+)
 # Serving fleet (k8s_tpu/router, docs/SERVING.md "Fleet"). Registered
 # process-global like the ckpt series: the router program's /metrics
 # and any operator health port expose them without new plumbing.

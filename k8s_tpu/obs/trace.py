@@ -367,10 +367,13 @@ class Tracer:
 
     def note_span(self, name: str, wall_s: float, **attrs) -> None:
         """Record an externally-timed span — phases a subsystem measures
-        itself (the checkpoint manager's restore_plan/fetch/device
-        breakdown, the program's first-step compile) and reports after
-        the fact. Same record shape as :meth:`span`, so the flight
-        recorder and /debug/flightrecorder render both identically."""
+        itself (the checkpoint manager's restore_plan/fetch/device and
+        save_snapshot/serialize/commit breakdowns, the program's
+        first-step compile) and reports after the fact — possibly from
+        a background thread (the save writer/committer call in here;
+        the recorder is lock-protected). Same record shape as
+        :meth:`span`, so the flight recorder and /debug/flightrecorder
+        render both identically."""
         if not self.enabled:
             return
         self._record_span(name, float(wall_s), attrs)

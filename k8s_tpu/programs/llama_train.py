@@ -443,11 +443,17 @@ def main(rdzv) -> None:
                                unhealthy=unhealthy_now)
             if multi_tier:
                 # the manager routes: local tier every
-                # localIntervalSteps (cheap device→host + node-local
-                # write), persistent tier every persistentIntervalSteps
-                # — and owns the never-checkpoint-a-poisoned-state gate
-                # (the callable syncs the device only on steps a tier
-                # would actually write)
+                # localIntervalSteps, persistent tier every
+                # persistentIntervalSteps — and owns the never-
+                # checkpoint-a-poisoned-state gate (the callable syncs
+                # the device only on steps a tier would actually
+                # write). The ckpt_save phase measures ONLY the step-
+                # critical-path slice — the parallel device→host
+                # snapshot; serialization/crc/commit run behind it on
+                # the writer/committer threads and surface as the
+                # save_serialize/save_commit spans + the
+                # ktpu_ckpt_save_seconds gauge (docs/CHECKPOINT.md
+                # "Save critical path")
                 with st.phase("ckpt_save"):
                     mgr.save(step, state, unhealthy=unhealthy_now)
                 mgr.note_step(step)

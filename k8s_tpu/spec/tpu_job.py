@@ -212,8 +212,13 @@ class CheckpointPolicySpec(K8sObject):
     results either way) and ``restoreInflightMb`` caps the host bytes
     of fetched-but-not-yet-device-resident shards, so a multi-GB
     restore streams instead of ballooning host RAM (docs/CHECKPOINT.md
-    "Restore critical path"). The whole block flows operator →
-    kubelet env (``KTPU_CKPT_*``) → launcher → training program."""
+    "Restore critical path"). ``saveConcurrency`` is the save
+    pipeline's device→host snapshot-pool width (1 = serial copies,
+    byte-identical committed output either way) and ``saveBufferBytes``
+    caps the host bytes staged between the snapshot and the background
+    writer (0 = uncapped; docs/CHECKPOINT.md "Save critical path").
+    The whole block flows operator → kubelet env (``KTPU_CKPT_*``) →
+    launcher → training program."""
 
     local_dir: str = ""
     local_interval_steps: int = 0
@@ -224,6 +229,8 @@ class CheckpointPolicySpec(K8sObject):
     peer_port: int = 0
     restore_parallel: int = 8
     restore_inflight_mb: int = 1024
+    save_concurrency: int = 8
+    save_buffer_bytes: int = 1 << 30
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -249,6 +256,13 @@ class CheckpointPolicySpec(K8sObject):
             raise ValidationError(
                 "checkpointPolicy: restoreInflightMb must be >= 0 "
                 "(0 disables the in-flight-bytes cap)")
+        if self.save_concurrency < 1:
+            raise ValidationError(
+                "checkpointPolicy: saveConcurrency must be >= 1")
+        if self.save_buffer_bytes < 0:
+            raise ValidationError(
+                "checkpointPolicy: saveBufferBytes must be >= 0 "
+                "(0 disables the staged-bytes cap)")
         if (
             self.persistent_interval_steps > 0
             and self.local_interval_steps > self.persistent_interval_steps
@@ -274,6 +288,8 @@ class CheckpointPolicySpec(K8sObject):
             env["KTPU_CKPT_PEER_PORT"] = str(self.peer_port)
         env["KTPU_CKPT_RESTORE_PARALLEL"] = str(self.restore_parallel)
         env["KTPU_CKPT_RESTORE_INFLIGHT_MB"] = str(self.restore_inflight_mb)
+        env["KTPU_CKPT_SAVE_CONCURRENCY"] = str(self.save_concurrency)
+        env["KTPU_CKPT_SAVE_BUFFER_BYTES"] = str(self.save_buffer_bytes)
         return env
 
 

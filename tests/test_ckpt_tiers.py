@@ -911,6 +911,449 @@ class TestParallelRestore:
                 restore_inflight_mb=-1).validate()
 
 
+class TestPipelinedSave:
+    """The zero-stall save path (ISSUE 15, docs/CHECKPOINT.md "Save
+    critical path"): parallel snapshot ≡ serial committed bytes, the
+    donate-after contract under snapshot/commit overlap, streaming crc
+    without the tobytes double-copy, bounded host staging, counted
+    busy-skips, the background persistent committer, and the
+    saveConcurrency/saveBufferBytes spec→env→policy round trip."""
+
+    def test_serial_and_pipelined_saves_byte_identical(self, tmp_path):
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=3.0)
+        serial = LocalTier(str(tmp_path / "serial"), host_id=0,
+                           sync=True, parallel=1)
+        pipelined = LocalTier(str(tmp_path / "pipe"), host_id=0,
+                              sync=True, parallel=8)
+        assert serial.save(5, tree) is True
+        assert pipelined.save(5, tree) is True
+        ms, mp = serial.manifest(5), pipelined.manifest(5)
+        assert ms is not None and ms["leaves"] == mp["leaves"]
+        # crc vocabulary unchanged too: the streaming crc must equal
+        # the historical tobytes spelling bit for bit
+        import zlib
+
+        for path, entry in ms["leaves"].items():
+            for key in entry["shards"]:
+                arr = pipelined.read_shard(5, path, key)
+                assert arr is not None
+                assert entry["shards"][key]["crc"] == (
+                    zlib.crc32(arr.tobytes()) & 0xFFFFFFFF)
+
+    def test_streaming_crc_is_zero_copy_on_large_shard(self):
+        """Satellite: crc32_array must match zlib.crc32(tobytes) yet
+        never materialize the tobytes copy — proven by hashing an
+        array whose tobytes is booby-trapped, at a size where the old
+        spelling would have doubled peak host RAM."""
+        import zlib
+
+        from k8s_tpu.ckpt.pipeline import crc32_array
+
+        big = np.arange(8 << 20, dtype=np.float32)  # a 32 MB shard
+        assert crc32_array(big) == zlib.crc32(big.tobytes()) & 0xFFFFFFFF
+
+        class _NoCopy(np.ndarray):
+            def tobytes(self, *a, **kw):  # pragma: no cover - trap
+                raise AssertionError(
+                    "crc32_array must not copy via tobytes")
+
+        trapped = big.view(_NoCopy)
+        assert crc32_array(trapped) == crc32_array(big)
+        # non-contiguous input (never produced by the save/restore
+        # paths) still hashes correctly via one compaction copy
+        strided = np.arange(64, dtype=np.float32)[::2]
+        assert crc32_array(strided) == (
+            zlib.crc32(np.ascontiguousarray(strided).tobytes())
+            & 0xFFFFFFFF)
+        # scalars (0-d) round-trip too
+        assert crc32_array(np.float32(3.5)) == (
+            zlib.crc32(np.float32(3.5).tobytes()) & 0xFFFFFFFF)
+
+    def test_donated_scribble_during_inflight_commit_is_invisible(
+            self, tmp_path):
+        """Satellite (the PR 9 ``np.asarray`` regression re-armed
+        against the pool): a train step that scribbles the device/host
+        buffers AFTER save() returned but BEFORE the background writer
+        serialized them must not reach the checkpoint — the staged
+        copies, not the live buffers, are what hits disk."""
+        import threading
+        import time as _time
+
+        mesh = small_mesh()
+        jtree = make_tree(mesh, scale=2.0)
+        host_leaf = np.arange(32, dtype=np.float32)
+        tree = {**jtree, "host": host_leaf}
+        expect = {k: np.array(np.asarray(v), copy=True)
+                  for k, v in tree.items()}
+        tier = LocalTier(str(tmp_path), host_id=0)
+        serialized = threading.Event()
+        orig_write_leaf = tier._write_leaf
+
+        def slow_write_leaf(*a, **kw):
+            # hold serialization until the scribble landed: the bytes
+            # written MUST be the staged copies
+            serialized.wait(timeout=5)
+            return orig_write_leaf(*a, **kw)
+
+        tier._write_leaf = slow_write_leaf
+        assert tier.save(3, tree) is True  # copies done at return
+        # scribble every buffer the save read: in-place host mutation
+        # (what a zero-copy np.asarray view would leak) + a donated
+        # jitted step over the jax leaves (the real train-loop shape)
+        host_leaf[:] = -777.0
+        donate = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda x: x * 0 - 7, t),
+            donate_argnums=0)
+        _ = donate(jtree)
+        serialized.set()
+        tier.wait()
+        _time.sleep(0)  # writer finished inside wait()
+        assert tier.committed_steps() == [3]
+        man = tier.manifest(3)
+        for path, entry in man["leaves"].items():
+            for key in entry["shards"]:
+                arr = tier.read_shard(3, path, key)  # crc-verified
+                assert arr is not None, (path, key)
+                ref = np.asarray(expect[path])
+                box = [slice(int(p.split(":")[0]), int(p.split(":")[1]))
+                       for p in key.split(",")] if key != "-" else ()
+                assert np.array_equal(arr, ref[tuple(box)]), (path, key)
+
+    def test_writer_first_failure_surfaces_once_with_root_cause(
+            self, tmp_path):
+        """A writer that dies before the copies finish (disk full at
+        the pending mkdir) aborts the snapshot as a side effect —
+        save() must raise the ROOT CAUSE exactly once, not a
+        contentless abort error now plus the real one out of the NEXT
+        save's wait() (which double-counted local_save_failures for
+        one disk event)."""
+        import time as _time
+
+        class _SlowLeaf:
+            shape = (8,)
+            dtype = np.float32
+
+            class _Shard:
+                index = (slice(0, 8),)
+                device = None
+
+                @property
+                def data(self):
+                    _time.sleep(0.2)
+                    return np.arange(8, dtype=np.float32)
+
+            addressable_shards = [_Shard()]
+
+        # a FILE where host-0's dir must go: the writer's makedirs
+        # fails immediately, long before the throttled copies land
+        open(tmp_path / "host-0", "w").close()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.parallel = 1
+        tree = {"a": _SlowLeaf(), "b": _SlowLeaf()}
+        mgr.save(1, tree)  # degraded, not fatal
+        assert mgr.goodput()["local_save_failures"] == 1
+        # the failure was fully drained: the next save sees a clean
+        # writer (and fails again on its own mkdir — one count each)
+        mgr.save(2, tree)
+        assert mgr.goodput()["local_save_failures"] == 2
+        mgr.close()
+
+    def test_staged_bytes_gate_bounds_host_ram(self, tmp_path):
+        leaves = {
+            f"l{i}": np.arange(1024, dtype=np.float32) + i
+            for i in range(8)
+        }  # 4 KiB per leaf
+        cap = 2 * 4096 + 64
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True,
+                         parallel=4, buffer_bytes=cap)
+        assert tier.save(2, leaves) is True
+        stats = tier.last_save_stats
+        assert stats["peak_staged_bytes"] <= cap, stats
+        assert stats["gate_waits"] > 0, stats
+        # uncapped control run stages (nearly) everything at once
+        tier2 = LocalTier(str(tmp_path / "u"), host_id=0, sync=True,
+                          parallel=4, buffer_bytes=0)
+        assert tier2.save(2, leaves) is True
+        assert tier2.last_save_stats["peak_staged_bytes"] > cap
+        # the capped checkpoint is intact
+        for path in leaves:
+            man = tier.manifest(2)
+            key = next(iter(man["leaves"][path]["shards"]))
+            assert np.array_equal(tier.read_shard(2, path, key),
+                                  leaves[path])
+
+    def test_staged_copies_are_actually_freed_under_the_cap(
+            self, tmp_path):
+        """The gate's accounting must match real liveness: nothing —
+        futures included — may pin a leaf's staged copy after the
+        writer dropped it, or the cap is cosmetic and a multi-GB save
+        OOMs the host anyway. Measured with tracemalloc over a tree 8x
+        the cap: real peak must stay well under the tree size."""
+        import tracemalloc
+
+        n = 1 << 18  # 1 MiB per leaf
+        leaves = {f"l{i:02d}": np.arange(n, dtype=np.float32) + i
+                  for i in range(16)}  # 16 MiB tree
+        cap = 2 * n * 4 + 64  # 2-leaf staging window
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True,
+                         parallel=4, buffer_bytes=cap)
+        tracemalloc.start()
+        try:
+            before, _ = tracemalloc.get_traced_memory()
+            assert tier.save(2, leaves) is True
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        staged_peak = peak - before
+        # half the tree is a generous bound (the cap window is 2/16);
+        # a future-pinned implementation peaks at the WHOLE tree
+        assert staged_peak < 8 * n * 4, (
+            f"staged copies not freed under the cap: real peak "
+            f"{staged_peak} bytes vs 16-leaf tree {16 * n * 4}")
+        assert tier.committed_steps() == [2]
+
+    def test_zero_stall_busy_skip_is_counted_and_warned(
+            self, tmp_path, caplog):
+        """Satellite: a routed save that finds the writer still
+        committing is a COUNTED skip (ktpu_ckpt_save_skipped_total +
+        goodput + the degraded-interval warning), never a stall —
+        and force= keeps the draining semantics."""
+        import logging
+        import threading
+        import time as _time
+
+        from k8s_tpu.controller import metrics as M
+
+        mesh = small_mesh()
+        release = threading.Event()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        # park the background writer in its serialize leg (no barrier:
+        # a barrier-wired gang tier deliberately DRAINS instead of
+        # skipping — see the asymmetric-barrier-participation test)
+        orig_write_leaf = mgr.local._write_leaf
+
+        def slow_write_leaf(*a, **kw):
+            release.wait(timeout=5)
+            return orig_write_leaf(*a, **kw)
+
+        mgr.local._write_leaf = slow_write_leaf
+        skipped_before = M.CKPT_SAVE_SKIPPED.get({"reason": "writer_busy"})
+        try:
+            assert mgr.save(1, make_tree(mesh)) is True  # writer parked
+            t0 = _time.perf_counter()
+            with caplog.at_level(logging.WARNING, "k8s_tpu.ckpt.manager"):
+                assert mgr.save(2, make_tree(mesh, scale=2.0)) is False
+            stall = _time.perf_counter() - t0
+            assert stall < 1.0  # zero-stall: no drain on the step path
+            assert mgr.stats.save_skipped == {"writer_busy": 1}
+            assert M.CKPT_SAVE_SKIPPED.get(
+                {"reason": "writer_busy"}) == skipped_before + 1
+            assert any("skipped" in r.message and "writer_busy" in r.message
+                       for r in caplog.records), caplog.records
+            assert mgr.goodput()["save_skipped"] == {"writer_busy": 1}
+        finally:
+            release.set()
+        mgr.wait()
+        assert mgr.local.committed_steps() == [1]
+        # force= drains instead of skipping (the preempt-flush contract)
+        release.clear()
+        park = threading.Thread(
+            target=lambda: mgr.save(3, make_tree(mesh, scale=3.0)))
+        park.start()
+        park.join()
+        release.set()  # let step 3 commit; force save 4 drains it first
+        assert mgr.save(4, make_tree(mesh, scale=4.0), force=True) is True
+        mgr.wait()
+        assert mgr.local.committed_steps()[-1] == 4
+        assert 3 in mgr.local.committed_steps()
+        mgr.close()
+
+    def test_barrier_wired_tier_never_busy_skips(self, tmp_path):
+        """A tier with a commit BARRIER must keep draining semantics
+        even on block=False: a host that skipped a step while a peer's
+        writer was already blocked in barrier(step) would wedge that
+        writer — and with it every later force/final save — so
+        zero-stall skipping is only sound barrier-less."""
+        import threading
+        import time as _time
+
+        mesh = small_mesh()
+        release = threading.Event()
+        tier = LocalTier(str(tmp_path), host_id=0,
+                         barrier=lambda step: release.wait(timeout=5))
+        assert tier.save(1, make_tree(mesh)) is True  # parked in barrier
+        done = []
+
+        def second():
+            done.append(tier.save(2, make_tree(mesh, scale=2.0),
+                                  block=False))
+
+        t = threading.Thread(target=second)
+        t.start()
+        _time.sleep(0.15)
+        assert not done, "barrier'd tier must DRAIN, not skip"
+        release.set()
+        t.join(timeout=5)
+        assert done == [True] and tier.skipped_busy == 0
+        tier.wait()
+        assert tier.committed_steps() == [1, 2]
+
+    def test_persistent_background_committer_and_busy_skip(
+            self, tmp_path):
+        """Routed persistent saves stage + commit off the step path; a
+        still-running committer skips (counted); force stays
+        synchronous. Uses a latency-injected stand-in manager so the
+        stall/skip timing is deterministic."""
+        import time as _time
+
+        mesh = small_mesh()
+
+        class SlowPersistent:
+            def __init__(self):
+                self.saved = []
+
+            def save(self, step, state, force=False, unhealthy=None):
+                _time.sleep(0.4)
+                self.saved.append((step, force))
+                return True
+
+            def latest_step(self):
+                return max((s for s, _ in self.saved), default=None)
+
+            def wait(self):
+                pass
+
+            def close(self):
+                pass
+
+        policy = CheckpointPolicy(persistent_dir="stand-in",
+                                  persistent_interval_steps=1)
+        slow = SlowPersistent()
+        mgr = MultiTierCheckpointManager(policy, host_id=0,
+                                         persistent=slow)
+        t0 = _time.perf_counter()
+        assert mgr.save(1, make_tree(mesh)) is True
+        crit = _time.perf_counter() - t0
+        assert crit < 0.3, crit  # the 0.4s store write is OFF the path
+        # a staged handoff must NOT advance last_saved_step until the
+        # commit actually lands — the scheduler prices preemptions off
+        # it, and a store outage must not look like a durable save
+        assert mgr.stats.last_saved_step == -1
+        assert mgr.save(2, make_tree(mesh, scale=2.0)) is False
+        assert mgr.stats.save_skipped == {"committer_busy": 1}
+        mgr.wait()
+        assert slow.saved == [(1, False)]
+        assert mgr.goodput()["persistent_saves"] == 1
+        assert mgr.stats.last_saved_step == 1  # committed, now counted
+        # force: synchronous on the calling thread, committer drained
+        assert mgr.save(3, make_tree(mesh, scale=3.0), force=True)
+        assert slow.saved[-1] == (3, True)
+        mgr.close()
+
+    def test_persistent_background_committer_real_orbax_roundtrip(
+            self, tmp_path):
+        """The staged numpy tree a background committer hands orbax
+        must restore bit-identically into the sharded template."""
+        mesh = small_mesh()
+        tree = make_tree(mesh, scale=9.0)
+        policy = CheckpointPolicy(
+            persistent_dir=str(tmp_path / "persist"),
+            persistent_interval_steps=2)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        assert mgr.save(2, tree) is True
+        mgr.wait()
+        assert mgr.persistent.latest_step() == 2
+        restored = mgr.restore(template_of(tree))
+        assert restored is not None
+        assert_tree_equal(restored, tree)
+        mgr.close()
+
+    def test_sync_checkpoint_env_keeps_persistent_on_step_path(
+            self, tmp_path, monkeypatch):
+        """KTPU_SYNC_CHECKPOINT=1 (the gloo-unsafe-thread escape hatch)
+        must keep routed persistent saves synchronous — no background
+        committer thread at all."""
+        mesh = small_mesh()
+        monkeypatch.setenv("KTPU_SYNC_CHECKPOINT", "1")
+        policy = CheckpointPolicy(
+            persistent_dir=str(tmp_path / "persist"),
+            persistent_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        assert mgr.save(1, make_tree(mesh)) is True
+        assert mgr._persist_worker is None  # never spawned
+        assert mgr.persistent.latest_step() == 1
+        mgr.close()
+
+    def test_save_phase_goodput_metrics_and_spans(self, tmp_path,
+                                                  capsys):
+        """Save-side MTTR-mirror telemetry end to end in-process:
+        goodput carries save_seconds_total + the snapshot/serialize/
+        commit phase breakdown, the ktpu_ckpt_save_seconds gauge is set
+        per phase, and the save_* spans land in the default tracer's
+        flight recorder — the exact restore-side contract, on the save
+        half (docs/CHECKPOINT.md "Save critical path")."""
+        from k8s_tpu.controller import metrics as M
+        from k8s_tpu.obs.trace import Tracer, set_default_tracer
+
+        mesh = small_mesh()
+        policy = CheckpointPolicy(
+            local_dir=str(tmp_path), local_interval_steps=1)
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        mgr.local.sync = True
+        tracer = Tracer(trace_id="t-save", task="worker-0")
+        set_default_tracer(tracer)
+        try:
+            assert mgr.save(3, make_tree(mesh)) is True
+        finally:
+            set_default_tracer(None)
+        g = mgr.goodput()
+        assert g["save_seconds_total"] > 0, g
+        assert set(g["save_phases_s"]) == {
+            "snapshot_s", "serialize_s", "commit_s"}, g
+        assert g["ckpt_overhead_fraction"] >= 0.0
+        for phase in ("snapshot", "serialize", "commit"):
+            assert ({"phase": phase} in
+                    [dict(k) for k in M.CKPT_SAVE_SECONDS.values]), phase
+        spans = {e["name"] for e in tracer.recorder.snapshot()
+                 if e.get("kind") == "span"}
+        assert {"save_snapshot", "save_serialize",
+                "save_commit"} <= spans, spans
+        mgr.close()
+
+    def test_save_knobs_env_roundtrip(self, tmp_path):
+        """saveConcurrency / saveBufferBytes flow spec → env → policy
+        → tier, like every other checkpointPolicy knob."""
+        from k8s_tpu.spec import CheckpointPolicySpec, ValidationError
+
+        spec = CheckpointPolicySpec(
+            local_dir=str(tmp_path), local_interval_steps=2,
+            save_concurrency=3, save_buffer_bytes=12345)
+        spec.validate()
+        env = spec.to_env()
+        assert env["KTPU_CKPT_SAVE_CONCURRENCY"] == "3"
+        assert env["KTPU_CKPT_SAVE_BUFFER_BYTES"] == "12345"
+        policy = CheckpointPolicy.from_env(env)
+        assert policy.save_concurrency == 3
+        assert policy.save_buffer_bytes == 12345
+        mgr = MultiTierCheckpointManager(policy, host_id=0)
+        assert mgr.local.parallel == 3
+        assert mgr.local.buffer_bytes == 12345
+        mgr.close()
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(
+                local_dir="/x", local_interval_steps=2,
+                save_concurrency=0).validate()
+        with pytest.raises(ValidationError):
+            CheckpointPolicySpec(
+                local_dir="/x", local_interval_steps=2,
+                save_buffer_bytes=-1).validate()
+
+
 class TestCompileCacheContract:
     def test_training_spec_env_and_launcher_roundtrip(self):
         """compileCacheDir rides the same spec→env→launcher contract
@@ -1192,6 +1635,9 @@ class TestOperatorEnvFlow:
         assert env["KTPU_CKPT_PERSIST_EVERY"] == "50"
         assert env["KTPU_CKPT_PEER_FETCH"] == "1"
         assert env["KTPU_CKPT_PEER_PORT"] == "8900"
+        # the zero-stall save knobs ride the same injection (defaults)
+        assert env["KTPU_CKPT_SAVE_CONCURRENCY"] == "8"
+        assert env["KTPU_CKPT_SAVE_BUFFER_BYTES"] == str(1 << 30)
         # peers: every worker's per-index Service DNS on the shard port
         peers = dict(
             p.split("=", 1) for p in env["KTPU_CKPT_PEERS"].split(","))

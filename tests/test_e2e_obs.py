@@ -344,6 +344,13 @@ def test_nan_divergence_restores_and_succeeds(tmp_path):
     jc.create(j)
     tj = TrainingJob(client, jc, j)
 
+    # every ckpt goodput block seen on the live heartbeats — the save
+    # phase split must ride the same surface the scheduler prices from
+    hb_ckpt_blocks = []
+    # live /metrics evidence that the save-phase gauge is exported by
+    # the worker processes (sampled alongside the heartbeat sweep)
+    save_gauge_seen = []
+
     def fetch():
         rid = tj.job.spec.runtime_id
         if not rid:
@@ -359,6 +366,15 @@ def test_nan_divergence_restores_and_succeeds(tmp_path):
                 hb = payload.get("obs")
                 if isinstance(hb, dict):
                     out[i] = hb
+                if isinstance(payload.get("ckpt"), dict):
+                    hb_ckpt_blocks.append(payload["ckpt"])
+                if not save_gauge_seen:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=2) as r:
+                        if 'ktpu_ckpt_save_seconds{phase="snapshot"}' \
+                                in r.read().decode():
+                            save_gauge_seen.append(i)
             except Exception:
                 pass
         return out or None
@@ -430,6 +446,24 @@ def test_nan_divergence_restores_and_succeeds(tmp_path):
         assert goodputs and any(
             g.get("restore_seconds_total", 0) > 0
             for g in goodputs), goodputs
+        # ...and the zero-stall save telemetry (ISSUE 15): the save
+        # critical path is measured, with the snapshot/serialize/commit
+        # phase split in the final goodput report AND on the live
+        # heartbeats the reconciler/scheduler read
+        assert any(
+            g.get("save_seconds_total", 0) > 0
+            and g.get("save_phases_s", {}).get("snapshot_s", 0) > 0
+            and "serialize_s" in g.get("save_phases_s", {})
+            and "commit_s" in g.get("save_phases_s", {})
+            for g in goodputs), goodputs
+        assert any(
+            b.get("save_phases_s", {}).get("snapshot_s", 0) > 0
+            for b in hb_ckpt_blocks), (
+            "no heartbeat carried the save phase split",
+            hb_ckpt_blocks[-3:])
+        assert save_gauge_seen, (
+            "ktpu_ckpt_save_seconds{phase=snapshot} never appeared on a "
+            "live worker /metrics endpoint")
         # step_health events bracket the divergence: a non-finite block
         # at/after the NaN step, healthy blocks after the restore, and
         # the final step completed
