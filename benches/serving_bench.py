@@ -404,6 +404,167 @@ def _run_disagg(args) -> int:
     return 0
 
 
+def _run_drain(args) -> int:
+    """``--drain``: A/B one mid-run decode-replica removal under the
+    SAME in-flight adversarial workload: the operator drain path (live
+    KV migration of every in-flight slot to a peer, then DRAINING) vs
+    the crash ladder (replica killed, interrupted requests recover by
+    re-prefill). Reported: tail ITL p95/p99 for each arm plus the
+    recomputed-prefill-token bill — the drain path is ASSERTED to
+    recompute zero prefill tokens, while the crash arm re-pays every
+    interrupted request's full prompt. A no-event pass supplies the
+    prefill-cost baseline and the token oracle (all three arms must
+    emit identical tokens — the stand-ins are deterministic, so any
+    divergence is a migration/handoff bug, not pacing noise)."""
+    import threading as th
+
+    from k8s_tpu.router import LocalFleet, StandinEngine
+
+    n_total = args.fleet
+    n_prefill = args.disagg_prefill
+    if not 1 <= n_prefill < n_total - 1:
+        raise SystemExit(
+            f"--disagg-prefill {n_prefill} must leave >=2 decode "
+            f"replicas within --fleet {n_total} (the drained slots "
+            "need a surviving decode peer to land on)")
+    rng = np.random.RandomState(0)
+    n_req = args.requests
+    vocab = 4093
+    long_len = (args.long_prompt if args.long_prompt
+                else 4 * args.max_prompt)
+    plens = rng.randint(2, args.max_prompt + 1, size=n_req)
+    is_long = rng.rand(n_req) < args.long_frac
+    plens[is_long] = long_len
+    news = rng.randint(max(1, args.max_new // 2), args.max_new + 1,
+                       size=n_req)
+    prompts = [rng.randint(0, vocab, size=n).astype(np.int32)
+               for n in plens]
+    if args.arrival_rate > 0:
+        gaps = rng.exponential(1.0 / args.arrival_rate, size=n_req)
+        arrivals = np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+    else:
+        arrivals = np.zeros(n_req)
+    roles = (["prefill"] * n_prefill
+             + ["decode"] * (n_total - n_prefill))
+    victim = n_prefill  # first decode replica
+
+    def build_engines():
+        return [StandinEngine(
+            max_slots=args.slots, decode_chunk=args.decode_chunk,
+            round_wall_s=args.fleet_round_wall,
+            prefill_chunk=args.prefill_chunk, vocab=vocab,
+            prefill_wall_factor=1.0)
+            for _ in range(n_total)]
+
+    def wait_victim_busy(fleet, timeout=30.0):
+        """Block until the victim holds a mid-decode slot, so the
+        removal really interrupts streams instead of an idle pod."""
+        eng = fleet.engines[victim]
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            with eng._lock:
+                busy = any(
+                    r is not None and not r.done and r.tokens
+                    and r.prefill_remaining == 0
+                    for r in eng._slots)
+            if busy:
+                return True
+            time.sleep(0.002)
+        return False
+
+    def run(mode):  # "baseline" | "migrate" | "reprefill"
+        fleet = LocalFleet(
+            build_engines(), roles=roles,
+            migration=(mode == "migrate"), mirror_interval=0.05,
+        ).start()
+        results = [None] * n_req
+        summary = {}
+        t0 = time.perf_counter()
+
+        def one(i):
+            dt = t0 + arrivals[i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            code, body = fleet.generate(prompts[i], int(news[i]))
+            results[i] = (code, body)
+
+        threads = [th.Thread(target=one, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        if mode != "baseline":
+            wait_victim_busy(fleet)
+            if mode == "migrate":
+                summary = fleet.router.drain_replica(victim)
+            else:
+                fleet.kill_replica(victim)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        codes = [r[0] for r in results]
+        assert codes == [200] * n_req, codes
+        useful = sum(len(r[1]["tokens"]) for r in results)
+        itl = np.sort(np.asarray(
+            [r[1].get("itl_ms") or 0.0 for r in results]))
+        prefill_tokens = sum(
+            e.stats["prefill_tokens"] for e in fleet.engines)
+        migrations = dict(fleet.router.migrations)
+        fleet.stop()
+        return {
+            "tokens_per_sec": round(useful / wall, 1),
+            "itl_p50_ms": round(float(itl[int(0.5 * (n_req - 1))]), 2),
+            "itl_p95_ms": round(float(itl[int(0.95 * (n_req - 1))]), 2),
+            "itl_p99_ms": round(float(itl[int(0.99 * (n_req - 1))]), 2),
+            "prefill_tokens": int(prefill_tokens),
+            "migrated": int(summary.get("migrated", 0)),
+            "migrations": migrations,
+            "tokens": [r[1]["tokens"] for r in results],
+        }
+
+    base = run("baseline")
+    mig = run("migrate")
+    rep = run("reprefill")
+    assert mig["tokens"] == base["tokens"], \
+        "migration arm tokens diverged from the no-event oracle"
+    assert rep["tokens"] == base["tokens"], \
+        "re-prefill arm tokens diverged from the no-event oracle"
+    # prefill_tokens is exactly sum(plen) per pass (the stand-in pays
+    # unpadded chunk tokens), so the delta vs the no-event pass IS the
+    # re-prefill bill
+    mig_recomputed = mig["prefill_tokens"] - base["prefill_tokens"]
+    rep_recomputed = rep["prefill_tokens"] - base["prefill_tokens"]
+    assert mig_recomputed == 0, (
+        f"drain path recomputed {mig_recomputed} prefill tokens "
+        "(live migration must not re-prefill)")
+    result = {
+        "metric": "serving_drain_itl_p99_ms",
+        "value": mig["itl_p99_ms"],
+        "unit": "ms (lower is better)",
+        "fleet": n_total,
+        "prefill_replicas": n_prefill,
+        "decode_replicas": n_total - n_prefill,
+        "requests": n_req,
+        "long_frac": args.long_frac,
+        "arrival_rate": args.arrival_rate,
+        "round_wall_s": args.fleet_round_wall,
+        "drained_replica": victim,
+        "migrated": mig["migrated"],
+        "drain_migrations": mig["migrations"].get("drain", 0),
+        "recomputed_prefill_tokens": int(mig_recomputed),
+        "reprefill_recomputed_prefill_tokens": int(rep_recomputed),
+        "itl_p99_win": round(
+            rep["itl_p99_ms"] / max(1e-9, mig["itl_p99_ms"]), 2),
+        "tokens_identical": True,
+    }
+    for k in ("tokens_per_sec", "itl_p50_ms", "itl_p95_ms",
+              "itl_p99_ms"):
+        result[k] = mig[k]
+        result[f"reprefill_{k}"] = rep[k]
+        result[f"baseline_{k}"] = base[k]
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serving-bench")
     # None = per-platform default (full 705M workload on accelerator,
@@ -476,6 +637,12 @@ def main(argv=None) -> int:
                    help="prefill-pool size for --disagg (default: "
                         "fleet // 2, min 1 — pools sized to the 25% "
                         "long-prompt mix's prefill share)")
+    p.add_argument("--drain", action="store_true",
+                   help="A/B one mid-run decode-replica removal: "
+                        "operator drain (live KV migration) vs crash/"
+                        "re-prefill; reports tail ITL p95/p99 and the "
+                        "recomputed-prefill-token bill (docs/"
+                        "SERVING.md Live migration)")
     p.add_argument("--cpu-model", default="tiny", choices=["tiny", "small"],
                    help="CPU-backend model size: 'small' (~30M) makes "
                         "step compute dominate dispatch, the "
@@ -492,8 +659,8 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     on_accel = jax.default_backend() in ("tpu", "gpu")
-    if args.disagg and args.fleet <= 0:
-        args.fleet = 4  # 2 prefill + 2 decode by default
+    if (args.disagg or args.drain) and args.fleet <= 0:
+        args.fleet = 4  # disagg: 2+2 pools; drain: 1 prefill + 3 decode
     # prefill_chunk defaults deliberately BELOW the adversarial prompt
     # length so a long prompt really spans multiple chunks (otherwise
     # its own bucket would ride along as a single monolithic chunk)
@@ -507,6 +674,10 @@ def main(argv=None) -> int:
             # service time dominates the fixed HTTP/poll overheads
             platform_defaults.update(requests=16, decode_chunk=8,
                                      max_new=24)
+        if args.drain:
+            # small decode chunks stretch each stream so the drain
+            # really lands mid-decode, not between finished requests
+            platform_defaults.update(decode_chunk=2)
     elif on_accel:
         platform_defaults = dict(requests=32, slots=8, decode_chunk=32,
                                  max_prompt=512, max_new=256,
@@ -535,6 +706,20 @@ def main(argv=None) -> int:
             # fleet serves its whole day's traffic at t=0
             args.arrival_rate = 25.0 if args.smoke else 10.0
         return _run_disagg(args)
+
+    if args.drain:
+        if not args.long_frac:
+            # like --disagg, the drain A/B wants the adversarial mix:
+            # long prompts make re-prefill maximally expensive, which
+            # is exactly the bill migration avoids
+            args.long_frac = 0.25
+        if args.disagg_prefill <= 0:
+            # one prefill pod is plenty; the drained decode slot
+            # needs >=2 decode peers (one dies/drains, one receives)
+            args.disagg_prefill = max(1, args.fleet // 4)
+        if args.arrival_rate <= 0:
+            args.arrival_rate = 25.0 if args.smoke else 10.0
+        return _run_drain(args)
 
     if args.fleet > 0:
         return _run_fleet(args, on_accel)

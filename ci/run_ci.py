@@ -154,6 +154,25 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_disagg.xml"],
             args.artifacts_dir, cases,
         )
+        # live-migration gate (ISSUE 16): engine slot export/import
+        # bit-identity, the migration payload kind's hostile-input
+        # wall, the per-kind handle TTL, the router's drain operation +
+        # reactive mirror rung + prefix directory, the no-migration
+        # byte-identity guards, and the drain bench's --smoke A/B
+        # (zero recomputed prefill tokens on the drain path + token
+        # identity across all three arms). Always on and fast,
+        # mirroring the disagg stage: a migration regression (a lost
+        # or double-decoded slot, a drain that silently re-prefills)
+        # fails in seconds.
+        ok = ok and stage(
+            "migration",
+            [py, "-m", "pytest", "tests/test_migration.py",
+             "tests/test_benches.py::TestBenches"
+             "::test_serving_drain_bench_smoke",
+             "-q", "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_migration.xml"],
+            args.artifacts_dir, cases,
+        )
         # observability gate (ISSUEs 9+10): tracer/flight-recorder
         # units, structured-event parser, straggler-detector AND
         # training-health-monitor decision tables (NaN one-shot,
@@ -297,12 +316,15 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_sched.py",
                       "--ignore=tests/test_resize.py",
                       "--ignore=tests/test_disagg.py",
+                      "--ignore=tests/test_migration.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_fleet_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_disagg_bench_smoke",
+                      "--deselect=tests/test_benches.py::TestBenches"
+                      "::test_serving_drain_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_restore_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

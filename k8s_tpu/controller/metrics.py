@@ -392,3 +392,23 @@ SERVING_SPEC_DECODE_ACCEPTED = REGISTRY.gauge(
     "Draft tokens accepted by the verify step (lifetime); the bonus "
     "correction token is not counted",
 )
+# Live request migration + fleet-wide prefix directory (docs/SERVING.md
+# "Live migration & prefix directory"): mid-stream slot moves instead
+# of re-prefill, and cross-replica prefix snapshot fetches.
+ROUTER_MIGRATIONS = REGISTRY.counter(
+    "ktpu_router_migrations_total",
+    "Mid-stream requests resumed on a peer via live KV migration, by "
+    "reason (drain = operator-initiated resize, reactive = decode-pod "
+    "death resumed from a mirrored slot)",
+)
+ROUTER_MIGRATION_FALLBACKS = REGISTRY.counter(
+    "ktpu_router_migration_fallback_total",
+    "Migration attempts that fell through to the next ladder rung "
+    "(missing/expired mirror, dead target, resume rejected) — the "
+    "request then pays the re-prefill the migration would have saved",
+)
+SERVING_PREFIX_REMOTE_HITS = REGISTRY.counter(
+    "ktpu_serving_prefix_remote_hits_total",
+    "Shared-prefix snapshots fetched from a holding peer on a local "
+    "LRU miss (the prefix directory's fleet-wide hit path)",
+)

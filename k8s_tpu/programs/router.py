@@ -66,6 +66,12 @@ def main(rdzv) -> None:
     # handoff legs; absent ⇒ interleaved routing, bit-identical
     roles = parse_roles(
         extra.get("roles", os.environ.get("KTPU_SERVING_ROLES", "")))
+    # live migration (docs/SERVING.md "Live migration & prefix
+    # directory"): mirrors in-flight decode slots onto peers and adds
+    # the migration rung above re-prefill; every replica must run with
+    # KTPU_SERVING_MIGRATION too
+    migration = bool(int(extra.get(
+        "migration", os.environ.get("KTPU_ROUTER_MIGRATION", "0"))))
     router = Router(
         peers,
         host=host,
@@ -77,6 +83,10 @@ def main(rdzv) -> None:
         saturation_depth=float(extra.get("saturation_depth", "8")),
         request_timeout=float(extra.get("request_timeout", "300")),
         roles=roles or None,
+        migration=migration,
+        mirror_interval=float(extra.get(
+            "mirror_interval",
+            os.environ.get("KTPU_ROUTER_MIRROR_INTERVAL", "0.25"))),
     ).start()
     mark_preempt_aware()  # drain in the SIGTERM grace period
     print(json.dumps({
@@ -87,6 +97,9 @@ def main(rdzv) -> None:
         "prefix_tokens": router.prefix_tokens,
         "roles": {str(i): r for i, r in sorted(router.roles.items())},
         "disaggregated": router.disaggregated,
+        # only stamped when on (regression guard: no-migration fleets'
+        # ready event stays byte-identical)
+        **({"migration": True} if migration else {}),
     }), flush=True)
     while not preempt_requested():
         time.sleep(0.1)

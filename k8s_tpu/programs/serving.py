@@ -122,6 +122,11 @@ def main(rdzv) -> None:
     spec_decode_k = int(extra.get(
         "spec_decode_tokens",
         os.environ.get("KTPU_SERVING_SPEC_DECODE", "0")))
+    # live migration (docs/SERVING.md "Live migration & prefix
+    # directory"): opt-in — the healthz/payload key sets only change
+    # when the whole fleet runs with it on
+    migration = bool(int(extra.get(
+        "migration", os.environ.get("KTPU_SERVING_MIGRATION", "0"))))
     if role == "prefill" and not chunked_prefill:
         # fail FAST and loud at startup: a prefill-pool worker on the
         # legacy one-shot path would 400 every /v1/prefill (the KV
@@ -176,7 +181,7 @@ def main(rdzv) -> None:
     )
     frontend = ServingFrontend(engine, host=host, port=port,
                                max_queue_depth=max_queue_depth,
-                               role=role)
+                               role=role, migration=migration)
     # use the SIGTERM grace period to drain instead of dying mid-request
     mark_preempt_aware()
     replica = os.environ.get("KTPU_SERVING_REPLICA", "")
@@ -193,6 +198,9 @@ def main(rdzv) -> None:
         "prefix_cache_tokens": prefix_cache_tokens,
         "role": role,
         "spec_decode_tokens": spec_decode_k,
+        # only stamped when on, keeping the no-migration ready event
+        # byte-identical (the regression guard)
+        **({"migration": True} if migration else {}),
         "restored": bool(cfg.checkpoint_dir),
     }), flush=True)
     frontend.serve(should_stop=preempt_requested)

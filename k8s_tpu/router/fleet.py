@@ -103,7 +103,9 @@ class StandinEngine:
                       "ttft_count": 0, "prefix_hits": 0,
                       "prefix_misses": 0, "prefix_captures": 0,
                       "prefix_tokens_saved": 0,
-                      "kv_prefills": 0, "kv_admits": 0}
+                      "kv_prefills": 0, "kv_admits": 0,
+                      "migrations_out": 0, "migrations_in": 0,
+                      "slot_mirrors": 0}
 
     # -- engine surface ---------------------------------------------------
 
@@ -155,10 +157,71 @@ class StandinEngine:
                 raise RuntimeError("engine is closed")
             req = _Req(next(self._rid), prompt, max_new_tokens)
             req.prefill_remaining = 0
-            req.tokens = [int(kv["first_token"])]
+            if str(kv.get("kind") or "") == "migration":
+                # live-migration resume: the stream so far rides in
+                # the manifest; the caller's max_new_tokens is
+                # budget+1 (the real engine's convention), so the
+                # final count lands exactly on the original request's
+                # max_new — bit-identical to the unmigrated stream
+                # because _token() is a function of (prompt, position)
+                tokens = [int(t) for t in (kv.get("tokens") or ())]
+                if not tokens or tokens[-1] != int(kv["first_token"]):
+                    raise ValueError(
+                        "migration kv: tokens must end at first_token")
+                req.tokens = tokens
+                req.max_new = len(tokens) + max_new_tokens - 1
+                self.stats["migrations_in"] += 1
+            else:
+                req.tokens = [int(kv["first_token"])]
             self.stats["kv_admits"] += 1
             self._queue.append(req)
         return req.rid
+
+    def export_slot(self, request_id: int, *, remove: bool = True,
+                    timeout: float = 30.0) -> Optional[dict]:
+        """Migration export, stand-in flavor (the real engine's
+        contract, minus the device snapshot): pack a SLOTTED request's
+        resumable state as a ``kind="migration"`` kv dict admissible
+        via :meth:`submit_with_kv`. Returns ``None`` for requests that
+        are queued, mid-prefill, finished, or out of budget — the
+        caller then lets them finish locally. Thread-safe (the
+        stand-in's state lives under one lock — no pump queue needed,
+        so ``timeout`` is accepted for signature parity only)."""
+        del timeout
+        with self._lock:
+            if self._closed:
+                return None
+            req = None
+            slot = None
+            for i, r in enumerate(self._slots):
+                if r is not None and r.rid == request_id:
+                    req, slot = r, i
+                    break
+            if req is None or req.done or req.prefill_remaining > 0 \
+                    or not req.tokens:
+                return None
+            budget = req.max_new - len(req.tokens)
+            if budget < 1:
+                return None
+            plen = int(req.prompt.size)
+            kv = {
+                "kind": "migration",
+                "plen": plen,
+                "rows": plen,
+                "first_token": int(req.tokens[-1]),
+                "prompt": [int(t) for t in req.prompt],
+                "tokens": [int(t) for t in req.tokens],
+                "max_new_tokens": int(req.max_new),
+                "budget": int(budget),
+                "leaves": [np.zeros(
+                    plen * self.kv_bytes_per_token, np.uint8)],
+            }
+            if remove:
+                self._slots[slot] = None
+                self.stats["migrations_out"] += 1
+            else:
+                self.stats["slot_mirrors"] += 1
+            return kv
 
     def queue_depth(self) -> int:
         return len(self._queue)
@@ -276,15 +339,19 @@ class LocalFleet:
 
     def __init__(self, engines, *, max_queue_depth: int = 0,
                  router_kwargs: Optional[dict] = None,
-                 roles: Optional[List[str]] = None):
+                 roles: Optional[List[str]] = None,
+                 migration: bool = False,
+                 mirror_interval: float = 0.25):
         self.engines = list(engines)
         self.roles = list(roles) if roles else []
+        self.migration = bool(migration)
         if self.roles and len(self.roles) != len(self.engines):
             raise ValueError("roles must match engines 1:1")
         self.frontends = [
             ServingFrontend(e, host="127.0.0.1", port=0,
                             max_queue_depth=max_queue_depth,
-                            role=(self.roles[i] if self.roles else ""))
+                            role=(self.roles[i] if self.roles else ""),
+                            migration=self.migration)
             for i, e in enumerate(self.engines)
         ]
         self._stops = [threading.Event() for _ in self.engines]
@@ -295,6 +362,9 @@ class LocalFleet:
         if self.roles:
             kwargs.setdefault(
                 "roles", {i: r for i, r in enumerate(self.roles)})
+        if self.migration:
+            kwargs.setdefault("migration", True)
+            kwargs.setdefault("mirror_interval", mirror_interval)
         self.router = Router(
             {i: f"http://127.0.0.1:{fe.port}"
              for i, fe in enumerate(self.frontends)},
@@ -396,6 +466,27 @@ class LocalFleet:
         if not decode_alive or len(alive) <= 1:
             return None
         victim = decode_alive[rng.randrange(len(decode_alive))]
+        self.kill_replica(victim)
+        return victim
+
+    def kill_migration_target(self, rng) -> Optional[int]:
+        """Chaos ``decode-migration-loss``: kill a replica currently
+        holding a mirrored slot — the migration TARGET, mid-transfer
+        from the request's point of view. The next reactive resume
+        against it fails and the source request must fall through to
+        the next ladder rung: never lost, never double-decoded. No-op
+        when no mirror has landed yet or when killing would leave
+        nothing standing."""
+        router = self.router
+        if not getattr(router, "migration", False):
+            return None
+        with router._lock:
+            targets = sorted({int(m["target"])
+                              for m in router._mig_mirrors.values()})
+        targets = [t for t in targets if t not in self._killed]
+        if not targets or len(self.alive()) <= 1:
+            return None
+        victim = targets[rng.randrange(len(targets))]
         self.kill_replica(victim)
         return victim
 

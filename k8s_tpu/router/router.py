@@ -50,6 +50,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import struct
 import threading
 import time
 import urllib.error
@@ -148,6 +149,9 @@ class Replica:
     routed: int = 0              # lifetime requests routed here
     routed_since_poll: int = 0   # staleness compensation (see load())
     last_error: str = ""
+    # sticky drain (drain_replica): the ROUTER decided this replica is
+    # going away — stays DRAINING across healthy polls until deleted
+    drain_requested: bool = False
 
     def load(self, include_backlog: bool = True) -> float:
         """Score used for routing: lower is better. Derived from the
@@ -203,6 +207,8 @@ class Router:
         down_after: int = DEFAULT_DOWN_AFTER,
         slo_window: int = 256,
         roles: Optional[Dict[int, str]] = None,
+        migration: bool = False,
+        mirror_interval: float = 0.25,
     ):
         self.replicas: Dict[int, Replica] = {
             int(i): Replica(index=int(i), url=u.rstrip("/"))
@@ -225,6 +231,27 @@ class Router:
         self.kv_transfers = 0
         self.kv_fallbacks = 0
         self.kv_bytes = 0
+        # Live migration (docs/SERVING.md "Live migration & prefix
+        # directory"): off by default — when on, a mirror thread
+        # checkpoints in-flight decode slots onto peers, drained/dead
+        # replicas' streams resume there instead of re-prefilling, and
+        # the prefix directory (built from healthz advertisements)
+        # points prefill workers at holding peers.
+        self.migration = bool(migration)
+        self.mirror_interval = float(mirror_interval)
+        self.migrations = {"drain": 0, "reactive": 0}
+        self.migration_fallbacks = 0
+        # trace_id -> {"source": decode idx, "max_new"}: requests
+        # currently on a decode leg (mirror candidates)
+        self._mig_inflight: Dict[str, dict] = {}
+        # trace_id -> {"handle", "target", "source"}: last landed
+        # mirror — what the reactive rung resumes from
+        self._mig_mirrors: Dict[str, dict] = {}
+        # replica idx -> set of advertised prefix digests (the
+        # fleet-wide directory), + the advertised engine prefix length
+        self._prefix_dir: Dict[int, set] = {}
+        self._prefix_len_adv = 0
+        self._mirror_thread: Optional[threading.Thread] = None
         self.poll_interval = float(poll_interval)
         self.poll_timeout = float(poll_timeout)
         self.prefix_tokens = int(prefix_tokens)
@@ -282,6 +309,20 @@ class Router:
                 return self._json(200, router.healthz())
 
             def do_POST(self):  # noqa: N802
+                if self.path.startswith("/v1/drain/"):
+                    # operator drain orchestration: migrate replica
+                    # N's in-flight streams to peers, then report —
+                    # the caller deletes the pod once this returns
+                    try:
+                        idx = int(self.path[len("/v1/drain/"):])
+                    except ValueError:
+                        return self._json(
+                            400, {"error": "bad replica index"})
+                    try:
+                        return self._json(200, router.drain_replica(idx))
+                    except KeyError:
+                        return self._json(
+                            404, {"error": f"unknown replica {idx}"})
                 if self.path != "/v1/generate":
                     return self._json(404, {"error": "not found"})
                 try:
@@ -326,7 +367,20 @@ class Router:
             r.failures = 0
             r.routed_since_poll = 0
             r.last_error = ""
-            r.state = DRAINING if r.stats.get("draining") else READY
+            # a router-requested drain is STICKY: the replica itself
+            # still polls healthy right up until the operator deletes
+            # it, and un-draining it here would route new work onto a
+            # pod that is about to disappear
+            r.state = DRAINING if (r.stats.get("draining")
+                                   or r.drain_requested) else READY
+            if self.migration:
+                mig = (payload or {}).get("migration")
+                if isinstance(mig, dict):
+                    self._prefix_dir[index] = set(
+                        str(k) for k in (mig.get("prefix_keys") or ()))
+                    plen = int(mig.get("prefix_len") or 0)
+                    if plen:
+                        self._prefix_len_adv = plen
         self._healthy_gauge()
 
     def note_poll_failure(self, index: int, err: str) -> None:
@@ -659,12 +713,20 @@ class Router:
                 p.routed += 1
                 p.routed_since_poll += 1
             metrics.ROUTER_REQUESTS.inc({"replica": str(idx)})
-            pre_body = json.dumps({
+            pre_req = {
                 "prompt": [int(t) for t in prompt],
                 "max_new_tokens": max_new,
                 "kv_target": d.url,
                 "handle": handle,
-            }).encode()
+            }
+            if self.migration:
+                # prefix directory: point the prefill worker at a
+                # peer already holding this prompt's shared-prefix
+                # snapshot — it fetches on a local LRU miss
+                holder = self._prefix_holder_for(prompt, exclude=(idx,))
+                if holder:
+                    pre_req["prefix_from"] = holder
+            pre_body = json.dumps(pre_req).encode()
             try:
                 code, pre = self._forward(p.url, pre_body,
                                           trace_id=trace_id,
@@ -722,59 +784,88 @@ class Router:
             metrics.ROUTER_REQUESTS.inc({"replica": str(d_idx)})
             dec_body = json.dumps({
                 "handle": handle, "max_new_tokens": max_new}).encode()
-            dec = None
-            for attempt in (0, 1):
-                try:
-                    code2, dec = self._forward(d.url, dec_body,
-                                               trace_id=trace_id,
-                                               path="/v1/decode")
-                    break
-                except urllib.error.HTTPError as e:
+            if self.migration:
+                # while this request is on its decode leg it is a
+                # mirror candidate: the mirror thread checkpoints its
+                # slot onto a peer, and a mirrored slot is what the
+                # reactive rung resumes from if d dies mid-stream
+                with self._lock:
+                    self._mig_inflight[trace_id] = {
+                        "source": d_idx, "max_new": max_new}
+            try:
+                dec = None
+                for attempt in (0, 1):
                     try:
-                        e.read()  # drain: an unread error pins a socket
-                    except Exception:
-                        pass
-                    if e.code in (429, 503) and attempt == 0:
-                        # transient admission rejection: the decode
-                        # worker RESTORED the popped handle expecting
-                        # exactly this retry — one brief retry against
-                        # the SAME replica (the handle lives there)
-                        # beats a full interleaved re-prefill
+                        code2, dec = self._forward(d.url, dec_body,
+                                                   trace_id=trace_id,
+                                                   path="/v1/decode")
+                        break
+                    except urllib.error.HTTPError as e:
                         try:
-                            ra = float(
-                                e.headers.get("Retry-After") or 0.2)
-                        except (TypeError, ValueError):
-                            ra = 0.2  # HTTP-date form: just back off
-                        time.sleep(min(0.5, ra))
-                        continue
-                    # 404 = handle never arrived / evicted; other
-                    # codes = replica-side — the KV is unusable now:
-                    # fall through to the interleaved rung rather
-                    # than re-prefilling through the disagg loop
-                    self._note_retry(d_idx)
+                            e.read()  # drain: unread errors pin sockets
+                        except Exception:
+                            pass
+                        if e.code in (429, 503) and attempt == 0:
+                            # transient admission rejection: the decode
+                            # worker RESTORED the popped handle
+                            # expecting exactly this retry — one brief
+                            # retry against the SAME replica (the
+                            # handle lives there) beats a full
+                            # interleaved re-prefill
+                            try:
+                                ra = float(
+                                    e.headers.get("Retry-After") or 0.2)
+                            except (TypeError, ValueError):
+                                ra = 0.2  # HTTP-date form: back off
+                            time.sleep(min(0.5, ra))
+                            continue
+                        # 404 = handle never arrived / evicted; other
+                        # codes = replica-side — the KV is unusable
+                        # now: migration rung first (resume from the
+                        # mirrored slot), the interleaved rung last
+                        self._note_retry(d_idx)
+                        dec_tried.add(d_idx)
+                        mig = self._migrate_rung(trace_id, t_route0,
+                                                 idx, dec_tried)
+                        if mig is not None:
+                            return mig
+                        return self._fallback_plain(prompt, body,
+                                                    trace_id, dec_tried)
+                    except Exception as e:  # replica died mid-stream
+                        self.note_poll_failure(d_idx, str(e))
+                        self._note_retry(d_idx)
+                        dec_tried.add(d_idx)
+                        mig = self._migrate_rung(trace_id, t_route0,
+                                                 idx, dec_tried)
+                        if mig is not None:
+                            return mig
+                        return self._fallback_plain(prompt, body,
+                                                    trace_id, dec_tried)
+                if not isinstance(dec, dict):
                     dec_tried.add(d_idx)
-                    return self._fallback_plain(prompt, body,
-                                                trace_id, dec_tried)
-                except Exception as e:  # replica died mid-stream
-                    self.note_poll_failure(d_idx, str(e))
-                    self._note_retry(d_idx)
-                    dec_tried.add(d_idx)
-                    return self._fallback_plain(prompt, body,
-                                                trace_id, dec_tried)
-            if not isinstance(dec, dict):
-                dec_tried.add(d_idx)
-                return self._fallback_plain(prompt, body, trace_id,
-                                            dec_tried)
-            with self._lock:
-                self.kv_transfers += 1
-                self.kv_bytes += kv_bytes
-            metrics.ROUTER_KV_TRANSFERS.inc()
-            metrics.ROUTER_KV_BYTES.inc(by=kv_bytes)
-            return self._compose(
-                t_route0, trace_id, dec, spans_pre, kv_s, kv_bytes,
-                replica=d_idx, prefill_replica=idx,
-                retries=len(pre_tried) - 1 + len(dec_tried),
-                pre_latency=float(pre.get("latency_s") or 0.0))
+                    mig = self._migrate_rung(trace_id, t_route0, idx,
+                                             dec_tried)
+                    if mig is not None:
+                        return mig
+                    return self._fallback_plain(prompt, body, trace_id,
+                                                dec_tried)
+                with self._lock:
+                    self.kv_transfers += 1
+                    self.kv_bytes += kv_bytes
+                metrics.ROUTER_KV_TRANSFERS.inc()
+                metrics.ROUTER_KV_BYTES.inc(by=kv_bytes)
+                return self._compose(
+                    t_route0, trace_id, dec, spans_pre, kv_s, kv_bytes,
+                    replica=d_idx, prefill_replica=idx,
+                    retries=len(pre_tried) - 1 + len(dec_tried),
+                    pre_latency=float(pre.get("latency_s") or 0.0))
+            finally:
+                if self.migration:
+                    # cleanup AFTER the migration rung read its mirror
+                    # — the stream resolved one way or another by now
+                    with self._lock:
+                        self._mig_inflight.pop(trace_id, None)
+                        self._mig_mirrors.pop(trace_id, None)
         if saw_429 and not [
                 r for r in self.replicas.values()
                 if self._routable(r)
@@ -807,6 +898,231 @@ class Router:
                 return None, "none"
             best = min(ready, key=lambda r: (r.load(), r.index))
             return best.index, "none"
+
+    # ------------------------------------------- live migration (ladder)
+
+    def _migrate_rung(self, trace_id: str, t_route0: float,
+                      prefill_replica: int, dec_tried: set):
+        """The migration rung of the fallback ladder — ABOVE re-prefill
+        (which stays terminal): if this stream's slot was mirrored onto
+        a peer before its decode replica failed, resume it there via
+        ``POST /v1/migrate/{handle}`` and return the composed response;
+        ``None`` means fall down to the next rung. A missing/expired
+        mirror, a dead target, or a rejected resume all count as
+        migration fallbacks — the request then pays the re-prefill the
+        migration would have saved."""
+        if not self.migration:
+            return None
+        with self._lock:
+            mirror = self._mig_mirrors.get(trace_id)
+        if mirror is None:
+            return None
+        tgt_idx = int(mirror["target"])
+        if tgt_idx in dec_tried:
+            return None
+        tgt = self.replicas.get(tgt_idx)
+        if tgt is None:
+            return None
+        try:
+            req = urllib.request.Request(
+                tgt.url + "/v1/migrate/" + mirror["handle"], data=b"",
+                headers={"Content-Type": "application/json",
+                         "X-KTPU-Trace-Id": trace_id})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - 404/5xx/dead target
+            log.warning("migration rung: resume of %s on replica %d "
+                        "failed (%s) — falling through to re-prefill",
+                        trace_id, tgt_idx, e)
+            with self._lock:
+                self.migration_fallbacks += 1
+            metrics.ROUTER_MIGRATION_FALLBACKS.inc()
+            return None
+        if not isinstance(payload, dict) \
+                or payload.get("tokens") is None:
+            with self._lock:
+                self.migration_fallbacks += 1
+            metrics.ROUTER_MIGRATION_FALLBACKS.inc()
+            return None
+        router_s = max(
+            0.0, time.perf_counter() - t_route0
+            - float(payload.get("latency_s") or 0.0))
+        spans = {k: float(v)
+                 for k, v in (payload.get("spans") or {}).items()}
+        spans["router_s"] = round(router_s, 4)
+        with self._lock:
+            self.migrations["reactive"] += 1
+            self.routed_total += 1
+            ttft = payload.get("ttft_s")
+            if ttft is not None:
+                self._slo.append((float(ttft),
+                                  float(payload.get("itl_ms") or 0.0)))
+            self._spans.append(dict(spans))
+        metrics.ROUTER_MIGRATIONS.inc({"reason": "reactive"})
+        out = dict(payload)
+        out["replica"] = tgt_idx
+        out["prefill_replica"] = prefill_replica
+        out["retries"] = len(dec_tried)
+        out["migrated"] = True
+        out["spans"] = spans
+        out.setdefault("trace_id", trace_id)
+        return 200, out, None
+
+    # ------------------------------------------- live migration (mirror)
+
+    def _pick_mirror_target(self, exclude=()) -> Optional[int]:
+        """Where a mirror (or drain hand-off) should land: the least-
+        loaded ready DECODE peer, else any ready peer — never the
+        source itself."""
+        idx = self.pick_decode(exclude=exclude)
+        if idx is not None:
+            return idx
+        with self._lock:
+            ready = [r for r in self.replicas.values()
+                     if self._routable(r) and r.index not in exclude]
+            if not ready:
+                return None
+            best = min(ready, key=lambda r: (
+                r.load(include_backlog=False), r.index))
+            return best.index
+
+    def _mirror_once(self) -> None:
+        """One mirror sweep: for every request currently on a decode
+        leg, ask its source replica to export the slot (remove=False)
+        and push the snapshot into a chosen peer's handle store. The
+        handle is deterministic per trace (``mig-<trace>``), so each
+        sweep OVERWRITES the previous checkpoint — the reactive rung
+        always resumes from the freshest mirrored state and replays
+        only the tokens since."""
+        with self._lock:
+            inflight = {t: dict(v)
+                        for t, v in self._mig_inflight.items()}
+        for trace_id, info in inflight.items():
+            src = self.replicas.get(int(info["source"]))
+            if src is None:
+                continue
+            tgt_idx = self._pick_mirror_target(
+                exclude=(int(info["source"]),))
+            if tgt_idx is None:
+                continue
+            handle = "mig-" + trace_id
+            try:
+                req = urllib.request.Request(
+                    src.url + "/v1/mirror",
+                    data=json.dumps({
+                        "trace_id": trace_id,
+                        "target": self.replicas[tgt_idx].url,
+                        "handle": handle}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout) as resp:
+                    if resp.status != 200:
+                        continue
+                    resp.read()
+            except Exception:  # noqa: BLE001 - a missed tick is fine
+                continue
+            with self._lock:
+                if trace_id in self._mig_inflight:
+                    self._mig_mirrors[trace_id] = {
+                        "handle": handle, "target": tgt_idx,
+                        "source": int(info["source"])}
+
+    def _mirror_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._mirror_once()
+            except Exception:  # the mirror must never die
+                pass
+            self._stop.wait(self.mirror_interval)
+
+    # ------------------------------------------- live migration (drain)
+
+    def _drain_targets(self, index: int) -> List[str]:
+        """Scored hand-off targets for draining ``index``: ready
+        decode peers first (load order), any ready peer otherwise —
+        never the drained replica itself."""
+        with self._lock:
+            cands = [r for r in self.replicas.values()
+                     if r.index != index and self._routable(r)
+                     and (not self.disaggregated
+                          or self.roles.get(r.index) == "decode")]
+            if not cands:
+                cands = [r for r in self.replicas.values()
+                         if r.index != index and self._routable(r)]
+            cands.sort(key=lambda r: (
+                r.load(include_backlog=False), r.index))
+            return [r.url for r in cands]
+
+    def drain_replica(self, index: int) -> dict:
+        """Zero-downtime drain (docs/SERVING.md "Live migration"):
+        stop routing NEW work to ``index`` (sticky DRAINING), then ask
+        it to hand every in-flight decode stream to a scored peer over
+        ``POST /v1/drain_migrate`` — in-flight clients get their full,
+        bit-identical token streams from the peers, and the replica is
+        safe to delete once this returns. Raises KeyError on an
+        unknown index (the HTTP handler's 404)."""
+        r = self.replicas[index]
+        with self._lock:
+            r.drain_requested = True
+            if r.state == READY:
+                r.state = DRAINING
+        self._healthy_gauge()
+        targets = self._drain_targets(index)
+        out = {"index": index, "targets": targets,
+               "migrated": 0, "failed": 0, "skipped": 0}
+        if not targets:
+            out["error"] = "no ready migration target"
+            return out
+        try:
+            req = urllib.request.Request(
+                r.url + "/v1/drain_migrate",
+                data=json.dumps({"targets": targets}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout) as resp:
+                summary = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - replica gone already?
+            out["error"] = str(e)
+            return out
+        for k in ("migrated", "failed", "skipped"):
+            out[k] = int(summary.get(k) or 0)
+        if out["migrated"]:
+            with self._lock:
+                self.migrations["drain"] += out["migrated"]
+            metrics.ROUTER_MIGRATIONS.inc({"reason": "drain"},
+                                          by=float(out["migrated"]))
+        if out["failed"]:
+            with self._lock:
+                self.migration_fallbacks += out["failed"]
+            metrics.ROUTER_MIGRATION_FALLBACKS.inc(
+                by=float(out["failed"]))
+        return out
+
+    # --------------------------------------------------- prefix directory
+
+    def _prefix_holder_for(self, prompt, exclude=()) -> Optional[str]:
+        """URL of a READY replica advertising this prompt's prefix
+        digest, or None. The digest keyspace is the ENGINE's (sha256
+        of the raw little-endian int32 prefix bytes) — NOT this
+        router's own ``prefix_key`` affinity hash; ties break on the
+        lower index so the choice is deterministic."""
+        with self._lock:
+            plen = self._prefix_len_adv
+            if plen <= 0 or len(prompt) <= plen:
+                return None
+            head = [int(t) for t in prompt[:plen]]
+            digest = hashlib.sha256(
+                struct.pack(f"<{plen}i", *head)).hexdigest()
+            for i in sorted(self._prefix_dir):
+                if i in exclude:
+                    continue
+                r = self.replicas.get(i)
+                if r is None or not self._routable(r):
+                    continue
+                if digest in self._prefix_dir[i]:
+                    return r.url
+        return None
 
     def _compose(self, t_route0: float, trace_id: str, leg: dict,
                  spans_pre: dict, kv_s: float, kv_bytes: int, *,
@@ -966,6 +1282,25 @@ class Router:
                         "bytes_total": self.kv_bytes,
                     },
                 }
+            migration = None
+            if self.migration:
+                migration = {
+                    "migrations": dict(self.migrations),
+                    "fallbacks": self.migration_fallbacks,
+                    "inflight": len(self._mig_inflight),
+                    "mirrors": len(self._mig_mirrors),
+                    # which decode replicas currently have a mirrored
+                    # stream: the chaos/e2e harness picks its SIGKILL
+                    # victim from here so a kill deterministically
+                    # exercises the reactive rung
+                    "mirrored_sources": sorted(
+                        {int(m["source"])
+                         for m in self._mig_mirrors.values()}),
+                    "prefix_replicas": {
+                        str(i): len(ks)
+                        for i, ks in sorted(self._prefix_dir.items())
+                        if ks},
+                }
             draining = self._draining
         return {
             "ok": not draining and ready > 0,
@@ -976,6 +1311,9 @@ class Router:
             # only present in disaggregated mode: the no-disagg healthz
             # stays byte-identical (the regression guard)
             **({"disaggregation": disagg} if disagg else {}),
+            # same guard for migration-off fleets
+            **({"migration": migration} if migration is not None
+               else {}),
             "slo": self.slo_snapshot(),
             "trace": self.trace_snapshot(),
             **counters,
@@ -988,6 +1326,11 @@ class Router:
         self._poll_thread = threading.Thread(
             target=self._poll_loop, daemon=True, name="router-poller")
         self._poll_thread.start()
+        if self.migration:
+            self._mirror_thread = threading.Thread(
+                target=self._mirror_loop, daemon=True,
+                name="router-mirror")
+            self._mirror_thread.start()
         return self
 
     def drain(self) -> None:
@@ -1001,6 +1344,8 @@ class Router:
         self._server.server_close()
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5)
+        if self._mirror_thread is not None:
+            self._mirror_thread.join(timeout=5)
 
     # alias used by tests/harnesses
     close = drain

@@ -39,6 +39,12 @@ kv-transfer-loss    the decode-pool target of a disaggregated KV
                     decode, retry-on-peer, or interleaved re-route),
                     counted in ktpu_router_kv_fallback_total — a lost
                     transfer degrades latency, never a request
+decode-migration-loss  the migration TARGET (the replica holding a
+                    live stream's mirrored slot) killed mid-transfer →
+                    the reactive resume fails, the source falls
+                    through to the next ladder rung (counted in
+                    ktpu_router_migration_fallback_total), and the
+                    request is neither lost nor decoded twice
 slow-host           one gang host's train steps throttled (armed via
                     the obs tracer hook in-process, or
                     ``KTPU_CHAOS_SLOW_HOST`` env for subprocess gangs)
@@ -520,6 +526,38 @@ class KvTransferLossFault(FaultInjector):
         return f"decode-replica-{victim}"
 
 
+class DecodeMigrationLossFault(FaultInjector):
+    """Kill the migration TARGET of a live-migration fleet — the
+    replica a mirrored slot was checkpointed onto, mid-transfer from
+    the stream's point of view (``decode-migration-loss``). The
+    reactive rung's ``/v1/migrate`` against it then fails, and the
+    SOURCE request must fall through to the next ladder rung (counted
+    in ``ktpu_router_migration_fallback_total``) — never lost, never
+    double-decoded (the mirror handle is single-use, so a dead
+    target's copy can't race the surviving stream). No-op on fleets
+    without migration enabled, when no mirror has landed yet, and
+    never removes the last standing replica."""
+
+    name = "decode-migration-loss"
+
+    def __init__(self, fleet, rate: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(rate, seed)
+        self.fleet = fleet
+
+    def fire(self) -> Optional[str]:
+        kill = getattr(self.fleet, "kill_migration_target", None)
+        if kill is None:
+            return None
+        victim = kill(self.rng)
+        if victim is None:
+            return None  # migration off / no mirror landed / last one
+        self.injected += 1
+        log.info("chaos[%s]: killed migration target %d mid-transfer",
+                 self.name, victim)
+        return f"migration-target-{victim}"
+
+
 class RouterStatsFlakeFault(FaultInjector):
     """Make one replica's /healthz stats endpoint error for the next
     few polls while its data plane keeps serving — the router's poll
@@ -887,6 +925,10 @@ class ChaosMonkey:
                     # disaggregated fleet additionally loses KV-handoff
                     # targets mid-transfer
                     KvTransferLossFault(fleet, rate=0.15, seed=s()),
+                    # no-op unless the fleet runs live migration — a
+                    # migration fleet additionally loses mirror
+                    # TARGETS mid-transfer
+                    DecodeMigrationLossFault(fleet, rate=0.15, seed=s()),
                 ]
             if scheduler is not None:
                 inj.append(

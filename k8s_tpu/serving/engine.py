@@ -739,7 +739,24 @@ class ContinuousBatchingEngine:
                       # that fell back to the plain chunk path
                       "spec_decode_rounds": 0, "spec_decode_drafted": 0,
                       "spec_decode_accepted": 0,
-                      "spec_decode_fallbacks": 0}
+                      "spec_decode_fallbacks": 0,
+                      # live migration (docs/SERVING.md "Live migration
+                      # & prefix directory"): slots exported away /
+                      # resumed here / mirrored non-destructively, plus
+                      # prefix snapshots fetched from a holding peer
+                      # instead of recomputed
+                      "migrations_out": 0, "migrations_in": 0,
+                      "slot_mirrors": 0, "prefix_remote_hits": 0,
+                      "prefix_installs": 0}
+        # export_slot() command queue: slot/device state is owned by
+        # the pump thread, so front-end handler threads park an export
+        # request here and the pump services it at the top of step()
+        self._export_q: collections.deque = collections.deque()
+        # guards _prefix_cache/_prefix_bytes structure: export_prefix/
+        # install_prefix run on handler threads while the pump's
+        # capture/hit path mutates the same OrderedDicts (the jax
+        # arrays themselves are immutable — only the dicts need it)
+        self._prefix_lock = threading.Lock()
 
     # -- request intake --------------------------------------------------
 
@@ -818,11 +835,33 @@ class ContinuousBatchingEngine:
                     f"{got.dtype}{list(got.shape)}, engine expects "
                     f"{big.dtype}{want} (model configs must match "
                     "across pools)")
+        if str(kv.get("kind") or "") == "migration":
+            toks = [int(t) for t in (kv.get("tokens") or [])]
+            if not toks or toks[-1] != int(kv["first_token"]):
+                raise ValueError(
+                    "migration seed: tokens[] must end with first_token "
+                    "(the un-fed boundary token the resumed decode "
+                    "feeds next)")
+            if self.eos_id is not None and toks[-1] == int(self.eos_id):
+                raise ValueError(
+                    "migration seed: boundary token is EOS — the "
+                    "source stream had already finished")
+            if float(self.temperature) != 0.0:
+                raise ValueError(
+                    "migration resume requires temperature=0 (greedy): "
+                    "the resumed stream must be bit-identical to the "
+                    "unmigrated one, which sampling cannot be")
         prompt = np.asarray(
             kv.get("prompt") if kv.get("prompt") is not None
             else np.zeros(plen, np.int32), np.int32).reshape(-1)
         req = Request(next(self._rid), prompt, int(max_new_tokens),
                       submitted_at=time.perf_counter(), kv_seed=kv)
+        if str(kv.get("kind") or "") == "migration":
+            # resume mid-stream: everything the source already streamed
+            # pre-seeds the request, so ONE request object yields the
+            # full token list and the boundary token is never
+            # double-delivered (_admit_kv skips the fill registration)
+            req.tokens = [int(t) for t in kv["tokens"]]
         self._enqueue(req)
         return req.rid
 
@@ -983,6 +1022,232 @@ class ContinuousBatchingEngine:
         return jax.tree_util.tree_leaves(
             jax.tree_util.tree_map_with_path(one, pcache))
 
+    def _snapshot_slot_kv(self, slot: int, rows: int) -> List[np.ndarray]:
+        """Host-side copy of ONE decode slot's first ``rows`` cache
+        rows per leaf, shaped as a batch-1 working cache — exactly the
+        intake shape :meth:`submit_with_kv` validates, so an exported
+        slot re-admits on any peer with the same model config. Same
+        copy semantics as :meth:`_snapshot_kv` (``np.asarray`` on CPU
+        is a zero-copy view the next donated chunk scribbles over)."""
+
+        def one(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") \
+                else str(path[-1])
+            if name in ("cached_key", "cached_value"):
+                axis = leaf.ndim - 2
+            elif name in ("key_scale", "value_scale"):
+                axis = leaf.ndim - 1
+            else:
+                raise ValueError(f"unknown cache leaf {name!r}")
+            x = jax.lax.slice_in_dim(
+                leaf, slot, slot + 1, axis=leaf.ndim - 4)
+            x = jax.lax.slice_in_dim(x, 0, rows, axis=axis)
+            return np.array(x, copy=True)
+
+        return jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map_with_path(one, self._cache))
+
+    # -- live migration (docs/SERVING.md "Live migration") ---------------
+
+    def export_slot(self, request_id: int, *, remove: bool = True,
+                    timeout: float = 30.0) -> Optional[dict]:
+        """Thread-safe export of a mid-stream request's full resumable
+        state (a ``kind="migration"`` handoff dict admissible via
+        :meth:`submit_with_kv` on a peer). Slot/device state is owned
+        by the pump thread, so this parks a command the pump services
+        at the top of its next :meth:`step` and waits for the result.
+        Returns ``None`` when the request is not exportable (queued,
+        mid-prefill, finished, token-less, or on timeout). With
+        ``remove=False`` the request keeps decoding locally and the
+        export is a consistent point-in-time MIRROR."""
+        done = threading.Event()
+        box: List[Optional[dict]] = [None]
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._export_q.append((int(request_id), bool(remove),
+                                   done, box))
+        if not done.wait(timeout):
+            return None
+        return box[0]
+
+    def _service_exports(self) -> None:
+        while True:
+            try:
+                rid, remove, done, box = self._export_q.popleft()
+            except IndexError:
+                return
+            try:
+                box[0] = self.export_slot_now(rid, remove=remove)
+            finally:
+                done.set()
+
+    def export_slot_now(self, request_id: int,
+                        remove: bool = True) -> Optional[dict]:
+        """Pump-thread half of :meth:`export_slot` — callers driving
+        :meth:`step` directly (tests, single-threaded harnesses) may
+        call it between rounds. Quiesces in-flight chunks first so the
+        host token list and the device vectors describe the same point
+        in the stream, then packs: slot KV rows (chunk-grid rounded),
+        prompt + every token streamed so far, the un-fed boundary
+        token, and the remaining budget. Resume math: after ``g``
+        emitted tokens the slot sits at ``lengths = plen0 + g - 1``
+        with rows ``[0, lengths)`` written and ``tokens[-1]`` not yet
+        fed — identical to a fresh KV handoff of a ``lengths``-token
+        prompt whose prefill just picked ``tokens[-1]``, which is why
+        the peer-side admission is bit-identical under greedy."""
+        if float(self.temperature) != 0.0:
+            raise ValueError(
+                "live migration requires temperature=0 (greedy): the "
+                "resumed decode must be bit-identical across hosts")
+        while self._unattributed:
+            self._attribute(block=True)
+        slot, req = None, None
+        for i, r in enumerate(self._slot_req):
+            if r is not None and r.rid == request_id:
+                slot, req = i, r
+                break
+        if slot is None or req.done or not req.tokens:
+            return None
+        plen0 = int(req.prompt.size)
+        g = len(req.tokens)
+        lengths = plen0 + g - 1
+        budget = int(req.max_new_tokens) - g
+        if budget <= 0:
+            return None  # finishing this round anyway — nothing to move
+        rows_b = min(self.max_seq,
+                     -(-lengths // self.prefill_chunk)
+                     * self.prefill_chunk)
+        kv = {
+            "kind": "migration",
+            "plen": int(lengths),
+            "rows": int(rows_b),
+            "first_token": int(req.tokens[-1]),
+            "prompt": [int(t) for t in req.prompt],
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": int(req.max_new_tokens),
+            "budget": int(budget),
+            "leaves": self._snapshot_slot_kv(slot, rows_b),
+        }
+        if remove:
+            # freeze the slot out of the schedule: budget0=0 deactivates
+            # on device, and the request leaves _reqs WITHOUT entering
+            # _done — the migration orchestrator resolves its waiter
+            (self._tok, self._lengths, self._active,
+             self._budget) = _set_slot(
+                self._tok, self._lengths, self._active, self._budget,
+                jnp.int32(slot), jnp.int32(0), jnp.int32(0),
+                jnp.int32(1), eos_id=self.eos_id)
+            self._slot_req[slot] = None
+            self._active_h[slot] = False
+            self._tok_h[slot] = 0
+            self._len_h[slot] = 0
+            self._budget_h[slot] = 0
+            self._fill_toks.pop(slot, None)
+            with self._lock:
+                self._reqs.pop(req.rid, None)
+            self.stats["migrations_out"] += 1
+        else:
+            self.stats["slot_mirrors"] += 1
+        return kv
+
+    # -- fleet-wide prefix directory (docs/SERVING.md) -------------------
+
+    def prefix_digest(self, prompt) -> Optional[str]:
+        """sha256 hex of the prompt's prefix-cache key, or ``None``
+        when the prefix cache is off / the prompt is too short to have
+        one. The digest is the fleet-wide directory key: replicas
+        advertise their held digests on /healthz and the router points
+        a missing prefill worker at a holding peer."""
+        L = self._prefix_len
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if not L or p.size <= L:
+            return None
+        import hashlib
+
+        return hashlib.sha256(p[:L].tobytes()).hexdigest()
+
+    def prefix_keys(self) -> List[str]:
+        """Digests of every locally-held prefix snapshot."""
+        import hashlib
+
+        with self._prefix_lock:
+            keys = list(self._prefix_cache.keys())
+        return [hashlib.sha256(k).hexdigest() for k in keys]
+
+    def has_prefix(self, digest: str) -> bool:
+        import hashlib
+
+        with self._prefix_lock:
+            return any(hashlib.sha256(k).hexdigest() == digest
+                       for k in self._prefix_cache)
+
+    def export_prefix(self, digest: str):
+        """``(meta, host leaves)`` of the held prefix snapshot whose
+        key hashes to ``digest``, or ``None``. ``meta["tokens"]`` is
+        the raw prefix so the importer re-derives its own key — the
+        digest never needs to be trusted."""
+        import hashlib
+
+        with self._prefix_lock:
+            entry = None
+            for k, (stage, snap) in self._prefix_cache.items():
+                if hashlib.sha256(k).hexdigest() == digest:
+                    entry = (k, stage, snap)
+                    break
+        if entry is None:
+            return None
+        key, stage, snap = entry
+        meta = {"kind": "prefix", "stage": int(stage),
+                "tokens": [int(t) for t in np.frombuffer(key, np.int32)]}
+        leaves = [np.array(x, copy=True)
+                  for x in jax.tree_util.tree_leaves(snap)]
+        return meta, leaves
+
+    def install_prefix(self, meta: dict, leaves) -> None:
+        """Admit a peer-exported prefix snapshot into the local LRU —
+        the fetch half of the directory. Validates config compatibility
+        (prefix length, stage, leaf shapes/dtypes) on the caller's
+        thread; a mismatch must 400 one fetch, not crash the pump."""
+        tokens = [int(t) for t in (meta.get("tokens") or [])]
+        if len(tokens) != self._prefix_len:
+            raise ValueError(
+                f"prefix import: {len(tokens)} tokens != this engine's "
+                f"prefix length {self._prefix_len} (configs must match "
+                "across the fleet)")
+        stage = int(meta["stage"])
+        if stage < self._prefix_len or stage > self.max_seq:
+            raise ValueError(f"prefix import: bad stage {stage}")
+        _, pcache = self._stage_cache(stage)
+        want = jax.tree_util.tree_leaves(pcache)
+        if len(leaves) != len(want):
+            raise ValueError(
+                f"prefix import: {len(leaves)} leaves != stage cache's "
+                f"{len(want)}")
+        for i, (w, leaf) in enumerate(zip(want, leaves)):
+            got = np.asarray(leaf)
+            if tuple(got.shape) != tuple(w.shape) or got.dtype != w.dtype:
+                raise ValueError(
+                    f"prefix import: leaf {i} is "
+                    f"{got.dtype}{list(got.shape)}, stage {stage} "
+                    f"expects {w.dtype}{list(w.shape)}")
+        treedef = jax.tree_util.tree_structure(pcache)
+        snap = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x) for x in leaves])
+        key = np.asarray(tokens, np.int32).tobytes()
+        with self._prefix_lock:
+            self._prefix_cache[key] = (stage, snap)
+            self._prefix_cache.move_to_end(key)
+            self._prefix_bytes[key] = sum(
+                int(getattr(x, "nbytes", 0) or 0)
+                for x in jax.tree_util.tree_leaves(snap))
+            while len(self._prefix_cache) > self.prefix_cache_max:
+                evicted, _ = self._prefix_cache.popitem(last=False)
+                self._prefix_bytes.pop(evicted, None)
+            self.stats["prefix_cache_bytes"] = sum(
+                self._prefix_bytes.values())
+        self.stats["prefix_installs"] += 1
+
     def _admit_kv(self, req: Request, slot: int,
                   fills: Dict[int, int]) -> None:
         """Scatter a received KV snapshot into ``slot`` and activate it
@@ -1008,9 +1273,23 @@ class ContinuousBatchingEngine:
         self.stats["kv_admits"] += 1
         self._slot_req[slot] = req
         self._active_h[slot] = True  # optimistic; fixed at harvest
-        fills[slot] = req.rid
-        if self.spec_decode_k > 0:
-            self._fill_toks[slot] = first
+        if str(kv.get("kind") or "") == "migration":
+            # resumed mid-stream request: tokens[] already carries the
+            # streamed prefix and the boundary token rides the slot's
+            # tok register. Registering the slot as a FILL would
+            # re-append that token (a duplicate in the stream), so
+            # attribution starts at the first NEW token instead.
+            self.stats["migrations_in"] += 1
+            if self.spec_decode_k > 0:
+                # spec mode plans rounds from the host mirrors, which
+                # normally seed via the fill path we just skipped
+                self._tok_h[slot] = first
+                self._len_h[slot] = int(kv["plen"])
+                self._budget_h[slot] = req.max_new_tokens - 1
+        else:
+            fills[slot] = req.rid
+            if self.spec_decode_k > 0:
+                self._fill_toks[slot] = first
 
     def _admit_prefix(self, req: Request) -> None:
         """Prefix-cache lookup at admission of the next prompt to
@@ -1024,10 +1303,12 @@ class ContinuousBatchingEngine:
         if not L or int(req.prompt.size) <= L:
             return
         key = req.prompt[:L].tobytes()
-        hit = self._prefix_cache.get(key)
+        with self._prefix_lock:
+            hit = self._prefix_cache.get(key)
+            if hit is not None:
+                self._prefix_cache.move_to_end(key)
         if hit is not None:
             stage, snap = hit
-            self._prefix_cache.move_to_end(key)
             self._stage_cache(stage)  # materialize the model view
             # a COPY seeds the live working cache: subsequent chunks
             # donate it, and the snapshot must survive for the next hit
@@ -1176,16 +1457,17 @@ class ContinuousBatchingEngine:
                 # prefix: snapshot it (a copy — the live cache is
                 # donated by the next chunk) into the LRU
                 snap = jax.tree_util.tree_map(jnp.copy, pcache)
-                self._prefix_cache[self._capture_key] = (stage, snap)
-                self._prefix_cache.move_to_end(self._capture_key)
-                self._prefix_bytes[self._capture_key] = sum(
-                    int(getattr(x, "nbytes", 0) or 0)
-                    for x in jax.tree_util.tree_leaves(snap))
-                while len(self._prefix_cache) > self.prefix_cache_max:
-                    evicted, _ = self._prefix_cache.popitem(last=False)
-                    self._prefix_bytes.pop(evicted, None)
-                self.stats["prefix_cache_bytes"] = sum(
-                    self._prefix_bytes.values())
+                with self._prefix_lock:
+                    self._prefix_cache[self._capture_key] = (stage, snap)
+                    self._prefix_cache.move_to_end(self._capture_key)
+                    self._prefix_bytes[self._capture_key] = sum(
+                        int(getattr(x, "nbytes", 0) or 0)
+                        for x in jax.tree_util.tree_leaves(snap))
+                    while len(self._prefix_cache) > self.prefix_cache_max:
+                        evicted, _ = self._prefix_cache.popitem(last=False)
+                        self._prefix_bytes.pop(evicted, None)
+                    self.stats["prefix_cache_bytes"] = sum(
+                        self._prefix_bytes.values())
                 self.stats["prefix_captures"] += 1
                 self._capture_key = None
             if final:
@@ -1501,6 +1783,9 @@ class ContinuousBatchingEngine:
         fill free slots, dispatch. Returns True while work remains."""
         if self._closed:
             raise RuntimeError("engine is closed")
+        # parked export_slot() commands run first: they quiesce, so the
+        # exported state is exactly the pre-round stream position
+        self._service_exports()
         if self.spec_decode_k > 0:
             return self._spec_step()
         while self._attribute(block=False):
@@ -1546,6 +1831,14 @@ class ContinuousBatchingEngine:
         shuts its workers down."""
         with self._lock:
             self._closed = True
+        # release any parked export_slot() waiters: step() will never
+        # run again, so they'd otherwise sit out their full timeout
+        while True:
+            try:
+                _, _, done, _ = self._export_q.popleft()
+            except IndexError:
+                break
+            done.set()
         for _ in self._harvesters:
             self._fetchq.put(None)
         for t in self._harvesters:

@@ -27,6 +27,17 @@ decode pool a corrupt cache).
 
 Stdlib + numpy only: this rides in the same ConfigMap-shipped image as
 the launcher.
+
+Payload kinds ride as manifest conventions — the frame format itself
+is kind-agnostic. ``meta["kind"]`` distinguishes the prefill→decode
+handoff (absent/empty, the original payload), a live-migration slot
+export (``"migration"``: adds ``tokens`` — the full streamed list
+ending at the un-fed boundary token — plus ``budget`` and
+``max_new_tokens``; docs/SERVING.md "Live migration"), and a shared-
+prefix snapshot (``"prefix"``: ``stage`` + the raw prefix ``tokens``,
+served over ``GET /v1/prefix/{digest}``). Receivers dispatch on the
+kind and reject mismatches with 400 — the same fail-loud contract as
+a crc mismatch.
 """
 
 from __future__ import annotations

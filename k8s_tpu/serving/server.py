@@ -98,11 +98,35 @@ class ServingFrontend:
                  role: str = "", kv_store_max: int = 32,
                  kv_store_max_bytes: int = 1 << 30,
                  kv_ttl_s: float = 120.0,
-                 kv_push_timeout: float = 30.0):
+                 kv_push_timeout: float = 30.0,
+                 migration: bool = False,
+                 kv_migration_ttl_s: float = 600.0,
+                 prefix_fetch_timeout: float = 10.0):
         self.engine = engine
         self.request_timeout = float(request_timeout)
         self.max_queue_depth = int(max_queue_depth)
         self.retry_after_s = float(retry_after_s)
+        # live migration (docs/SERVING.md "Live migration & prefix
+        # directory"): off by default — every route below exists
+        # regardless (a peer may call them), but the healthz surface
+        # only grows the migration block when enabled, keeping
+        # no-migration fleets byte-identical on their key sets
+        self.migration = bool(migration)
+        # migration mirrors must outlive a whole decode stream, not one
+        # router leg — their own, longer TTL (the per-kind fix)
+        self.kv_migration_ttl_s = float(kv_migration_ttl_s)
+        self.prefix_fetch_timeout = float(prefix_fetch_timeout)
+        self.kv_migration_expired = 0   # expired MIGRATION handles (dedicated cue)
+        self.mirrors_out = 0            # /v1/mirror exports pushed to a peer
+        self.migrated_out = 0           # drain_migrate slots handed off
+        self.migrated_in = 0            # /v1/migrate resumes served here
+        # trace_id -> in-flight rid: lets the router address a live
+        # request by the trace id it already knows (mirror/migrate)
+        self._trace_rids: Dict[str, int] = {}
+        # re-imported rid -> original rid: a failed drain hand-off
+        # re-admits locally under a NEW rid; the original waiter must
+        # still resolve (see drain_migrate / _resolve_finished)
+        self._aliases: Dict[int, int] = {}
         # disaggregation (docs/SERVING.md "Disaggregation"): "" =
         # interleaved (today's fleet), "prefill"/"decode" = phase pool
         # membership. Steering-only: every replica keeps the full
@@ -172,6 +196,9 @@ class ServingFrontend:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     return self.wfile.write(body)
+                if self.path.startswith("/v1/prefix/"):
+                    return self._prefix_get(
+                        self.path[len("/v1/prefix/"):])
                 if self.path != "/healthz":
                     return self._json(404, {"error": "not found"})
                 if frontend._consume_healthz_fault():
@@ -203,6 +230,14 @@ class ServingFrontend:
                     **({"role": frontend.role,
                         "kv": frontend._kv_store_stats()}
                        if frontend.role else {}),
+                    # live migration + prefix directory (docs/
+                    # SERVING.md): mirror/drain counters plus the
+                    # prefix digests this replica holds — the router's
+                    # healthz poll builds the fleet-wide directory
+                    # from these. Absent unless migration is enabled
+                    # (no-migration fleets stay byte-identical).
+                    **({"migration": frontend._migration_stats()}
+                       if frontend.migration else {}),
                     "draining": frontend._draining,
                     "in_flight": in_flight,
                     "served": frontend.served,
@@ -251,6 +286,12 @@ class ServingFrontend:
                     return self._decode()
                 if self.path.startswith("/v1/kv/"):
                     return self._kv_put(self.path[len("/v1/kv/"):])
+                if self.path.startswith("/v1/migrate/"):
+                    return self._migrate(self.path[len("/v1/migrate/"):])
+                if self.path == "/v1/mirror":
+                    return self._mirror()
+                if self.path == "/v1/drain_migrate":
+                    return self._drain_migrate()
                 return self._json(404, {"error": "not found"})
 
             def _generate(self):
@@ -263,7 +304,8 @@ class ServingFrontend:
                 trace_id = self._trace_id()
                 t0 = time.perf_counter()
                 try:
-                    result = frontend.submit_and_wait(prompt, max_new)
+                    result = frontend.submit_and_wait(
+                        prompt, max_new, trace_id=trace_id)
                 except Overloaded as e:     # backpressure → caller retries
                     return self._json(
                         429, {"error": str(e)},
@@ -304,6 +346,7 @@ class ServingFrontend:
                     max_new = int(req.get("max_new_tokens", 16))
                     kv_target = str(req.get("kv_target") or "")
                     handle = str(req.get("handle") or "")
+                    prefix_from = str(req.get("prefix_from") or "")
                     if not kv_target or not handle:
                         raise ValueError("kv_target and handle required")
                 except Exception as e:
@@ -311,7 +354,8 @@ class ServingFrontend:
                 trace_id = self._trace_id()
                 try:
                     code, payload = frontend.prefill_and_push(
-                        prompt, max_new, kv_target, handle)
+                        prompt, max_new, kv_target, handle,
+                        prefix_from=prefix_from)
                 except Overloaded as e:
                     return self._json(
                         429, {"error": str(e)},
@@ -347,7 +391,8 @@ class ServingFrontend:
                 t0 = time.perf_counter()
                 try:
                     result = frontend.submit_and_wait_kv(
-                        {**meta, "leaves": leaves}, max_new)
+                        {**meta, "leaves": leaves}, max_new,
+                        trace_id=trace_id)
                 except Overloaded as e:
                     # admission never happened and the snapshot is
                     # intact: restore it so a post-backoff retry costs
@@ -401,6 +446,147 @@ class ServingFrontend:
                 frontend._kv_store_put(handle, meta, leaves, len(body))
                 return self._json(200, {
                     "ok": True, "handle": handle, "bytes": len(body)})
+
+            def _migrate(self, handle: str):
+                """Live-migration intake: resume a mid-stream request
+                from a pushed slot export (drain) or its periodic
+                mirror (reactive, after the source died). The resume
+                budget derives from the manifest, so the caller's body
+                may be empty; the response carries the FULL token list
+                — previously-streamed tokens included — bit-identical
+                to what the unmigrated stream would have produced
+                (greedy decode, same weights). 404 on an unknown or
+                expired handle — the caller's cue to fall down the
+                ladder."""
+                if not handle:
+                    return self._json(400, {"error": "empty handle"})
+                trace_id = self._trace_id()
+                entry = frontend._kv_pop(handle)
+                if entry is None:
+                    return self._json(
+                        404, {"error": f"unknown kv handle {handle!r}"})
+                meta, leaves, nbytes = entry
+                if (meta or {}).get("kind") != "migration":
+                    # a disagg handoff is not resumable state — put it
+                    # back (its decode leg may still claim it) and
+                    # reject the kind mismatch loudly
+                    frontend._kv_restore(handle, meta, leaves, nbytes)
+                    return self._json(400, {
+                        "error": f"handle {handle!r} is not a "
+                                 f"migration export"})
+                t0 = time.perf_counter()
+                try:
+                    result = frontend.submit_and_wait_kv(
+                        {**meta, "leaves": leaves},
+                        int(meta.get("budget", 0)) + 1,
+                        trace_id=trace_id)
+                except Overloaded as e:
+                    frontend._kv_restore(handle, meta, leaves, nbytes)
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After":
+                                 f"{frontend.retry_after_s:g}"})
+                except RuntimeError as e:
+                    frontend._kv_restore(handle, meta, leaves, nbytes)
+                    return self._json(503, {"error": str(e)})
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except TimeoutError as e:
+                    return self._json(504, {"error": str(e)})
+                with frontend._lock:
+                    frontend.migrated_in += 1
+                return self._json(200, {
+                    "tokens": [int(t) for t in result.tokens],
+                    "latency_s": round(time.perf_counter() - t0, 4),
+                    "ttft_s": round(result.ttft_s, 4),
+                    "itl_ms": round(result.itl_ms, 3),
+                    "trace_id": trace_id,
+                    "handle": handle,
+                    "migrated": True,
+                    "spans": {k: round(v, 4)
+                              for k, v in result.spans.items()},
+                })
+
+            def _mirror(self):
+                """Router-driven periodic slot mirror: export the named
+                live request's resumable state WITHOUT removing it and
+                push the snapshot into the chosen peer's handle store —
+                the checkpoint the reactive-migration rung resumes from
+                if this pod dies mid-stream."""
+                try:
+                    req = json.loads(self._body())
+                    trace_id = str(req.get("trace_id") or "")
+                    target = str(req.get("target") or "")
+                    handle = str(req.get("handle") or "")
+                    if not trace_id or not target or not handle:
+                        raise ValueError(
+                            "trace_id, target and handle required")
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                with frontend._lock:
+                    rid = frontend._trace_rids.get(trace_id)
+                if rid is None:
+                    return self._json(404, {
+                        "error": f"no live request for trace "
+                                 f"{trace_id!r}"})
+                export = getattr(frontend.engine, "export_slot", None)
+                if not callable(export):
+                    return self._json(
+                        501, {"error": "engine cannot export slots"})
+                try:
+                    kv = export(rid, remove=False)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                if kv is None:
+                    # queued / mid-prefill / already finished: nothing
+                    # mirrorable right now — the router just retries on
+                    # its next mirror tick
+                    return self._json(
+                        404, {"error": "request not mirrorable"})
+                ok, nbytes, err = frontend._push_kv(target, handle, kv)
+                if not ok:
+                    return self._json(
+                        502, {"error": f"mirror push failed: {err}"})
+                with frontend._lock:
+                    frontend.mirrors_out += 1
+                return self._json(200, {
+                    "ok": True, "handle": handle,
+                    "tokens": len(kv.get("tokens") or ()),
+                    "bytes": nbytes})
+
+            def _drain_migrate(self):
+                """Source side of ``router.drain_replica``: hand every
+                slotted in-flight request to one of the given peers and
+                resolve the original waiters with the peers' tokens —
+                the zero-downtime resize contract."""
+                try:
+                    req = json.loads(self._body())
+                    targets = [str(t) for t in (req.get("targets") or [])
+                               if t]
+                    if not targets:
+                        raise ValueError("targets required")
+                except Exception as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                return self._json(200, frontend.drain_migrate(targets))
+
+            def _prefix_get(self, digest: str):
+                """Prefix-directory fetch: serve this replica's
+                captured shared-prefix snapshot (crc-framed, the same
+                wire as every other KV move) to a peer whose local LRU
+                missed."""
+                export = getattr(frontend.engine, "export_prefix", None)
+                packed = export(digest) if callable(export) else None
+                if packed is None:
+                    return self._json(
+                        404, {"error": f"prefix {digest!r} not held"})
+                meta, leaves = packed
+                body = kv_transfer.pack_kv(meta, leaves)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                return self.wfile.write(body)
 
         class Server(ThreadingHTTPServer):
             daemon_threads = True
@@ -470,7 +656,8 @@ class ServingFrontend:
         with self._lock:
             self._healthz_faults += int(n)
 
-    def submit_and_wait(self, prompt, max_new_tokens: int) -> _Result:
+    def submit_and_wait(self, prompt, max_new_tokens: int,
+                        trace_id: str = "") -> _Result:
         """Submit one request and block until its tokens are ready;
         returns a :class:`_Result` (tokens + TTFT/ITL timing).
         Raises RuntimeError while draining (503 to the client) so the
@@ -478,13 +665,16 @@ class ServingFrontend:
         :class:`Overloaded` (429) when backpressure is on and the
         engine queue is at the threshold."""
         return self._submit_and_wait(
-            lambda: self.engine.submit(prompt, max_new_tokens))
+            lambda: self.engine.submit(prompt, max_new_tokens),
+            trace_id=trace_id)
 
-    def submit_and_wait_kv(self, kv: dict, max_new_tokens: int) -> _Result:
+    def submit_and_wait_kv(self, kv: dict, max_new_tokens: int,
+                           trace_id: str = "") -> _Result:
         """Decode-pool intake: same contract as :meth:`submit_and_wait`
         over a received KV seed instead of a prompt."""
         return self._submit_and_wait(
-            lambda: self.engine.submit_with_kv(kv, max_new_tokens))
+            lambda: self.engine.submit_with_kv(kv, max_new_tokens),
+            trace_id=trace_id)
 
     def submit_and_wait_prefill(self, prompt,
                                 max_new_tokens: int) -> _Result:
@@ -493,7 +683,7 @@ class ServingFrontend:
         return self._submit_and_wait(
             lambda: self.engine.submit_prefill(prompt, max_new_tokens))
 
-    def _submit_and_wait(self, submit_fn) -> _Result:
+    def _submit_and_wait(self, submit_fn, trace_id: str = "") -> _Result:
         with self._lock:
             if self._draining:
                 raise RuntimeError("draining: not accepting new requests")
@@ -506,18 +696,29 @@ class ServingFrontend:
             rid = submit_fn()
             ev = threading.Event()
             self._waiters[rid] = ev
+            if trace_id and self.migration:
+                # the router addresses live requests by the trace id it
+                # minted (mirror ticks); registration lives exactly as
+                # long as the waiter
+                self._trace_rids[trace_id] = rid
         self._work.set()
-        if not ev.wait(self.request_timeout):
+        try:
+            if not ev.wait(self.request_timeout):
+                with self._lock:
+                    self._waiters.pop(rid, None)
+                    # the engine may still finish this request later;
+                    # with the waiter gone _resolve_finished drops the
+                    # tokens, but the finish could also have raced this
+                    # timeout — purge either way so nothing accumulates
+                    self._results.pop(rid, None)
+                raise TimeoutError(f"request {rid} timed out")
             with self._lock:
-                self._waiters.pop(rid, None)
-                # the engine may still finish this request later; with
-                # the waiter gone _resolve_finished drops the tokens,
-                # but the finish could also have raced this timeout —
-                # purge either way so nothing accumulates
-                self._results.pop(rid, None)
-            raise TimeoutError(f"request {rid} timed out")
-        with self._lock:
-            result = self._results.pop(rid)
+                result = self._results.pop(rid)
+        finally:
+            if trace_id:
+                with self._lock:
+                    if self._trace_rids.get(trace_id) == rid:
+                        del self._trace_rids[trace_id]
         if isinstance(result, Exception):
             raise result
         return result
@@ -530,16 +731,29 @@ class ServingFrontend:
         orphaned handoff (router gave up after the retry, or died
         between legs) on a then-quiet pod would pin its hundreds of
         MB of host snapshot indefinitely; the TTL bounds retention in
-        TIME as well as bytes."""
-        if self.kv_ttl_s <= 0:
-            return
-        cutoff = time.monotonic() - self.kv_ttl_s
-        while self._kv_store:
-            handle = next(iter(self._kv_store))
-            if self._kv_store[handle][3] > cutoff:
-                break  # ordered by insert time: the rest are younger
-            _, _, nb, _ = self._kv_store.pop(handle)
+        TIME as well as bytes.
+
+        Per-KIND TTLs: a disagg handoff lives ``kv_ttl_s`` (one router
+        leg), a migration mirror lives ``kv_migration_ttl_s`` (it must
+        survive a whole decode stream — the 120s default silently
+        expired long streams' mirrors right when they were needed).
+        Expiring a MIGRATION handle increments its own counter: a
+        peer's /v1/migrate then 404s for a *known, counted* reason
+        instead of silently aliasing the disagg 404-fallback cue.
+        Full scan, not head-pop: per-kind cutoffs break the
+        insert-order == expiry-order property, and the store is
+        bounded at ``kv_store_max`` entries anyway."""
+        now = time.monotonic()
+        for handle in list(self._kv_store):
+            meta, _, nb, born = self._kv_store[handle]
+            mig = (meta or {}).get("kind") == "migration"
+            ttl = self.kv_migration_ttl_s if mig else self.kv_ttl_s
+            if ttl <= 0 or now - born <= ttl:
+                continue
+            del self._kv_store[handle]
             self._kv_store_bytes -= nb
+            if mig:
+                self.kv_migration_expired += 1
 
     def _kv_insert(self, handle: str, meta: dict, leaves,
                    nbytes: int) -> None:
@@ -590,7 +804,7 @@ class ServingFrontend:
     def _kv_store_stats(self) -> dict:
         with self._lock:
             self._kv_expire_locked()
-            return {
+            out = {
                 "handles": len(self._kv_store),
                 "bytes_held": self._kv_store_bytes,
                 "received": self.kv_received,
@@ -599,9 +813,173 @@ class ServingFrontend:
                 "push_failures": self.kv_push_failures,
                 "bytes_out": self.kv_bytes_out,
             }
+            if self.migration:
+                # only when migration is on: no-migration fleets keep
+                # the pre-migration kv key set byte-identical
+                out["migration_expired"] = self.kv_migration_expired
+            return out
+
+    # -- live migration + prefix directory --------------------------------
+
+    def _migration_stats(self) -> dict:
+        """The healthz ``migration`` block: mirror/drain/resume
+        counters plus this replica's prefix-directory advertisement
+        (the digests its local prefix LRU holds) — the router's poll
+        aggregates these into the fleet-wide directory."""
+        eng = self.engine
+        with self._lock:
+            out = {
+                "mirrors_out": self.mirrors_out,
+                "migrated_out": self.migrated_out,
+                "migrated_in": self.migrated_in,
+                "migration_expired": self.kv_migration_expired,
+            }
+        out["prefix_len"] = int(getattr(eng, "_prefix_len", 0) or 0)
+        keys_fn = getattr(eng, "prefix_keys", None)
+        out["prefix_keys"] = list(keys_fn()) if callable(keys_fn) else []
+        return out
+
+    def _push_kv(self, target: str, handle: str, kv: dict):
+        """POST one packed export into ``target``'s handle store;
+        returns ``(ok, nbytes, err)``. Shared by the mirror and drain
+        paths — the migration-specific counters are the caller's, but
+        the bytes ride the same kv push ledger as disagg handoffs."""
+        meta = {k: v for k, v in kv.items() if k != "leaves"}
+        meta["handle"] = handle
+        body = kv_transfer.pack_kv(meta, kv.get("leaves") or [])
+        try:
+            req = urllib.request.Request(
+                target.rstrip("/") + f"/v1/kv/{handle}", data=body,
+                headers={"Content-Type": "application/octet-stream"})
+            with urllib.request.urlopen(
+                    req, timeout=self.kv_push_timeout) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"kv push HTTP {resp.status}")
+        except Exception as e:  # noqa: BLE001 - any failure reported
+            with self._lock:
+                self.kv_push_failures += 1
+            return False, len(body), str(e)
+        with self._lock:
+            self.kv_pushed += 1
+            self.kv_bytes_out += len(body)
+        return True, len(body), ""
+
+    def _migrate_on_peer(self, target: str, handle: str):
+        """Blocking ``POST /v1/migrate/{handle}`` on the peer; returns
+        ``(payload, err)`` — payload None on any failure."""
+        try:
+            req = urllib.request.Request(
+                target.rstrip("/") + f"/v1/migrate/{handle}", data=b"",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"migrate HTTP {resp.status}")
+                return json.loads(resp.read()), ""
+        except Exception as e:  # noqa: BLE001
+            return None, str(e)
+
+    def drain_migrate(self, targets) -> dict:
+        """Source side of ``router.drain_replica``: export every
+        SLOTTED in-flight request (remove=True — the slot frees as the
+        export leaves) and hand it to a peer; the ORIGINAL waiter
+        resolves with the peer's bit-identical full token list, so the
+        client never observes the move. Per-request failure ladder:
+        peer push/resume failed → re-import the export LOCALLY under a
+        fresh rid aliased back to the original waiter (zero recompute —
+        the export still holds the KV rows); local re-import also
+        impossible → fail the waiter with RuntimeError rather than
+        hang it. Requests still queued or mid-prefill export ``None``
+        and simply finish here — the drain moves decode streams and
+        never re-prefills anything."""
+        export = getattr(self.engine, "export_slot", None)
+        out = {"migrated": 0, "failed": 0, "skipped": 0}
+        if not callable(export) or not targets:
+            return out
+        with self._lock:
+            rids = list(self._waiters)
+        for i, rid in enumerate(rids):
+            try:
+                kv = export(rid, remove=True)
+            except ValueError:
+                kv = None
+            if kv is None:
+                out["skipped"] += 1   # unslotted: finishes locally
+                continue
+            handle = f"drain-{self.port}-{rid}"
+            target = targets[i % len(targets)]
+            ok, _, err = self._push_kv(target, handle, kv)
+            payload = None
+            if ok:
+                payload, err = self._migrate_on_peer(target, handle)
+            if payload is not None and payload.get("tokens") is not None:
+                with self._lock:
+                    ev = self._waiters.pop(rid, None)
+                    if ev is not None:
+                        self._results[rid] = _Result(
+                            np.asarray(payload["tokens"], np.int32),
+                            float(payload.get("ttft_s", 0.0)),
+                            float(payload.get("itl_ms", 0.0)),
+                            spans=dict(payload.get("spans") or {}))
+                        self.served += 1
+                        ev.set()
+                    self.migrated_out += 1
+                out["migrated"] += 1
+                continue
+            try:
+                with self._lock:
+                    rid2 = self.engine.submit_with_kv(
+                        kv, int(kv.get("budget", 0)) + 1)
+                    self._aliases[rid2] = rid
+                self._work.set()
+            except Exception as e:  # noqa: BLE001 - double failure
+                with self._lock:
+                    ev = self._waiters.pop(rid, None)
+                    if ev is not None:
+                        self._results[rid] = RuntimeError(
+                            f"drain migration failed both ways: "
+                            f"peer: {err}; local: {e}")
+                        ev.set()
+            out["failed"] += 1
+        return out
+
+    def _maybe_fetch_prefix(self, prompt, peer: str) -> None:
+        """Prefix-directory fetch (best-effort): when the router says
+        ``peer`` holds this prompt's shared-prefix snapshot and the
+        local LRU misses, pull it over ``GET /v1/prefix/{digest}`` and
+        install it before prefill — the fleet-wide hit path. Any
+        failure degrades to computing the prefix locally, exactly as
+        if the directory had never spoken."""
+        eng = self.engine
+        digest_fn = getattr(eng, "prefix_digest", None)
+        has = getattr(eng, "has_prefix", None)
+        install = getattr(eng, "install_prefix", None)
+        if not (callable(digest_fn) and callable(has)
+                and callable(install)):
+            return
+        try:
+            digest = digest_fn(prompt)
+            if not digest or has(digest):
+                return
+            with urllib.request.urlopen(
+                    peer.rstrip("/") + f"/v1/prefix/{digest}",
+                    timeout=self.prefix_fetch_timeout) as resp:
+                body = resp.read()
+            meta, leaves = kv_transfer.unpack_kv(body)
+            if (meta or {}).get("kind") != "prefix":
+                return
+            install(meta, leaves)
+            eng.stats["prefix_remote_hits"] = \
+                eng.stats.get("prefix_remote_hits", 0) + 1
+            from k8s_tpu.controller import metrics as M
+
+            M.SERVING_PREFIX_REMOTE_HITS.inc()
+        except Exception:   # noqa: BLE001 - telemetry-grade best effort
+            return
 
     def prefill_and_push(self, prompt, max_new_tokens: int,
-                         kv_target: str, handle: str):
+                         kv_target: str, handle: str,
+                         prefix_from: str = ""):
         """The prefill leg, end to end: chunked prefill to completion,
         then stream the finished KV to ``kv_target``'s
         ``/v1/kv/{handle}`` (crc32-framed, the peer-shard-wire idiom).
@@ -614,8 +992,14 @@ class ServingFrontend:
           LOCAL-PREFILL FALLBACK: the snapshot this worker already
           holds seeds its own decode slot and the complete generation
           returns with ``{"local_fallback": true, tokens, ...}`` — a
-          lost transfer degrades latency, never the request."""
+          lost transfer degrades latency, never the request.
+
+        ``prefix_from`` (router-injected): a peer URL advertising this
+        prompt's shared-prefix snapshot — fetched and installed before
+        prefill when the local LRU misses (migration fleets only)."""
         t_req0 = time.perf_counter()
+        if prefix_from and self.migration:
+            self._maybe_fetch_prefix(prompt, prefix_from)
         result = self.submit_and_wait_prefill(prompt, max_new_tokens)
         kv = result.kv or {}
         meta = {k: v for k, v in kv.items() if k != "leaves"}
@@ -691,6 +1075,10 @@ class ServingFrontend:
             return
         with self._lock:
             for rid, req in done.items():
+                # a drain hand-off that failed back to a local
+                # re-import finished under a NEW engine rid — resolve
+                # the ORIGINAL waiter it aliases
+                rid = self._aliases.pop(rid, rid)
                 ev = self._waiters.pop(rid, None)
                 if ev is not None:
                     self.served += 1

@@ -457,6 +457,31 @@ class TrainingJob:
         except Exception:
             return None
 
+    def _drain_serving_replica(self, idx: int) -> None:
+        """Best-effort ``POST /v1/drain/{idx}`` on the fleet router
+        before a scale-down delete: the replica's in-flight decode
+        streams migrate to peers instead of dying with the pod."""
+        import urllib.request
+
+        serving = self.job.spec.serving
+        router_set = next(
+            (r for r in self.replicas
+             if r.spec.replica_type == "ROUTER"), None)
+        if serving is None or router_set is None:
+            return
+        url = (f"http://{router_set.job_name(0)}:"
+               f"{serving.router_port}/v1/drain/{idx}")
+        try:
+            req = urllib.request.Request(url, data=b"{}", headers={
+                "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            log.info("job %s: drained serving replica %d before "
+                     "scale-down", self.fullname, idx)
+        except Exception as e:
+            log.info("job %s: pre-delete drain of replica %d skipped "
+                     "(%s)", self.fullname, idx, e)
+
     def _maybe_autoscale_serving(self) -> None:
         """SLO autoscaling tick (spec.serving): compare the router's
         aggregated TTFT/ITL p95s to the SLOs and move the WORKER
@@ -505,6 +530,13 @@ class TrainingJob:
         direction = "up" if desired > current else "down"
         if desired < current:
             for idx in range(desired, current):
+                # zero-downtime resize (docs/SERVING.md "Live
+                # migration"): ask the router to migrate the doomed
+                # replica's in-flight streams to peers BEFORE the
+                # delete. Best-effort — a router without the drain
+                # route (or migration off) 404s and the delete
+                # proceeds exactly as before.
+                self._drain_serving_replica(idx)
                 try:
                     wset.delete_index(idx)
                 except Exception as e:

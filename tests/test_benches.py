@@ -98,6 +98,32 @@ class TestBenches:
         assert out["affinity_hit_rate"] > 0, out
         assert out["prefix_tokens_saved"] > 0, out
 
+    def test_serving_drain_bench_smoke(self, capsys):
+        """``--drain --smoke`` must emit the drain A/B JSON shape AND
+        meet the live-migration acceptance bar (ISSUE 16): at least
+        one in-flight slot really migrated on the drain path, ZERO
+        prefill tokens recomputed there (the crash arm's re-prefill
+        bill is > 0 by construction), and tokens bit-identical across
+        the no-event / drain / crash arms."""
+        from benches import serving_bench
+
+        assert serving_bench.main(["--smoke", "--drain"]) == 0
+        out = _last_json_line(capsys)
+        assert out["metric"] == "serving_drain_itl_p99_ms"
+        for k in ("value", "itl_p95_ms", "itl_p99_ms",
+                  "reprefill_itl_p95_ms", "reprefill_itl_p99_ms",
+                  "baseline_itl_p99_ms", "itl_p99_win", "migrated",
+                  "drain_migrations", "recomputed_prefill_tokens",
+                  "reprefill_recomputed_prefill_tokens",
+                  "prefill_replicas", "decode_replicas",
+                  "tokens_identical"):
+            assert k in out, k
+        assert out["migrated"] >= 1, out
+        assert out["drain_migrations"] >= 1, out
+        assert out["recomputed_prefill_tokens"] == 0, out
+        assert out["reprefill_recomputed_prefill_tokens"] > 0, out
+        assert out["tokens_identical"] is True, out
+
     def test_serving_disagg_bench_smoke(self, capsys):
         """``--disagg --smoke`` must emit the A/B JSON shape AND meet
         the phase-split acceptance bar under the adversarial

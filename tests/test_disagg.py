@@ -112,6 +112,31 @@ class TestKvWire:
             kv_transfer.pack_kv({"k": "v"}, []))
         assert meta2["k"] == "v" and leaves2 == []
 
+    def _migration_meta(self):
+        # the ``kind="migration"`` payload carries everything a peer
+        # needs to resume a live stream (tests/test_migration.py)
+        return {"kind": "migration", "plen": 9, "rows": 12,
+                "first_token": 41, "prompt": list(range(1, 8)),
+                "tokens": [17, 29, 41], "max_new_tokens": 16,
+                "budget": 13}
+
+    def test_migration_kind_round_trip(self):
+        meta = self._migration_meta()
+        meta2, leaves2 = kv_transfer.unpack_kv(
+            kv_transfer.pack_kv(meta, self._leaves(), chunk_bytes=16))
+        assert {k: meta2[k] for k in meta} == meta
+        assert len(leaves2) == 3
+
+    def test_migration_kind_hostile_frames(self):
+        body = kv_transfer.pack_kv(
+            self._migration_meta(), self._leaves(), chunk_bytes=16)
+        with pytest.raises(ValueError, match="truncated"):
+            kv_transfer.unpack_kv(body[:len(body) - 9])
+        flipped = bytearray(body)
+        flipped[len(flipped) - 2] ^= 0x08
+        with pytest.raises(ValueError, match="crc32"):
+            kv_transfer.unpack_kv(bytes(flipped))
+
 
 # ---------------------------------------------------------------------------
 # engine handoff oracle (real tiny engines)

@@ -1,0 +1,279 @@
+"""Live KV migration through the CONTROL PLANE (ISSUE 16 flagship):
+the operator materializes a 1-prefill + 3-decode fleet + router, all
+with migration on (``KTPU_SERVING_MIGRATION`` / ``KTPU_ROUTER_MIGRATION``,
+announced in the ready events). A REAL subprocess fleet under sustained
+traffic then proves the two migration paths end to end on real engines:
+
+- **drain**: one decode replica is drained mid-stream over
+  ``POST /v1/drain/{index}`` — every request returns 200 with tokens
+  BIT-IDENTICAL to the undrained oracle run, with zero fallback rungs
+  taken (no re-prefill paid on the drain path, asserted at the router's
+  fallback counters AND per-response retries).
+- **reactive**: a second decode replica is SIGKILLed mid-stream — at
+  least one in-flight request resumes on a peer from its periodically
+  mirrored slot (``migrations.reactive`` > 0, response flagged
+  ``migrated``), still token-identical to the oracle.
+
+Plus the fleet-wide prefix directory: the prefill replica's healthz
+advertisement lands in the router's ``prefix_replicas`` map.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_tpu.obs.events import parse_events
+
+from k8s_tpu.api.client import KubeClient
+from k8s_tpu.api.crd_client import TpuJobClient
+from k8s_tpu.controller.controller import Controller
+from k8s_tpu.runtime.kubelet import LocalKubelet, SubprocessExecutor
+from k8s_tpu import spec as S
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class _Feeder:
+    """Sustained request traffic: N worker threads cycling a fixed
+    prompt set through the router so the decode pool is never idle —
+    the window a drain or a SIGKILL lands in is then a certainty, not
+    a race against a single ~50 ms stream."""
+
+    def __init__(self, rport, prompts, max_new, workers=12):
+        self.rport, self.prompts, self.max_new = rport, prompts, max_new
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.results = []  # (prompt_idx, code, body)
+        self.threads = [
+            threading.Thread(target=self._run, args=(w,), daemon=True)
+            for w in range(workers)]
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def _run(self, w):
+        i = w
+        while not self.stop.is_set():
+            idx = i % len(self.prompts)
+            i += 1
+            try:
+                code, body = _post(
+                    self.rport, "/v1/generate",
+                    {"prompt": self.prompts[idx],
+                     "max_new_tokens": self.max_new}, timeout=120)
+            except Exception as e:  # noqa: BLE001 - recorded, asserted
+                code, body = -1, {"error": str(e)}
+            with self.lock:
+                self.results.append((idx, code, body))
+
+    def finish(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=120)
+        with self.lock:
+            return list(self.results)
+
+
+@pytest.mark.integration
+def test_migration_fleet_drain_and_reactive_resume(tmp_path):
+    from k8s_tpu.api.apiserver import LocalApiServer
+    from k8s_tpu.api.restcluster import RestCluster
+
+    api = LocalApiServer().start()
+    controller = kubelet = None
+    try:
+        client = KubeClient(RestCluster(api.url))
+        jc = TpuJobClient(RestCluster(api.url))
+        node_client = KubeClient(api.cluster)
+        controller = Controller(client, jc, S.ControllerConfig(),
+                                reconcile_interval=0.1)
+        executor = SubprocessExecutor(
+            log_dir=str(tmp_path / "logs"),
+            extra_env={
+                "KTPU_FORCE_PLATFORM": "cpu",
+                "KTPU_NUM_CPU_DEVICES": "1",
+                # migration on, fleet-wide; 8 slots + decode_chunk=1
+                # stretch each stream's wall-clock (more slots per
+                # ragged-decode round) so mirrors land mid-flight
+                "KTPU_SERVING_MIGRATION": "1",
+                "KTPU_ROUTER_MIGRATION": "1",
+                "KTPU_ROUTER_MIRROR_INTERVAL": "0.02",
+                "KTPU_PROGRAM": "k8s_tpu.programs.serving:main",
+                "KTPU_PROGRAM_ARGS": (
+                    "--model=tiny --max_seq_len=64 --max_slots=8 "
+                    "--decode_chunk=1 --prompt_buckets=4,8,16 "
+                    "--prefill_chunk=4 --prefix_cache_tokens=4"
+                ),
+            },
+        )
+        kubelet = LocalKubelet(node_client, executor)
+        kubelet.start()
+        controller.start()
+
+        j = S.TpuJob()
+        j.metadata.name = "serve-mig"
+        j.metadata.namespace = "default"
+        j.spec.replica_specs = [
+            S.TpuReplicaSpec(replica_type="WORKER")
+        ]
+        j.spec.serving = S.ServingSpec(
+            prefix_tokens=8, engine_port=8000, router_port=8080,
+            disaggregation=S.DisaggregationSpec(
+                prefill_replicas=1, decode_replicas=3))
+        jc.create(j)
+
+        def _log(name):
+            import glob
+
+            pats = glob.glob(str(tmp_path / "logs" / f"{name}-*.log"))
+            return {p: open(p).read() for p in sorted(pats)}
+
+        deadline = time.monotonic() + 300
+        engines, router = {}, None
+        while time.monotonic() < deadline:
+            engines, router = {}, None
+            for path, log in _log("serve-mig").items():
+                for ev in parse_events(log):
+                    if ev["event"] == "serving_ready":
+                        engines[ev["replica"]] = ev
+                    elif ev["event"] == "router_ready":
+                        router = ev
+            if len(engines) == 4 and router is not None:
+                break
+            time.sleep(0.3)
+        assert len(engines) == 4 and router is not None, (
+            engines, router, _log("serve-mig"))
+        # migration announced in every ready event (the regression
+        # guard's flip side: without the env the key must not exist,
+        # pinned by test_e2e_disagg + tests/test_migration.py)
+        assert all(engines[i]["migration"] is True for i in range(4))
+        assert router["migration"] is True
+        assert engines[0]["role"] == "prefill"
+
+        rport = router["port"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            health = _get(rport, "/healthz")
+            if health["ready_replicas"] == 4:
+                break
+            time.sleep(0.2)
+        assert health["ready_replicas"] == 4, health
+
+        # oracle run — the undrained fleet's exact streams (greedy
+        # real engines are deterministic), and compile warm-up
+        drain_prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 10 + i]
+                         for i in range(4)]
+        kill_prompts = [[7, 5, 3, 20 + i, 11, 13, 2]
+                        for i in range(4)]
+        oracle = {}
+        for p in drain_prompts + kill_prompts:
+            code, body = _post(rport, "/v1/generate",
+                               {"prompt": p, "max_new_tokens": 40})
+            assert code == 200, body
+            oracle[tuple(p)] = body["tokens"]
+
+        # the prefill replica's chunked prefills populated its prefix
+        # LRU; its healthz advertisement must land in the router's
+        # prefix directory
+        deadline = time.monotonic() + 30
+        mig = {}
+        while time.monotonic() < deadline:
+            mig = _get(rport, "/healthz")["migration"]
+            if mig.get("prefix_replicas"):
+                break
+            time.sleep(0.2)
+        assert "0" in mig["prefix_replicas"], mig
+        assert mig["prefix_replicas"]["0"] >= 1, mig
+
+        # phase 1 — DRAIN a decode replica mid-stream: zero re-prefill,
+        # bit-identical tokens via peers
+        pre = _get(rport, "/healthz")
+        pre_kv_fb = pre["disaggregation"]["kv"]["fallbacks"]
+        feeder = _Feeder(rport, drain_prompts, 40).start()
+        deadline = time.monotonic() + 60
+        victim = None
+        while time.monotonic() < deadline:
+            mig = _get(rport, "/healthz")["migration"]
+            if mig["mirrored_sources"]:
+                victim = mig["mirrored_sources"][0]
+                break
+            time.sleep(0.02)
+        assert victim is not None, "no slot mirror ever appeared"
+        code, summary = _post(rport, f"/v1/drain/{victim}", {})
+        assert code == 200, summary
+        results = feeder.finish()
+        assert len(results) >= 4, results
+        for idx, rcode, body in results:
+            assert rcode == 200, body
+            assert body["tokens"] == oracle[tuple(drain_prompts[idx])]
+            assert body["retries"] == 0, body  # no fallback rung taken
+        assert summary["migrated"] >= 1, summary
+        health = _get(rport, "/healthz")
+        assert health["migration"]["migrations"]["drain"] >= 1, health
+        assert health["migration"]["fallbacks"] == 0, health
+        # ZERO re-prefills paid on the drain path
+        assert health["disaggregation"]["kv"]["fallbacks"] == pre_kv_fb
+        # sticky: the drained replica stays out of the ready pool
+        assert health["ready_replicas"] == 3, health
+
+        # phase 2 — SIGKILL a second decode replica mid-stream: ≥1
+        # in-flight request resumes on a peer from its mirrored slot
+        feeder = _Feeder(rport, kill_prompts, 40).start()
+        deadline = time.monotonic() + 60
+        src = None
+        while time.monotonic() < deadline:
+            mig = _get(rport, "/healthz")["migration"]
+            live = [s for s in mig["mirrored_sources"] if s != victim]
+            if live:
+                src = live[0]
+                break
+            time.sleep(0.02)
+        assert src is not None, "no mirrored source to kill"
+        os.kill(engines[src]["pid"], signal.SIGKILL)
+        results = feeder.finish()
+        migrated = 0
+        for idx, rcode, body in results:
+            assert rcode == 200, body
+            assert body["tokens"] == oracle[tuple(kill_prompts[idx])]
+            migrated += 1 if body.get("migrated") else 0
+        health = _get(rport, "/healthz")
+        assert health["migration"]["migrations"]["reactive"] >= 1, health
+        assert migrated >= 1, (migrated, health["migration"])
+
+        # delete over REST ⇒ SIGTERM ⇒ the whole fleet drains
+        jc.delete("default", "serve-mig")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            logs = "\n".join(_log("serve-mig").values())
+            if '"event": "router_drained"' in logs:
+                break
+            time.sleep(0.3)
+        logs = "\n".join(_log("serve-mig").values())
+        assert '"event": "router_drained"' in logs, logs
+    finally:
+        if controller is not None:
+            controller.stop()
+        if kubelet is not None:
+            kubelet.stop()
+        api.stop()

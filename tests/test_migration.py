@@ -1,0 +1,847 @@
+"""Live KV migration + the fleet-wide prefix directory (ISSUE 16,
+docs/SERVING.md "Live migration & prefix directory").
+
+Six layers of proof, all tier-1 (the CI ``migration`` stage):
+
+- **Engine export/import oracle**: a mid-stream slot exported via
+  ``export_slot`` and re-admitted on a peer through ``submit_with_kv``
+  resumes bit-identical to the unmigrated stream (solo ``generate``),
+  including through the real wire format, with the peer running
+  speculative decode, and from a non-destructive mirror.
+- **Hostile migration payloads**: truncated frames, crc flips, leaf
+  mismatches, kind confusion and oversized bodies are rejected loudly
+  (400/413/404) and never seed a decode slot; the drain source
+  completes its waiters via the local re-import fallback when every
+  peer push fails.
+- **Per-kind handle TTL**: migration mirrors outlive the disagg
+  handoff TTL and expire on their OWN counter — an expired mirror is
+  a counted event, not a silent alias of the disagg 404 cue.
+- **Router drain + reactive rung**: ``drain_replica`` migrates every
+  in-flight decode stream to a scored peer with zero re-prefills;
+  decode-pod death resumes ≥1 stream from its periodic mirror via the
+  migration rung ABOVE re-prefill.
+- **Prefix directory**: replicas advertise held prefix digests on
+  /healthz, the router's directory answers holder lookups in the
+  ENGINE's digest keyspace, and a missing prefill worker fetches and
+  installs a peer's snapshot over ``GET /v1/prefix/{digest}``.
+- **Regression guards**: fleets without migration keep healthz /
+  payload key sets byte-identical to the pre-migration surface.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_tpu.router import LocalFleet, Router, StandinEngine
+from k8s_tpu.serving import kv_transfer
+from k8s_tpu.serving.server import ServingFrontend
+
+from llm_fixtures import trained_tiny
+
+
+def _post(url, payload, timeout=30, raw=None):
+    req = urllib.request.Request(
+        url, data=(raw if raw is not None
+                   else json.dumps(payload).encode()),
+        headers={"Content-Type": ("application/octet-stream"
+                                  if raw is not None
+                                  else "application/json")})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {"error": str(e)}
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _engines(n, **kw):
+    defaults = dict(max_slots=2, decode_chunk=1, round_wall_s=0.01,
+                    prefill_chunk=32)
+    defaults.update(kw)
+    return [StandinEngine(**defaults) for _ in range(n)]
+
+
+def _mig_fleet(n=4, mirror_interval=0.03, **kw):
+    roles = ["prefill"] + ["decode"] * (n - 1)
+    return LocalFleet(_engines(n), roles=roles, migration=True,
+                      mirror_interval=mirror_interval,
+                      router_kwargs={"poll_interval": 0.05}, **kw)
+
+
+def _oracle_tokens(prompt, max_new):
+    """StandinEngine tokens are a pure function of (prompt, position)."""
+    eng = StandinEngine()
+    req = type("R", (), {"prompt": np.asarray(prompt)})
+    return [eng._token(req, j) for j in range(max_new)]
+
+
+class _Frontend:
+    """One pumped ServingFrontend over a StandinEngine."""
+
+    def __init__(self, role="", migration=False, **kw):
+        self.engine = StandinEngine(max_slots=2, decode_chunk=1,
+                                    round_wall_s=0.005, prefill_chunk=32)
+        self.fe = ServingFrontend(self.engine, role=role,
+                                  migration=migration, **kw)
+        self.stop = threading.Event()
+        self.fe._http_thread.start()
+        self.t = threading.Thread(target=self._pump, daemon=True)
+        self.t.start()
+
+    def _pump(self):
+        while not self.stop.is_set():
+            busy = self.engine.step()
+            self.fe._resolve_finished()
+            if not busy:
+                self.fe._work.wait(0.01)
+                self.fe._work.clear()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.fe.port}"
+
+    def close(self):
+        self.stop.set()
+        self.t.join(timeout=5)
+        try:
+            self.fe.drain()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# engine export/import oracle (real tiny engines)
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(model, params, **kw):
+    from k8s_tpu.serving import ContinuousBatchingEngine
+
+    defaults = dict(max_slots=2, prompt_buckets=(4, 8, 16),
+                    decode_chunk=4, prefill_chunk=4)
+    defaults.update(kw)
+    return ContinuousBatchingEngine(model, params, **defaults)
+
+
+class TestEngineMigration:
+    @pytest.fixture(scope="class")
+    def fixture(self):
+        from k8s_tpu.models import LlamaForCausalLM
+
+        cfg, params = trained_tiny()
+        dec = dataclasses.replace(
+            cfg, decode=True, ragged_decode=True, max_seq_len=64)
+        oracle = dataclasses.replace(cfg, decode=True, max_seq_len=64)
+        return (LlamaForCausalLM(dec), LlamaForCausalLM(oracle), params)
+
+    def _export_mid_stream(self, eng, rid, min_tokens, remove=True):
+        """Step until ``rid`` has streamed ≥ min_tokens, then export."""
+        for _ in range(500):
+            if len(eng._reqs[rid].tokens) >= min_tokens:
+                break
+            eng.step()
+        assert len(eng._reqs[rid].tokens) >= min_tokens
+        return eng.export_slot_now(rid, remove=remove)
+
+    def test_export_import_bit_identity_vs_generate(self, fixture):
+        """Mid-stream export → wire → peer import resumes bit-identical
+        to solo generate — with and without the peer's speculative fast
+        path. The export math: after g tokens the slot sits at
+        plen+g-1 rows with tokens[-1] un-fed, so the import is a fresh
+        KV handoff whose budget+1 decode finishes the stream."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models import generate
+
+        model, oracle, params = fixture
+        rng = np.random.RandomState(5)
+        for plen, max_new in ((3, 28), (9, 24)):
+            p = rng.randint(0, 512, size=plen).astype(np.int32)
+            ref = np.asarray(generate(
+                oracle, params, jnp.asarray(p)[None], max_new))[0]
+            src = _mk_engine(model, params)
+            rid = src.submit(p, max_new)
+            # export early: the quiesce inside export_slot_now drains
+            # in-flight chunks, so leave plenty of budget
+            kv = self._export_mid_stream(src, rid, 2)
+            assert kv is not None and kv["kind"] == "migration"
+            g = len(kv["tokens"])
+            assert 2 <= g < max_new
+            assert kv["budget"] == max_new - g
+            assert kv["tokens"] == [int(t) for t in ref[:g]]
+            assert kv["first_token"] == int(ref[g - 1])
+            # removal semantics: the slot is gone, the source stays
+            # healthy for other work
+            assert src.stats["migrations_out"] == 1
+            assert rid not in src._reqs
+            rid2 = src.submit(p, 4)
+            assert len(src.run()[rid2]) == 4
+            src.close()
+            # through the REAL wire format
+            meta = {k: v for k, v in kv.items() if k != "leaves"}
+            meta2, leaves2 = kv_transfer.unpack_kv(
+                kv_transfer.pack_kv(meta, kv["leaves"]))
+            for spec_k in (0, 3):
+                peer = _mk_engine(model, params, spec_decode_k=spec_k)
+                prid = peer.submit_with_kv(
+                    {**meta2, "leaves": leaves2},
+                    int(meta2["budget"]) + 1)
+                out = peer.run()
+                peer.close()
+                assert np.array_equal(out[prid], ref), (plen, spec_k)
+
+    def test_mirror_keeps_local_stream_decoding(self, fixture):
+        """remove=False is a point-in-time MIRROR: the source stream
+        finishes untouched AND the mirror resumes bit-identical on a
+        peer — the reactive rung's checkpoint contract."""
+        import jax.numpy as jnp
+
+        from k8s_tpu.models import generate
+
+        model, oracle, params = fixture
+        p = np.array([2, 3, 5, 7, 11, 13, 17], np.int32)
+        ref = np.asarray(generate(
+            oracle, params, jnp.asarray(p)[None], 24))[0]
+        src = _mk_engine(model, params)
+        rid = src.submit(p, 24)
+        kv = self._export_mid_stream(src, rid, 2, remove=False)
+        assert kv is not None
+        assert src.stats["slot_mirrors"] == 1
+        assert src.stats["migrations_out"] == 0
+        out = src.run()
+        src.close()
+        assert np.array_equal(out[rid], ref)  # source unaffected
+        peer = _mk_engine(model, params)
+        prid = peer.submit_with_kv(kv, int(kv["budget"]) + 1)
+        out2 = peer.run()
+        peer.close()
+        assert np.array_equal(out2[prid], ref)
+
+    def test_export_via_command_queue(self, fixture):
+        """The handler-thread path: ``export_slot`` parks a command
+        the pump services at the next step — same payload as the
+        direct call."""
+        model, _, params = fixture
+        eng = _mk_engine(model, params)
+        rid = eng.submit(np.arange(1, 6, dtype=np.int32), 28)
+        for _ in range(500):
+            if len(eng._reqs[rid].tokens) >= 2:
+                break
+            eng.step()
+        box = {}
+
+        def exporter():
+            box["kv"] = eng.export_slot(rid, remove=True, timeout=10)
+
+        t = threading.Thread(target=exporter)
+        t.start()
+        while t.is_alive():
+            eng.step()
+        t.join()
+        eng.close()
+        assert box["kv"] is not None
+        assert box["kv"]["kind"] == "migration"
+
+    def test_unexportable_and_invalid(self, fixture):
+        model, _, params = fixture
+        eng = _mk_engine(model, params)
+        # unknown rid → None
+        assert eng.export_slot_now(12345) is None
+        # finished request → None
+        rid = eng.submit(np.arange(1, 5, dtype=np.int32), 3)
+        eng.run()
+        assert eng.export_slot_now(rid) is None
+        kv_probe = None
+        rid = eng.submit(np.arange(2, 8, dtype=np.int32), 28)
+        for _ in range(500):
+            eng.step()
+            if len(eng._reqs[rid].tokens) >= 1:
+                kv_probe = eng.export_slot_now(rid, remove=False)
+                break
+        assert kv_probe is not None
+        # hostile imports fail on the INTAKE thread, loudly
+        with pytest.raises(ValueError, match="leaves"):
+            eng.submit_with_kv({**kv_probe, "leaves": []},
+                               int(kv_probe["budget"]) + 1)
+        with pytest.raises(ValueError, match="first_token"):
+            eng.submit_with_kv(
+                {**kv_probe,
+                 "tokens": list(kv_probe["tokens"][:-1]) + [0]},
+                int(kv_probe["budget"]) + 1)
+        eng.close()
+        # sampling engines cannot promise bit-identical resume: both
+        # export and import refuse
+        hot = _mk_engine(model, params, temperature=0.7)
+        hrid = hot.submit(np.arange(1, 6, dtype=np.int32), 28)
+        for _ in range(200):
+            hot.step()
+            if len(hot._reqs[hrid].tokens) >= 1:
+                break
+        with pytest.raises(ValueError, match="temperature"):
+            hot.export_slot_now(hrid, remove=False)
+        with pytest.raises(ValueError, match="temperature"):
+            hot.submit_with_kv(kv_probe, int(kv_probe["budget"]) + 1)
+        hot.close()
+
+
+# ---------------------------------------------------------------------------
+# hostile migration payloads + migrate/mirror routes (HTTP, stand-ins)
+# ---------------------------------------------------------------------------
+
+
+def _standin_migration_kv(prompt, g, max_new, eng=None):
+    """A migration export as a StandinEngine would produce mid-stream
+    after ``g`` emitted tokens."""
+    eng = eng or StandinEngine()
+    toks = _oracle_tokens(prompt, g)
+    plen = len(prompt)
+    return {
+        "kind": "migration", "plen": plen, "rows": plen,
+        "first_token": toks[-1],
+        "prompt": [int(t) for t in prompt],
+        "tokens": toks, "max_new_tokens": max_new,
+        "budget": max_new - g,
+        "leaves": [np.zeros(plen * eng.kv_bytes_per_token, np.uint8)],
+    }
+
+
+class TestMigrationRoutes:
+    def test_migrate_resumes_pushed_export(self):
+        fe = _Frontend(role="decode", migration=True)
+        try:
+            prompt = list(range(1, 12))
+            kv = _standin_migration_kv(prompt, 4, 10, fe.engine)
+            meta = {k: v for k, v in kv.items() if k != "leaves"}
+            body = kv_transfer.pack_kv(meta, kv["leaves"])
+            code, out = _post(fe.url + "/v1/kv/mig-1", None, raw=body)
+            assert code == 200, out
+            code, out = _post(fe.url + "/v1/migrate/mig-1", {})
+            assert code == 200, out
+            # FULL token list, bit-identical to the unmigrated stream
+            assert out["migrated"] is True
+            assert out["tokens"] == _oracle_tokens(prompt, 10)
+            # the handle is single-use
+            code, again = _post(fe.url + "/v1/migrate/mig-1", {})
+            assert code == 404, again
+            h = _get(fe.url + "/healthz")
+            assert h["migration"]["migrated_in"] == 1
+            assert fe.engine.stats["migrations_in"] == 1
+        finally:
+            fe.close()
+
+    def test_migrate_rejects_unknown_and_kind_mismatch(self):
+        fe = _Frontend(role="decode", migration=True)
+        try:
+            code, out = _post(fe.url + "/v1/migrate/nope", {})
+            assert code == 404, out
+            # a plain disagg handoff is NOT resumable state: 400, and
+            # the handle goes BACK (its decode leg may still claim it)
+            prompt = list(range(1, 8))
+            disagg = {
+                "plen": 7, "rows": 7,
+                "first_token": _oracle_tokens(prompt, 1)[0],
+                "prompt": prompt}
+            body = kv_transfer.pack_kv(
+                disagg,
+                [np.zeros(7 * fe.engine.kv_bytes_per_token, np.uint8)])
+            code, _ = _post(fe.url + "/v1/kv/h-d", None, raw=body)
+            assert code == 200
+            code, out = _post(fe.url + "/v1/migrate/h-d", {})
+            assert code == 400 and "not a migration" in out["error"]
+            code, out = _post(fe.url + "/v1/decode",
+                              {"handle": "h-d", "max_new_tokens": 5})
+            assert code == 200, out
+            assert out["tokens"] == _oracle_tokens(prompt, 5)
+        finally:
+            fe.close()
+
+    def test_hostile_migration_bodies_rejected(self):
+        """The wire wall, exercised with MIGRATION payloads: truncated
+        frame, crc flip, and oversized body must 400/413 at the
+        receiver and never land in the handle store."""
+        fe = _Frontend(role="decode", migration=True,
+                       kv_store_max_bytes=1 << 20)
+        try:
+            kv = _standin_migration_kv(list(range(1, 10)), 3, 8,
+                                       fe.engine)
+            meta = {k: v for k, v in kv.items() if k != "leaves"}
+            good = kv_transfer.pack_kv(meta, kv["leaves"])
+            code, out = _post(fe.url + "/v1/kv/h-t", None,
+                              raw=good[:len(good) - 7])
+            assert code == 400 and "truncated" in out["error"], out
+            flipped = bytearray(good)
+            flipped[-4] ^= 0x10
+            code, out = _post(fe.url + "/v1/kv/h-c", None,
+                              raw=bytes(flipped))
+            assert code == 400 and "crc32" in out["error"], out
+            big = kv_transfer.pack_kv(
+                meta, [np.zeros(2 << 20, np.uint8)])
+            code, out = _post(fe.url + "/v1/kv/h-big", None, raw=big)
+            assert code == 413, out
+            h = _get(fe.url + "/healthz")
+            assert h["kv"]["received"] == 0
+            assert h["kv"]["handles"] == 0
+            # every rejected handle is a migrate miss, not a seed
+            for handle in ("h-t", "h-c", "h-big"):
+                code, _ = _post(fe.url + f"/v1/migrate/{handle}", {})
+                assert code == 404
+        finally:
+            fe.close()
+
+    def test_mirror_roundtrip_and_reactive_resume(self):
+        """Source mirrors a LIVE stream onto a peer (non-destructively)
+        and the peer's /v1/migrate resumes the full bit-identical
+        stream — the reactive rung, one layer below the router."""
+        src = _Frontend(role="decode", migration=True)
+        tgt = _Frontend(role="decode", migration=True)
+        try:
+            prompt = list(range(3, 40))
+            done = {}
+
+            def one():
+                done["r"] = src.fe.submit_and_wait(
+                    np.asarray(prompt, np.int32), 40, trace_id="t-9")
+
+            th = threading.Thread(target=one)
+            th.start()
+            # the mirror needs a slotted, mid-decode stream: retry
+            # like the router's mirror tick does
+            deadline = time.time() + 10
+            code, out = 0, {}
+            while time.time() < deadline:
+                code, out = _post(src.url + "/v1/mirror", {
+                    "trace_id": "t-9", "target": tgt.url,
+                    "handle": "mig-t-9"})
+                if code == 200:
+                    break
+                time.sleep(0.01)
+            assert code == 200, out
+            assert out["tokens"] >= 1 and out["bytes"] > 0
+            code, res = _post(tgt.url + "/v1/migrate/mig-t-9", {},
+                              timeout=60)
+            assert code == 200, res
+            assert res["migrated"] is True
+            assert res["tokens"] == _oracle_tokens(prompt, 40)
+            th.join(timeout=60)
+            # the mirror never disturbed the source stream
+            assert [int(t) for t in done["r"].tokens] == \
+                _oracle_tokens(prompt, 40)
+            hs, ht = _get(src.url + "/healthz"), _get(tgt.url + "/healthz")
+            assert hs["migration"]["mirrors_out"] == 1
+            assert ht["migration"]["migrated_in"] == 1
+        finally:
+            src.close()
+            tgt.close()
+
+    def test_mirror_unknown_trace_404(self):
+        fe = _Frontend(role="decode", migration=True)
+        try:
+            code, out = _post(fe.url + "/v1/mirror", {
+                "trace_id": "ghost", "target": fe.url, "handle": "h"})
+            assert code == 404, out
+            code, out = _post(fe.url + "/v1/mirror", {"trace_id": ""})
+            assert code == 400, out
+        finally:
+            fe.close()
+
+    def test_drain_source_falls_back_to_local_reimport(self):
+        """Every peer push failing must NOT fail the client: the
+        source re-imports its own export under an aliased rid and the
+        original waiter still gets the full stream."""
+        fe = _Frontend(role="decode", migration=True)
+        try:
+            prompt = list(range(2, 30))
+            done = {}
+
+            def one():
+                done["r"] = fe.fe.submit_and_wait(
+                    np.asarray(prompt, np.int32), 30, trace_id="t-d")
+
+            th = threading.Thread(target=one)
+            th.start()
+            deadline = time.time() + 10
+            summary = {}
+            while time.time() < deadline:
+                # nothing listens on the target: push fails, ladder
+                # falls to the local re-import
+                summary = fe.fe.drain_migrate(["http://127.0.0.1:1"])
+                if summary["failed"] or summary["migrated"]:
+                    break
+                time.sleep(0.01)
+            assert summary["failed"] >= 1, summary
+            th.join(timeout=60)
+            assert [int(t) for t in done["r"].tokens] == \
+                _oracle_tokens(prompt, 30)
+        finally:
+            fe.close()
+
+
+# ---------------------------------------------------------------------------
+# per-kind handle TTL
+# ---------------------------------------------------------------------------
+
+
+class TestPerKindTtl:
+    def test_migration_mirrors_outlive_disagg_ttl(self):
+        eng = StandinEngine()
+        fe = ServingFrontend(eng, migration=True)
+        fe._server.server_close()
+        leaves = [np.zeros(10, np.uint8)]
+        fe._kv_store_put("d", {"plen": 1}, leaves, 10)
+        fe._kv_store_put("m", {"kind": "migration", "plen": 1},
+                         leaves, 10)
+        fe.kv_ttl_s = 0.05
+        fe.kv_migration_ttl_s = 30.0
+        time.sleep(0.08)
+        # the disagg handoff expired (plain miss, the 404 cue)...
+        assert fe._kv_pop("d") is None
+        # ...but the mirror — which must survive a whole decode
+        # stream — did not
+        entry = fe._kv_pop("m")
+        assert entry is not None and entry[0]["kind"] == "migration"
+        assert fe.kv_migration_expired == 0
+        assert fe._kv_store_stats()["migration_expired"] == 0
+        # an expired MIGRATION handle hits its own counter
+        fe._kv_restore("m", *entry)
+        fe.kv_migration_ttl_s = 0.01
+        time.sleep(0.03)
+        assert fe._kv_pop("m") is None
+        assert fe.kv_migration_expired == 1
+        assert fe._kv_store_stats()["migration_expired"] == 1
+        eng.close()
+
+    def test_no_migration_keeps_kv_stats_key_set(self):
+        eng = StandinEngine()
+        fe = ServingFrontend(eng)
+        fe._server.server_close()
+        assert "migration_expired" not in fe._kv_store_stats()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router: drain + reactive rung (LocalFleet)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMigration:
+    def _oracle(self, prompt, max_new):
+        flt = _mig_fleet().start()
+        code, body = flt.generate(prompt, max_new)
+        flt.stop()
+        assert code == 200
+        return body["tokens"]
+
+    def test_drain_migrates_inflight_zero_reprefill(self):
+        prompt = list(range(40))
+        ref = self._oracle(prompt, 24)
+        flt = _mig_fleet().start()
+        try:
+            out = {}
+
+            def one():
+                out["r"] = flt.generate(prompt, 24, timeout=60)
+
+            th = threading.Thread(target=one)
+            th.start()
+            # wait for the decode leg to register, then drain its
+            # replica mid-stream
+            deadline = time.time() + 10
+            victim = None
+            while time.time() < deadline:
+                with flt.router._lock:
+                    infl = dict(flt.router._mig_inflight)
+                if infl:
+                    victim = list(infl.values())[0]["source"]
+                    break
+                time.sleep(0.005)
+            assert victim is not None, "decode leg never registered"
+            res = flt.router.drain_replica(victim)
+            th.join(timeout=60)
+            code, body = out["r"]
+            assert code == 200, body
+            # bit-identical to the undrained fleet, via a peer
+            assert body["tokens"] == ref
+            assert res["migrated"] >= 1, res
+            assert flt.router.migrations["drain"] >= 1
+            assert flt.router.migration_fallbacks == 0
+            # ZERO re-prefills: the prompt was prefilled exactly once
+            # across the whole fleet (StandinEngine pays unpadded
+            # chunk tokens, so the ledger is exact)
+            total = sum(e.stats["prefill_tokens"] for e in flt.engines)
+            assert total == len(prompt), total
+            h = flt.router.healthz()
+            assert h["migration"]["migrations"]["drain"] >= 1
+            # sticky: the drained replica never goes READY again
+            assert flt.router.replicas[victim].drain_requested
+            flt.router._poll_once()
+            from k8s_tpu.router.router import READY
+            assert flt.router.replicas[victim].state != READY
+        finally:
+            flt.stop()
+
+    def test_drain_http_route_and_unknown_404(self):
+        flt = _mig_fleet().start()
+        try:
+            url = f"http://127.0.0.1:{flt.router.port}"
+            code, out = _post(url + "/v1/drain/99", {})
+            assert code == 404, out
+            code, out = _post(url + "/v1/drain/xyz", {})
+            assert code == 400, out
+            code, out = _post(url + "/v1/drain/2", {})
+            assert code == 200, out
+            assert out["index"] == 2 and "migrated" in out
+        finally:
+            flt.stop()
+
+    def test_reactive_migration_on_decode_death(self):
+        prompt = list(range(40))
+        ref = self._oracle(prompt, 30)
+        flt = _mig_fleet().start()
+        try:
+            out = {}
+
+            def one():
+                out["r"] = flt.generate(prompt, 30, timeout=60)
+
+            th = threading.Thread(target=one)
+            th.start()
+            # wait for a mirror checkpoint, then kill its SOURCE
+            deadline = time.time() + 15
+            src = None
+            while time.time() < deadline:
+                with flt.router._lock:
+                    mirrors = dict(flt.router._mig_mirrors)
+                if mirrors:
+                    src = list(mirrors.values())[0]["source"]
+                    break
+                time.sleep(0.005)
+            assert src is not None, "no mirror appeared"
+            flt.kill_replica(src)
+            th.join(timeout=60)
+            code, body = out["r"]
+            assert code == 200, body
+            # resumed from the mirror: bit-identical, flagged, counted
+            assert body["tokens"] == ref
+            assert body.get("migrated") is True, body
+            assert flt.router.migrations["reactive"] >= 1
+            h = flt.router.healthz()
+            assert h["migration"]["migrations"]["reactive"] >= 1
+        finally:
+            flt.stop()
+
+    def test_migration_off_keeps_surfaces_byte_identical(self):
+        """Roles WITHOUT migration: healthz / payload key sets exactly
+        the pre-migration disagg surface — no migration block, no
+        mirror traffic, no migrated/prefix keys anywhere."""
+        flt = LocalFleet(
+            _engines(3), roles=["prefill", "decode", "decode"]).start()
+        try:
+            code, body = flt.generate(list(range(1, 20)), 6)
+            assert code == 200
+            assert "migrated" not in body
+            h = flt.router.healthz()
+            assert "migration" not in h
+            assert flt.router._mirror_thread is None
+            eh = _get(f"http://127.0.0.1:{flt.frontends[1].port}"
+                      "/healthz")
+            assert "migration" not in eh
+            assert "migration_expired" not in eh["kv"]
+        finally:
+            flt.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: decode-migration-loss
+# ---------------------------------------------------------------------------
+
+
+class TestDecodeMigrationLossFault:
+    def test_fault_kills_target_source_falls_through(self):
+        """The chaos contract (docs/ROBUSTNESS.md matrix row): SIGKILL
+        the migration TARGET mid-transfer — the mirrored checkpoint
+        dies with it, the SOURCE stream keeps decoding, and every
+        request completes exactly once with oracle tokens (never lost,
+        never double-decoded)."""
+        from k8s_tpu.runtime.chaos import DecodeMigrationLossFault
+
+        flt = _mig_fleet().start()
+        try:
+            fault = DecodeMigrationLossFault(flt, rate=1.0, seed=3)
+            out = {}
+
+            def one(i):
+                out[i] = flt.generate(
+                    list(range(i + 1, i + 30)), 24, timeout=60)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            # a mirror must have landed for there to be a target
+            deadline = time.time() + 15
+            fired = None
+            while time.time() < deadline:
+                fired = fault.fire()
+                if fired is not None:
+                    break
+                time.sleep(0.01)
+            assert fired is not None and "migration-target" in fired
+            for t in threads:
+                t.join()
+            assert [c for c, _ in out.values()] == [200] * 4, out
+            for i, (_, body) in out.items():
+                assert body["tokens"] == _oracle_tokens(
+                    list(range(i + 1, i + 30)), 24), i
+        finally:
+            flt.stop()
+
+    def test_noop_without_migration_and_profile_registration(self):
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.runtime.chaos import (
+            ChaosMonkey,
+            DecodeMigrationLossFault,
+        )
+
+        flt = LocalFleet(
+            _engines(3), roles=["prefill", "decode", "decode"]).start()
+        try:
+            fault = DecodeMigrationLossFault(flt, rate=1.0, seed=1)
+            assert fault.fire() is None  # migration off → no targets
+            assert flt.alive() == [0, 1, 2]
+        finally:
+            flt.stop()
+        client = KubeClient(InMemoryCluster())
+        m = ChaosMonkey.from_level(client, 3, seed=1, fleet=object())
+        assert "decode-migration-loss" in {i.name for i in m.injectors}
+        m2 = ChaosMonkey.from_level(client, 3, seed=1)
+        assert "decode-migration-loss" not in {
+            i.name for i in m2.injectors}
+
+
+# ---------------------------------------------------------------------------
+# prefix directory
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixDirectory:
+    @pytest.fixture(scope="class")
+    def fixture(self):
+        from k8s_tpu.models import LlamaForCausalLM
+
+        cfg, params = trained_tiny()
+        dec = dataclasses.replace(
+            cfg, decode=True, ragged_decode=True, max_seq_len=64)
+        return LlamaForCausalLM(dec), params
+
+    def _prompt(self, rng, head, tail):
+        return np.concatenate([head, tail]).astype(np.int32)
+
+    def test_fetch_install_and_directory_parity(self, fixture):
+        """The whole directory loop at the engine/frontend layer: A
+        captures a prefix, advertises it on healthz, serves it over
+        GET /v1/prefix/{digest}; B's LRU miss fetches + installs it
+        (counted), and B's subsequent decode is bit-identical to A's.
+        Plus keyspace parity: the router's stdlib digest of the same
+        prompt matches the engine's — the directory lookup and the
+        advertisement can never drift apart."""
+        model, params = fixture
+        rng = np.random.RandomState(3)
+        head = rng.randint(0, 512, size=4).astype(np.int32)
+        p1 = self._prompt(rng, head, rng.randint(0, 512, size=5))
+        eng_a = _mk_engine(model, params, prefix_cache_tokens=4)
+        rid = eng_a.submit(p1, 6)
+        ref = eng_a.run()[rid]
+        digest = eng_a.prefix_digest(p1)
+        assert digest is not None
+        assert digest in eng_a.prefix_keys()
+        fe_a = ServingFrontend(eng_a, migration=True)
+        fe_a._http_thread.start()
+        eng_b = _mk_engine(model, params, prefix_cache_tokens=4)
+        fe_b = ServingFrontend(eng_b, migration=True)
+        fe_b._server.server_close()
+        try:
+            url_a = f"http://127.0.0.1:{fe_a.port}"
+            # healthz advertisement (what the router's poll ingests)
+            h = _get(url_a + "/healthz")
+            assert h["migration"]["prefix_len"] == 4
+            assert digest in h["migration"]["prefix_keys"]
+            # raw fetch: framed, kind="prefix"; unknown digest → 404
+            with urllib.request.urlopen(
+                    url_a + f"/v1/prefix/{digest}", timeout=10) as r:
+                meta, _ = kv_transfer.unpack_kv(r.read())
+            assert meta["kind"] == "prefix"
+            assert meta["tokens"] == [int(t) for t in head]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    url_a + "/v1/prefix/" + "0" * 64, timeout=10)
+            assert ei.value.code == 404
+            # B misses locally, fetches from A, installs, and decodes
+            # the same stream bit-identically
+            assert not eng_b.has_prefix(digest)
+            fe_b._maybe_fetch_prefix(p1, url_a)
+            assert eng_b.has_prefix(digest)
+            assert eng_b.stats["prefix_remote_hits"] == 1
+            assert eng_b.stats["prefix_installs"] == 1
+            rid_b = eng_b.submit(p1, 6)
+            assert np.array_equal(eng_b.run()[rid_b], ref)
+            # a second fetch is a local hit — no re-install
+            fe_b._maybe_fetch_prefix(p1, url_a)
+            assert eng_b.stats["prefix_remote_hits"] == 1
+            # router keyspace parity + holder lookup
+            r = Router({0: "http://a:1", 1: "http://b:1"},
+                       prefix_tokens=4, migration=True)
+            r._server.server_close()
+            for i in range(2):
+                r.note_stats(i, {
+                    "ok": True, "stats": {"queue_depth": 0},
+                    **({"migration": {"prefix_len": 4,
+                                      "prefix_keys": [digest]}}
+                       if i == 0 else
+                       {"migration": {"prefix_len": 4,
+                                      "prefix_keys": []}})})
+            assert r._prefix_holder_for(p1) == "http://a:1"
+            assert r._prefix_holder_for(p1, exclude=(0,)) is None
+            # too-short prompt: no digest, no holder
+            assert r._prefix_holder_for(head) is None
+        finally:
+            try:
+                fe_a.drain()
+            except Exception:
+                pass
+            eng_a.close()
+            eng_b.close()
+
+    def test_prefix_fetch_noops_safely_on_standins(self):
+        """A prefix_from hint against an engine with no prefix cache
+        (or a dead peer) must degrade to doing nothing — the prefill
+        route keeps working."""
+        pre = _Frontend(role="prefill", migration=True)
+        dec = _Frontend(role="decode", migration=True)
+        try:
+            code, body = _post(pre.url + "/v1/prefill", {
+                "prompt": list(range(1, 20)), "max_new_tokens": 5,
+                "kv_target": dec.url, "handle": "h-p",
+                "prefix_from": "http://127.0.0.1:1"})
+            assert code == 200 and body["kv_pushed"] is True, body
+            code, out = _post(dec.url + "/v1/decode",
+                              {"handle": "h-p", "max_new_tokens": 5})
+            assert code == 200, out
+            assert out["tokens"] == _oracle_tokens(
+                list(range(1, 20)), 5)
+        finally:
+            pre.close()
+            dec.close()
