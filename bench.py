@@ -235,6 +235,25 @@ def main() -> int:
         spmd_remat += int(res.get("spmd_involuntary_remat") or 0)
     except Exception as e:  # noqa: BLE001
         llama["llama_zero1_error"] = f"{type(e).__name__}: {e}"
+    # ZeRO-2/3 rows of the same config (ISSUE 17): stage 2 tracks the
+    # grad-carry bytes/device dropping to ~1/DP, stage 3 additionally
+    # the embedding/lm_head param bytes; step time + collective budget
+    # price what the JIT forward gather costs. Same failure isolation.
+    for stage in (2, 3):
+        try:
+            res = bench_llama(["--zero-stage", str(stage)])
+            llama.update({
+                f"llama_zero{stage}_tokens_per_sec_per_chip": res["value"],
+                f"llama_zero{stage}_mfu": res.get("mfu"),
+                f"llama_zero{stage}_step_time_ms": res.get("step_time_ms"),
+                f"llama_zero{stage}_hbm_bytes_per_device":
+                    res.get("hbm_bytes_per_device"),
+                f"llama_zero{stage}_collective_budget":
+                    res.get("collective_budget"),
+            })
+            spmd_remat += int(res.get("spmd_involuntary_remat") or 0)
+        except Exception as e:  # noqa: BLE001
+            llama[f"llama_zero{stage}_error"] = f"{type(e).__name__}: {e}"
 
     # the driver parses the LAST stdout line: flush stderr first so no
     # late warning text can interleave into it

@@ -1,0 +1,106 @@
+"""Autotune sweep under the wall timer — ranked step times as a bench.
+
+Runs the same grid the CI ``autotune`` stage ranks with the stub cost
+model (k8s_tpu/tools/autotune.py), but times every lint-accepted
+candidate with min-of-N real step executions, so the payload records
+what the knob ladder actually costs on this backend. The headline value
+is the chosen (fastest accepted) candidate's step time; the full ranked
+ladder rides along so BENCH_r*.json can track relative ordering flips —
+e.g. latency-hiding overtaking the default schedule on a real TPU mesh
+where the CPU stand-in cannot see the overlap.
+
+Sync is ``jax.block_until_ready`` on the step metrics inside the timer
+(autotune.time_step_wall); compiles are paid outside the timed region.
+``--smoke`` trims the grid to two candidates and one repeat — the
+JSON-shape wiring check for tests/test_benches.py, never a measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="autotune-bench")
+    p.add_argument("--grid", default="standin",
+                   help="named grid (see k8s_tpu.tools.autotune.GRIDS) "
+                        "or a path to a grid JSON")
+    p.add_argument("--repeat", type=int, default=5,
+                   help="N for the wall timer's min-of-N")
+    p.add_argument("--smoke", action="store_true",
+                   help="two candidates + 1 repeat on any backend — a "
+                        "JSON-shape wiring check, never a measurement")
+    return p
+
+
+def measure(args) -> dict:
+    from k8s_tpu.tools import autotune
+
+    if args.grid in autotune.GRIDS:
+        grid = copy.deepcopy(autotune.GRIDS[args.grid])
+        grid_name = args.grid
+    else:
+        with open(args.grid) as f:
+            grid = json.load(f)
+        grid_name = os.path.splitext(os.path.basename(args.grid))[0]
+    repeat = args.repeat
+    if args.smoke:
+        # the smallest sweep that still exercises ranking (2 candidates)
+        grid["axes"] = dict(grid["axes"],
+                            zero_stage=[0, 1], accum_steps=[1])
+        repeat = 1
+
+    artifact = autotune.run_grid(grid, timer="wall", repeat=repeat)
+    chosen = artifact.get("chosen")
+    ladder = [
+        {"config": c["config"], "step_time_ms": c["step_time_ms"],
+         "rank": c["rank"]}
+        for c in artifact["candidates"] if c["status"] == "ok"
+    ]
+    ladder.sort(key=lambda c: c["rank"])
+    rejected = [
+        {"config": c["config"], "reasons": c["reasons"]}
+        for c in artifact["candidates"] if c["status"] != "ok"
+    ]
+    return {
+        "metric": "autotune_chosen_step_time_ms",
+        "value": chosen["step_time_ms"] if chosen else None,
+        "unit": "ms",
+        "grid": grid_name,
+        "timer": "wall",
+        "repeat": repeat,
+        "mesh": artifact["mesh"],
+        "chosen_config": chosen["config"] if chosen else None,
+        "make_train_step_kwargs":
+            chosen["make_train_step_kwargs"] if chosen else None,
+        "ladder": ladder,
+        "rejected": rejected,
+        "n_accepted": artifact["n_accepted"],
+        "n_rejected": artifact["n_rejected"],
+        "n_compile_error": artifact["n_compile_error"],
+        **({"mode": "smoke"} if args.smoke else {}),
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # virtual CPU mesh before first device query (the stand-in setup
+    # needs 8 devices; a real TPU backend already has them)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    payload = measure(args)
+    sys.stderr.flush()
+    print(json.dumps(payload), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

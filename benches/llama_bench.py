@@ -69,6 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO-1 sharded weight update: optimizer state "
                         "+ grad sync sharded over the data axis, params "
                         "all-gathered in-step (docs/PERF.md)")
+    p.add_argument("--zero-stage", type=int, default=None,
+                   choices=[0, 1, 2, 3],
+                   help="ZeRO stage (cumulative; docs/PERF.md "
+                        "\"ZeRO-2/3\"): 2 = + f32 grad-accum carry "
+                        "born 1/DP-sharded, 3 = + the --zero3-leaves "
+                        "params sharded with a JIT forward gather. "
+                        "Default: 1 if --zero1 else 0")
+    p.add_argument("--zero3-leaves", default="embedding,lm_head",
+                   help="comma-separated param-path substrings sharded "
+                        "at --zero-stage 3")
     return p
 
 
@@ -134,20 +144,40 @@ def measure(args) -> dict:
     rules = LogicalRules(LogicalRules.DP)
     model = LlamaForCausalLM(cfg)
     zero1 = bool(getattr(args, "zero1", False))
+    zero_stage = getattr(args, "zero_stage", None)
+    if zero_stage is None:
+        zero_stage = 1 if zero1 else 0
+    zero1 = zero1 or zero_stage >= 1
+    zero3_leaves = [
+        s for s in getattr(args, "zero3_leaves", "").split(",") if s
+    ]
 
     ids = jnp.zeros((batch, seq), jnp.int32)
     state = create_sharded_state(
         model, optax.adamw(3e-4, weight_decay=0.1), mesh, rules,
-        jax.random.PRNGKey(0), ids, zero1=zero1,
+        jax.random.PRNGKey(0), ids, zero_stage=zero_stage,
+        zero3_leaves=zero3_leaves if zero_stage >= 3 else None,
     )
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
     # steady-state per-device residents from abstract shard sizes: the
-    # tracked ZeRO-1 memory metric (opt_state drops ~1/DP under
-    # --zero1; grads are reported in the layout the backward
-    # materializes them in — the params')
+    # tracked ZeRO memory metric. opt_state drops ~1/DP at stage >= 1;
+    # at stage >= 2 the f32 accum carry / reduced grads live in the
+    # zero1 layout (1/DP where a dim divides) instead of the params';
+    # at stage 3 the selected param leaves are THEMSELVES 1/DP, which
+    # state.params' real placements already reflect
+    if zero_stage >= 2:
+        from k8s_tpu.parallel import zero1_shardings
+
+        grad_tree = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            state.params, zero1_shardings(state.params, mesh),
+        )
+    else:
+        # stages 0/1 materialize grads in the params' layout
+        grad_tree = state.params
     hbm = {
         "params": shard_bytes_per_device(state.params),
-        "grads": shard_bytes_per_device(state.params),
+        "grads": shard_bytes_per_device(grad_tree),
         "opt_state": shard_bytes_per_device(state.opt_state),
         "source": "abstract_shard_sizes",
     }
@@ -176,7 +206,7 @@ def measure(args) -> dict:
             return ce + sum_sown_losses(mut.get("intermediates", {})), {}
 
     step = make_train_step(
-        loss_fn, mesh, rules, zero1=zero1,
+        loss_fn, mesh, rules, zero_stage=zero_stage,
         latency_hiding=getattr(args, "latency_hiding", False),
     )
     rng = jax.random.PRNGKey(1)
@@ -226,7 +256,7 @@ def measure(args) -> dict:
         float(metrics["loss"])  # whole step incl. host sync, both arms
         untraced_min = min(untraced_min, time.perf_counter() - tt0)
     step_h = make_train_step(
-        loss_fn, mesh, rules, zero1=zero1, health=True,
+        loss_fn, mesh, rules, zero_stage=zero_stage, health=True,
         latency_hiding=getattr(args, "latency_hiding", False),
     )
     # one warm call pays the health step's compile outside the timing
@@ -311,6 +341,7 @@ def measure(args) -> dict:
         "spmd_involuntary_remat": spmd_remat,
         "latency_hiding": bool(getattr(args, "latency_hiding", False)),
         "zero1": zero1,
+        "zero_stage": zero_stage,
         "trace": trace,
         "hbm_bytes_per_device": hbm,
         "collective_budget": budget,

@@ -302,6 +302,33 @@ def main(argv=None) -> int:
             [py, "-m", "k8s_tpu.tools.hlo_lint", "--check"],
             args.artifacts_dir, cases,
         )
+        # autotune gate (ISSUE 17), always on: the harness unit/smoke
+        # tests — grid-expansion determinism, gate wording, the golden
+        # diff failing loudly on an injected flip, one end-to-end
+        # mini-grid sweep whose winner round-trips into
+        # make_train_step(**chosen["make_train_step_kwargs"])
+        ok = ok and stage(
+            "autotune",
+            [py, "-m", "pytest", "tests/test_autotune.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_autotune.xml"],
+            args.artifacts_dir, cases,
+        )
+        # ...and the FULL stand-in grid sweep under the deterministic
+        # stub timer: the ranked JSON artifact lands in the CI
+        # artifacts (step time as a CI artifact, the ISSUE 17 north
+        # star) and is diffed against ci/autotune/standin-grid-cpu8 —
+        # a chosen-config flip, a collective-signature change, a
+        # surrogate-cost regression past 25% headroom, or any
+        # candidate's accept/reject status flipping fails HERE with a
+        # readable AUTOTUNE GOLDEN DIFF line, mirroring hlo-budget.
+        ok = ok and stage(
+            "autotune-grid",
+            [py, "-m", "k8s_tpu.tools.autotune", "--grid", "standin",
+             "--timer", "stub", "--check",
+             "--out", f"{args.artifacts_dir}/autotune_standin.json"],
+            args.artifacts_dir, cases,
+        )
         # slow-marked tests (the chaos soak) run in their own stage
         # below, never inside the tier-1 unit run
         marker = "not slow and not integration" if args.skip_slow else "not slow"
@@ -317,6 +344,7 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_resize.py",
                       "--ignore=tests/test_disagg.py",
                       "--ignore=tests/test_migration.py",
+                      "--ignore=tests/test_autotune.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

@@ -72,6 +72,23 @@ class Rendezvous:
         # parsed here so the contract is visible at the launch boundary
         # like the checkpoint contract above)
         self.zero1 = env.get("KTPU_ZERO1", "") in ("1", "true")
+        # ZeRO stage ladder (KTPU_ZERO_STAGE 0..3); a legacy KTPU_ZERO1
+        # alone means stage 1, a malformed value degrades the same way
+        try:
+            self.zero_stage = int(env.get(
+                "KTPU_ZERO_STAGE", "1" if self.zero1 else "0"))
+        except ValueError:
+            self.zero_stage = 1 if self.zero1 else 0
+        if not 0 <= self.zero_stage <= 3:
+            self.zero_stage = 1 if self.zero1 else 0
+        self.zero1 = self.zero1 or self.zero_stage >= 1
+        try:
+            self.zero3_min_leaf_size = int(
+                env.get("KTPU_ZERO3_MIN_LEAF_SIZE", "0"))
+        except ValueError:
+            self.zero3_min_leaf_size = 0
+        self.zero3_leaves = [
+            s for s in env.get("KTPU_ZERO3_LEAVES", "").split(",") if s]
         self.latency_hiding = env.get(
             "KTPU_LATENCY_HIDING", "") in ("1", "true")
         self.compile_cache_dir = env.get("KTPU_COMPILE_CACHE_DIR", "")

@@ -27,4 +27,5 @@ from k8s_tpu.parallel.sharding import (  # noqa: F401
     zero1_partition_spec,
     zero1_sharding,
     zero1_shardings,
+    zero3_param_shardings,
 )

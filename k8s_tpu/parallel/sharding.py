@@ -307,6 +307,58 @@ def zero1_shardings(params, mesh: Mesh, axis: str = ZERO1_AXIS):
     )
 
 
+def zero3_param_shardings(
+    params,
+    mesh: Mesh,
+    min_leaf_size: int = 0,
+    leaves: Optional[Sequence[str]] = None,
+    axis: str = ZERO1_AXIS,
+):
+    """Selective ZeRO-3 layout: the zero1 partition applied to the
+    params THEMSELVES, for the selected leaves only — a params-shaped
+    tree of NamedShardings with None for every leaf left in place.
+
+    Selection is deliberately coarse: a leaf is sharded when its
+    '/'-joined tree path contains any substring in ``leaves``
+    (``["embedding", "lm_head"]``), or when its element count is at
+    least ``min_leaf_size`` (> 0). ZeRO-3 pays one just-in-time
+    all-gather per sharded leaf per forward, so only the leaves that
+    dominate param bytes (embedding / lm_head — a third of a small
+    llama) are worth the traffic; the scanned transformer blocks stay
+    in their rules layout. Leaves whose shape the DP degree cannot
+    divide fall back to None (unselected) — same best-effort contract
+    as :func:`zero1_partition_spec`.
+    """
+    sel = tuple(leaves or ())
+
+    def path_str(path) -> str:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return "/".join(parts)
+
+    def pick(path, x):
+        p = path_str(path)
+        chosen = any(s in p for s in sel) or (
+            min_leaf_size and int(getattr(x, "size", 0)) >= int(min_leaf_size)
+        )
+        if not chosen:
+            return None
+        own = getattr(x, "sharding", None)
+        own_spec = own.spec if isinstance(own, NamedSharding) else P()
+        zspec = zero1_partition_spec(
+            own_spec, tuple(getattr(x, "shape", ())), mesh, axis=axis
+        )
+        return NamedSharding(mesh, zspec) if zspec is not None else None
+
+    return jax.tree_util.tree_map_with_path(pick, params)
+
+
 def shard_init(mesh: Mesh, rules: LogicalRules, init_fn, annotations):
     """Eval-shape ``init_fn`` and produce NamedShardings for its pytree.
 

@@ -134,6 +134,21 @@ def _rdzv_flag(rdzv, attr: str, env: str) -> bool:
     return os.environ.get(env, "0") in ("1", "true")
 
 
+def _rdzv_int(rdzv, attr: str, env: str, default: int = 0) -> int:
+    """Integer twin of :func:`_rdzv_flag`: same one-production-parser
+    contract (Rendezvous attr first, env fallback for bare stubs)."""
+    val = getattr(rdzv, attr, None)
+    if val is not None:
+        try:
+            return int(val)
+        except (TypeError, ValueError):
+            return default
+    try:
+        return int(os.environ.get(env, str(default)))
+    except ValueError:
+        return default
+
+
 def main(rdzv) -> None:
     cfg = parse_run_config(rdzv, {"steps": 30, "batch_size": 16})
     extra = cfg.extra or {}
@@ -157,6 +172,27 @@ def main(rdzv) -> None:
         "zero1",
         "1" if _rdzv_flag(rdzv, "zero1", "KTPU_ZERO1") else "0",
     ) in ("1", "true")
+    # --zero_stage=0..3 (spec.training.zeroStage → KTPU_ZERO_STAGE):
+    # the cumulative ZeRO ladder — 2 adds the sharded f32 accum carry,
+    # 3 selectively shards the largest param leaves themselves
+    # (--zero3_leaves substrings / --zero3_min_leaf_size element
+    # threshold, gathered just-in-time in the forward)
+    zero_stage = int(extra.get(
+        "zero_stage",
+        _rdzv_int(rdzv, "zero_stage", "KTPU_ZERO_STAGE",
+                  1 if zero1 else 0)))
+    zero1 = zero1 or zero_stage >= 1
+    zero3_min_leaf_size = int(extra.get(
+        "zero3_min_leaf_size",
+        _rdzv_int(rdzv, "zero3_min_leaf_size", "KTPU_ZERO3_MIN_LEAF_SIZE")))
+    _z3_default = getattr(rdzv, "zero3_leaves", None)
+    if _z3_default is None:
+        _z3_default = os.environ.get("KTPU_ZERO3_LEAVES", "")
+    if not isinstance(_z3_default, str):
+        _z3_default = ",".join(_z3_default)
+    zero3_leaves = [
+        s for s in str(extra.get("zero3_leaves", _z3_default)).split(",")
+        if s]
     if rdzv.process_id <= 0:
         # machine-readable proof the MEGASCALE env shaped the mesh
         # (multi-slice e2e asserts data axis == num_slices; the elastic
@@ -165,7 +201,8 @@ def main(rdzv) -> None:
 
         print(json.dumps({"event": "mesh", "num_slices": num_slices,
                           "dp": data_parallel_degree(mesh),
-                          "shape": dict(mesh.shape), "zero1": zero1}),
+                          "shape": dict(mesh.shape), "zero1": zero1,
+                          "zero_stage": zero_stage}),
               flush=True)
     rules = LogicalRules(getattr(LogicalRules, STRATEGIES[strategy]))
     attention = "ring" if mesh.shape["seq"] > 1 else "flash"
@@ -198,7 +235,8 @@ def main(rdzv) -> None:
     state = create_sharded_state(
         model, optax.adamw(lr, weight_decay=0.1), mesh, rules,
         jax.random.PRNGKey(0), jnp.asarray(next(data)["input_ids"]),
-        zero1=zero1,
+        zero1=zero1, zero_stage=zero_stage,
+        zero3_min_leaf_size=zero3_min_leaf_size, zero3_leaves=zero3_leaves,
     )
 
     # multi-tier when the job's checkpointPolicy enables the local tier
@@ -301,7 +339,8 @@ def main(rdzv) -> None:
         extra.get("health", "1") not in ("0", "false")
     step_fn = make_train_step(loss_fn, mesh, rules,
                               accum_steps=cfg.accum_steps,
-                              zero1=zero1, latency_hiding=lhs,
+                              zero1=zero1, zero_stage=zero_stage,
+                              latency_hiding=lhs,
                               health=health)
     logger = MetricLogger(rdzv, f"llama-{model_name}-{strategy}")
     rng = jax.random.PRNGKey(1)

@@ -300,9 +300,16 @@ class TrainingSpec(K8sObject):
     the launcher and training programs consume — the same spec→env→
     program contract as ``checkpointPolicy``.
 
-    ``zero1`` shards the weight update + optimizer state across the
-    data-parallel mesh axis (ZeRO-1: reduce-scatter grads, update the
-    local shard, all-gather params — 1/DP optimizer HBM per device).
+    ``zeroStage`` selects the cumulative ZeRO ladder (0 = replicated
+    update, 1 = optimizer state sharded across the data-parallel mesh
+    axis, 2 = additionally the f32 grad-accumulation carry and reduced
+    grads — no replicated f32 gradient tree, 3 = additionally the
+    largest param leaves themselves, gathered just-in-time in the
+    forward). Stage 3 needs a selection: ``zero3MinLeafSize`` (element
+    count threshold) and/or ``zero3Leaves`` (param-path substrings,
+    e.g. ``["embedding", "lm_head"]``). The legacy ``zero1: true``
+    bool normalizes to ``zeroStage: 1`` in ``set_defaults`` (and any
+    ``zeroStage >= 1`` sets ``zero1`` back for old consumers).
     ``latencyHiding`` compiles train steps with XLA's latency-hiding
     scheduler so the ZeRO gather/scatter (and every other collective)
     overlaps with compute; the env lands before backend init via the
@@ -314,26 +321,71 @@ class TrainingSpec(K8sObject):
     becomes a disk read. Same pre-init plumbing as ``latencyHiding``."""
 
     zero1: bool = False
+    zero_stage: int = 0
+    zero3_min_leaf_size: int = 0
+    zero3_leaves: List[str] = field(default_factory=list)
     latency_hiding: bool = False
     compile_cache_dir: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
+
+    def resolved_zero_stage(self) -> int:
+        """The effective stage whether or not set_defaults ran: an
+        explicit ``zeroStage`` wins, the legacy bool alone means 1."""
+        if self.zero_stage:
+            return self.zero_stage
+        return 1 if self.zero1 else 0
+
+    def set_defaults(self) -> None:
+        # legacy zero1 ↔ zeroStage normalization, both directions: old
+        # manifests keep working, old consumers of .zero1 keep seeing
+        # True for every sharded-update stage
+        self.zero_stage = self.resolved_zero_stage()
+        if self.zero_stage >= 1:
+            self.zero1 = True
 
     def validate(self) -> None:
         for name in ("zero1", "latency_hiding"):
             if not isinstance(getattr(self, name), bool):
                 raise ValidationError(f"training: {name} must be a boolean")
+        if not isinstance(self.zero_stage, int) or isinstance(
+                self.zero_stage, bool) or not 0 <= self.zero_stage <= 3:
+            raise ValidationError(
+                f"training: zeroStage must be an integer 0..3 "
+                f"(got {self.zero_stage!r})")
+        if not isinstance(self.zero3_min_leaf_size, int) or isinstance(
+                self.zero3_min_leaf_size, bool) or self.zero3_min_leaf_size < 0:
+            raise ValidationError(
+                "training: zero3MinLeafSize must be a non-negative integer")
+        if not isinstance(self.zero3_leaves, list) or any(
+                not isinstance(x, str) or not x for x in self.zero3_leaves):
+            raise ValidationError(
+                "training: zero3Leaves must be a list of non-empty "
+                "param-path substrings")
+        if self.resolved_zero_stage() == 3 and not (
+                self.zero3_min_leaf_size or self.zero3_leaves):
+            raise ValidationError(
+                "training: zeroStage 3 requires a leaf selection — set "
+                "zero3MinLeafSize and/or zero3Leaves (which params to "
+                "shard is a deliberate choice, not a default)")
         if not isinstance(self.compile_cache_dir, str):
             raise ValidationError(
                 "training: compileCacheDir must be a string path")
 
     def to_env(self) -> Dict[str, str]:
-        """The launcher/program contract (``KTPU_ZERO1`` read by
-        ``programs.llama_train``; ``KTPU_LATENCY_HIDING`` and
+        """The launcher/program contract (``KTPU_ZERO_STAGE`` +
+        legacy ``KTPU_ZERO1`` read by ``programs.llama_train`` via the
+        launcher ``Rendezvous``; ``KTPU_LATENCY_HIDING`` and
         ``KTPU_COMPILE_CACHE_DIR`` by the launcher's
         ``configure_platform`` pre-init hook)."""
         env: Dict[str, str] = {}
-        if self.zero1:
-            env["KTPU_ZERO1"] = "1"
+        stage = self.resolved_zero_stage()
+        if stage:
+            env["KTPU_ZERO_STAGE"] = str(stage)
+            env["KTPU_ZERO1"] = "1"  # pre-zeroStage programs
+        if self.zero3_min_leaf_size:
+            env["KTPU_ZERO3_MIN_LEAF_SIZE"] = str(self.zero3_min_leaf_size)
+        if self.zero3_leaves:
+            env["KTPU_ZERO3_LEAVES"] = ",".join(self.zero3_leaves)
         if self.latency_hiding:
             env["KTPU_LATENCY_HIDING"] = "1"
         if self.compile_cache_dir:
@@ -982,6 +1034,8 @@ class TpuJobSpec(K8sObject):
             self.restart_backoff = RestartBackoffSpec()
         if self.scheduling is not None and not self.scheduling.queue:
             self.scheduling.queue = "default"
+        if self.training is not None:
+            self.training.set_defaults()
         if self.elastic is not None and self.tpu is not None:
             # normalize the DP bounds once (the serving-bounds pattern)
             # so everything downstream reads concrete numbers

@@ -1,0 +1,494 @@
+"""Compiler/parallelism autotune harness: folklore → measured config.
+
+The train-step knobs this repo grew — ZeRO stage, gradient-accumulation
+depth, the latency-hiding scheduler, donation, remat policy, raw XLA
+flags — interact in ways nobody should be asked to reason about from
+first principles (the TensorFlow system paper's ethos: tuning knobs get
+*measured*, PAPERS.md arXiv:1605.08695). This module sweeps a declared
+grid of candidates over ONE train-step setup, gates every candidate
+through the ``hlo_lint`` machinery (a config that compiles to
+involuntary rematerialization or a backward all-gather is wrong, not
+slow — it is rejected before any timing), times the survivors, and
+emits a ranked JSON artifact whose winner round-trips directly into
+``make_train_step(**chosen["make_train_step_kwargs"])``.
+
+Grid format (JSON-able; every axis is a list, candidates are the
+cartesian product in sorted-key order, so candidate order — and
+therefore tie-breaks — is deterministic)::
+
+    {
+      "axes": {
+        "zero_stage": [0, 1, 2, 3],
+        "accum_steps": [1, 2],
+        "latency_hiding": [false],
+        "donate": [true],
+        "remat_policy": ["off"],          # "off" | model policy name
+        "compiler_options": [null]        # null | {"xla_flag": "val"}
+      },
+      "zero3_leaves": ["embedding", "lm_head"],   # used when stage == 3
+      "gates": {
+        "max_involuntary_remat": 0,
+        "max_backward_all_gather": 0
+      }
+    }
+
+Two timers:
+
+- ``stub`` — a deterministic surrogate computed from the compiled
+  program alone (collective bytes + op count + remat penalty). Same
+  HLO in, same number out: the CI stage ranks the stand-in grid with
+  it so the artifact is reproducible and the golden
+  (``ci/autotune/``) can pin the CHOSEN config, its collective
+  signature, and its surrogate cost. It is a scheduling cost model,
+  not a clock — use it to compare programs, never to report time.
+- ``wall`` — min-of-N real step executions (min, not mean: the minimum
+  is the contention-free estimate, the same policy as
+  ``benches/*_bench``). ``benches/autotune_bench.py`` runs the same
+  grid under this timer on real hardware.
+
+CLI::
+
+    python -m k8s_tpu.tools.autotune --grid standin --timer stub \
+        --out /tmp/autotune.json            # sweep + write artifact
+    python -m k8s_tpu.tools.autotune --grid standin --timer stub \
+        --check                             # sweep + diff vs ci/autotune/
+
+``--check`` fails (exit 1) when the chosen config changed, its
+collective signature changed, its surrogate cost regressed past the
+golden's 25% headroom, or any candidate's accept/reject status flipped
+— the same loud-diff contract as the HLO budget goldens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+from itertools import product
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from k8s_tpu.tools.hlo_lint import capture_stderr, lint_compiled
+
+DEFAULT_ARTIFACT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "ci", "autotune",
+)
+
+# The CI stand-in grid (8-device virtual CPU mesh, tiny llama): the
+# ZeRO ladder × accumulation depth, gated hard — accum_steps=2
+# candidates compile with one involuntary remat and scan-internal
+# backward gathers on this backend (pinned as such by
+# ci/hlo_budgets/standin-zero2-dp-cpu8.json), so under these gates the
+# artifact DEMONSTRATES lint rejection on every CI run while the
+# accum=1 ladder is ranked. Wall-clock tuning on real hardware relaxes
+# the gates to that config's own budget instead.
+STANDIN_GRID: Dict[str, Any] = {
+    "axes": {
+        "zero_stage": [0, 1, 2, 3],
+        "accum_steps": [1, 2],
+        "latency_hiding": [False],
+        "donate": [True],
+        "remat_policy": ["off"],
+        "compiler_options": [None],
+    },
+    "zero3_leaves": ["embedding", "lm_head"],
+    "gates": {
+        "max_involuntary_remat": 0,
+        "max_backward_all_gather": 0,
+    },
+}
+
+GRIDS: Dict[str, Dict[str, Any]] = {"standin": STANDIN_GRID}
+
+
+def expand_grid(grid: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product of ``grid["axes"]`` in sorted-key order —
+    deterministic candidate order, so ranking tie-breaks and golden
+    diffs are stable across runs."""
+    axes = grid.get("axes", {})
+    keys = sorted(axes)
+    out = []
+    for combo in product(*(axes[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+@dataclasses.dataclass
+class TuneSetup:
+    """One train-step problem the grid is swept over: everything a
+    candidate needs to build, compile, and run a step."""
+
+    make_state: Callable[[Dict[str, Any]], Any]   # candidate → TrainState
+    make_loss: Callable[[Dict[str, Any]], Any]    # candidate → loss_fn
+    mesh: Any
+    rules: Any
+    batch: Any
+    rng: Any
+
+
+def _standin_setup(grid: Dict[str, Any]) -> TuneSetup:
+    """The stand-in problem: tiny llama on the 8-device virtual CPU DP
+    mesh — the same shapes as the zero* hlo_lint stand-ins, so the lint
+    gates and the HLO budget goldens talk about the same programs."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_tpu.models import LlamaConfig, LlamaForCausalLM
+    from k8s_tpu.ops.fused_ce import fused_lm_head_cross_entropy
+    from k8s_tpu.parallel import LogicalRules, MeshConfig, build_mesh
+    from k8s_tpu.train import create_sharded_state, make_batch_sharder
+
+    mesh = build_mesh(MeshConfig(data=8), devices=jax.devices()[:8])
+    rules = LogicalRules(LogicalRules.DP)
+    example = jnp.zeros((8, 64), jnp.int32)
+    zero3_leaves = list(grid.get("zero3_leaves") or [])
+
+    def model_for(cand: Dict[str, Any]):
+        policy = cand.get("remat_policy", "off")
+        cfg = LlamaConfig.tiny(
+            num_heads=4, num_kv_heads=2, head_dim=32, attention="flash",
+            remat=policy != "off",
+            **({"remat_policy": policy} if policy != "off" else {}),
+        )
+        return LlamaForCausalLM(cfg), cfg
+
+    def make_state(cand: Dict[str, Any]):
+        model, _ = model_for(cand)
+        stage = int(cand.get("zero_stage", 0))
+        return create_sharded_state(
+            model, optax.adamw(1e-3), mesh, rules,
+            jax.random.PRNGKey(0), example,
+            zero_stage=stage,
+            zero3_leaves=zero3_leaves if stage >= 3 else None,
+        )
+
+    def make_loss(cand: Dict[str, Any]):
+        _, cfg = model_for(cand)
+
+        def loss_fn(st, params, b, rng):
+            hidden = st.apply_fn(
+                {"params": params}, b["input_ids"], return_hidden=True
+            )
+            return fused_lm_head_cross_entropy(
+                hidden[:, :-1], params["lm_head"]["kernel"],
+                b["input_ids"][:, 1:], target_chunk=cfg.vocab_size // 4,
+                mesh=mesh,
+            ), {}
+
+        return loss_fn
+
+    batch = make_batch_sharder(mesh, rules)({"input_ids": example})
+    return TuneSetup(make_state=make_state, make_loss=make_loss,
+                     mesh=mesh, rules=rules, batch=batch,
+                     rng=jax.random.PRNGKey(2))
+
+
+def step_kwargs_of(cand: Dict[str, Any]) -> Dict[str, Any]:
+    """The ``make_train_step`` kwargs a candidate denotes — exactly
+    what ``chosen["make_train_step_kwargs"]`` carries, so a consumer
+    builds the winning step with ``make_train_step(loss_fn, mesh,
+    rules, **kwargs)`` and nothing else."""
+    return {
+        "zero_stage": int(cand.get("zero_stage", 0)),
+        "accum_steps": int(cand.get("accum_steps", 1)),
+        "latency_hiding": bool(cand.get("latency_hiding", False)),
+        "donate": bool(cand.get("donate", True)),
+        "compiler_options": cand.get("compiler_options") or None,
+    }
+
+
+def gate_report(report: dict, gates: Dict[str, Any]) -> List[str]:
+    """Human-readable gate violations for one candidate's lint report
+    (empty = accepted). Mirrors the hlo_lint budget wording so CI
+    output reads the same in both stages."""
+    reasons: List[str] = []
+    max_remat = int(gates.get("max_involuntary_remat", 0))
+    got_remat = int(report.get("involuntary_remat", 0))
+    if got_remat > max_remat:
+        reasons.append(
+            f"involuntary_remat: {got_remat} > gate {max_remat}")
+    max_bwd_ag = gates.get("max_backward_all_gather")
+    if max_bwd_ag is not None:
+        got = int(report.get("backward", {}).get("all-gather", 0))
+        if got > int(max_bwd_ag):
+            reasons.append(
+                f"backward all-gather: {got} > gate {max_bwd_ag}")
+    max_bytes = gates.get("max_collective_bytes")
+    if max_bytes is not None:
+        got_b = int(report.get("total_collective_bytes", 0))
+        if got_b > int(max_bytes):
+            reasons.append(
+                f"total_collective_bytes: {got_b} > gate {max_bytes}")
+    return reasons
+
+
+def stub_cost_ms(report: dict, cand: Dict[str, Any]) -> float:
+    """The deterministic surrogate the CI ranking runs on: bytes moved
+    by collectives dominate, op count (dispatch overhead) and any
+    involuntary remat (a full re-partition round trip) penalize. Pure
+    function of the compiled program + candidate — same inputs, same
+    ranking, which is what lets ci/autotune/ pin the chosen config."""
+    n_ops = sum(report.get("collectives", {}).values())
+    return round(
+        report.get("total_collective_bytes", 0) / 1e6
+        + 0.05 * n_ops
+        + 5.0 * report.get("involuntary_remat", 0),
+        6,
+    )
+
+
+def time_step_wall(step, state, batch, rng, repeat: int = 5) -> float:
+    """Min-of-N wall-clock step time in ms (one warmup/compile call
+    outside the timed region; min is the contention-free estimate)."""
+    import jax
+
+    new_state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics)
+    best = float("inf")
+    for _ in range(max(1, repeat)):
+        t0 = time.perf_counter()
+        new_state, metrics = step(new_state, batch, rng)
+        jax.block_until_ready(metrics)
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return round(best, 3)
+
+
+def evaluate_candidate(
+    setup: TuneSetup,
+    cand: Dict[str, Any],
+    gates: Dict[str, Any],
+    timer: str = "stub",
+    repeat: int = 5,
+) -> Dict[str, Any]:
+    """Build + compile one candidate, lint-gate it, time it if it
+    survives. Never raises on a candidate's own failure — a candidate
+    that cannot compile is a *result* (status "compile_error"), not an
+    abort of the sweep."""
+    import flax.linen as nn
+
+    from k8s_tpu.train import make_train_step
+
+    entry: Dict[str, Any] = {"config": dict(cand), "status": "ok",
+                             "reasons": []}
+    try:
+        state = setup.make_state(cand)
+        loss_fn = setup.make_loss(cand)
+        step = make_train_step(
+            loss_fn, setup.mesh, setup.rules, **step_kwargs_of(cand)
+        )
+        with nn.logical_axis_rules(setup.rules.to_flax()):
+            # the aot gate: lower+compile of the EXACT program the step
+            # would run (compiler options included via the AOT path)
+            with capture_stderr() as cap:
+                compiled = step.jitted.compiled(
+                    state, setup.batch, setup.rng)
+        report = lint_compiled(compiled, setup.mesh, cap.text)
+    except Exception as e:  # noqa: BLE001 — candidate, not harness, failed
+        entry["status"] = "compile_error"
+        entry["reasons"] = [f"{type(e).__name__}: {e}"]
+        return entry
+    entry["lint"] = {
+        "collectives": report["collectives"],
+        "backward": report["backward"],
+        "involuntary_remat": report["involuntary_remat"],
+        "total_collective_bytes": report["total_collective_bytes"],
+    }
+    reasons = gate_report(report, gates or {})
+    if reasons:
+        entry["status"] = "rejected"
+        entry["reasons"] = reasons
+        return entry
+    if timer == "stub":
+        entry["step_time_ms"] = stub_cost_ms(report, cand)
+    else:
+        with nn.logical_axis_rules(setup.rules.to_flax()):
+            entry["step_time_ms"] = time_step_wall(
+                step, state, setup.batch, setup.rng, repeat=repeat)
+    return entry
+
+
+def run_grid(
+    grid: Dict[str, Any],
+    setup: Optional[TuneSetup] = None,
+    timer: str = "stub",
+    repeat: int = 5,
+) -> Dict[str, Any]:
+    """Sweep the grid and return the ranked artifact."""
+    setup = setup or _standin_setup(grid)
+    gates = grid.get("gates", {})
+    candidates = [
+        evaluate_candidate(setup, cand, gates, timer=timer, repeat=repeat)
+        for cand in expand_grid(grid)
+    ]
+    accepted = [c for c in candidates if c["status"] == "ok"]
+    # stable sort: equal times keep grid order (deterministic ties)
+    accepted.sort(key=lambda c: c["step_time_ms"])
+    for i, c in enumerate(accepted):
+        c["rank"] = i
+    artifact: Dict[str, Any] = {
+        "grid": grid,
+        "timer": timer,
+        "mesh": {k: int(v) for k, v in setup.mesh.shape.items()},
+        "candidates": candidates,
+        "n_accepted": len(accepted),
+        "n_rejected": sum(c["status"] == "rejected" for c in candidates),
+        "n_compile_error": sum(
+            c["status"] == "compile_error" for c in candidates),
+    }
+    if accepted:
+        best = accepted[0]
+        artifact["chosen"] = {
+            "config": best["config"],
+            "step_time_ms": best["step_time_ms"],
+            "collectives": best["lint"]["collectives"],
+            "backward": best["lint"]["backward"],
+            "make_train_step_kwargs": step_kwargs_of(best["config"]),
+        }
+    return artifact
+
+
+# ---------------------------------------------------------------------------
+# Golden check (ci/autotune/)
+# ---------------------------------------------------------------------------
+
+
+def _cand_key(config: Dict[str, Any]) -> str:
+    return json.dumps(config, sort_keys=True)
+
+
+def check_artifact(artifact: dict, golden: dict) -> List[str]:
+    """Readable diffs between a fresh sweep and the committed golden.
+    Pins: the chosen config, its collective signature, its surrogate
+    cost (25% headroom — the hlo-budget bytes policy), and every
+    candidate's accept/reject status. Times of non-chosen candidates
+    and raw byte counts float free."""
+    diffs: List[str] = []
+    got_chosen = artifact.get("chosen", {})
+    want_chosen = golden.get("chosen", {})
+    if got_chosen.get("config") != want_chosen.get("config"):
+        diffs.append(
+            "chosen config changed: "
+            f"{_cand_key(got_chosen.get('config', {}))} != golden "
+            f"{_cand_key(want_chosen.get('config', {}))}")
+    for sig in ("collectives", "backward"):
+        if got_chosen.get(sig) != want_chosen.get(sig):
+            diffs.append(
+                f"chosen {sig} signature changed: {got_chosen.get(sig)} "
+                f"!= golden {want_chosen.get(sig)}")
+    want_t = want_chosen.get("step_time_ms")
+    got_t = got_chosen.get("step_time_ms")
+    if want_t is not None and got_t is not None and got_t > want_t * 1.25:
+        diffs.append(
+            f"chosen step_time_ms regressed: {got_t} > {want_t} * 1.25")
+    want_status = {
+        _cand_key(c["config"]): c["status"]
+        for c in golden.get("candidates", [])
+    }
+    got_status = {
+        _cand_key(c["config"]): c["status"]
+        for c in artifact.get("candidates", [])
+    }
+    for key in sorted(set(want_status) | set(got_status)):
+        g, w = got_status.get(key, "MISSING"), want_status.get(key, "MISSING")
+        if g != w:
+            diffs.append(f"candidate {key}: status {g} != golden {w}")
+    return diffs
+
+
+def artifact_path(artifact_dir: str, name: str) -> str:
+    return os.path.join(artifact_dir, f"{name}.json")
+
+
+def save_artifact(path: str, artifact: dict) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser("autotune")
+    ap.add_argument("--grid", default="standin",
+                    help="named grid (%s) or a path to a grid JSON"
+                         % "/".join(sorted(GRIDS)))
+    ap.add_argument("--timer", choices=("stub", "wall"), default="stub")
+    ap.add_argument("--repeat", type=int, default=5,
+                    help="N for the wall timer's min-of-N")
+    ap.add_argument("--out", default="",
+                    help="write the ranked artifact here")
+    ap.add_argument("--check", action="store_true",
+                    help="diff against the committed golden "
+                         "(ci/autotune/<grid>-grid-cpu8.json)")
+    ap.add_argument("--write-golden", action="store_true",
+                    help="(re)write the golden from this run")
+    ap.add_argument("--golden-dir", default=DEFAULT_ARTIFACT_DIR)
+    args = ap.parse_args(argv)
+
+    # virtual CPU mesh before first device query (hlo_lint's approach)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    if args.grid in GRIDS:
+        grid_name, grid = args.grid, GRIDS[args.grid]
+    else:
+        with open(args.grid) as f:
+            grid = json.load(f)
+        grid_name = os.path.splitext(os.path.basename(args.grid))[0]
+
+    artifact = run_grid(grid, timer=args.timer, repeat=args.repeat)
+    if args.out:
+        save_artifact(args.out, artifact)
+    golden_path = artifact_path(args.golden_dir, f"{grid_name}-grid-cpu8")
+    if args.write_golden:
+        save_artifact(golden_path, artifact)
+        print(json.dumps({"grid": grid_name, "wrote": golden_path,
+                          "chosen": artifact.get("chosen", {}).get("config"),
+                          "n_accepted": artifact["n_accepted"],
+                          "n_rejected": artifact["n_rejected"]}))
+        return 0
+    summary = {
+        "grid": grid_name,
+        "timer": args.timer,
+        "chosen": artifact.get("chosen", {}).get("config"),
+        "chosen_step_time_ms": artifact.get("chosen", {}).get("step_time_ms"),
+        "n_accepted": artifact["n_accepted"],
+        "n_rejected": artifact["n_rejected"],
+        "n_compile_error": artifact["n_compile_error"],
+    }
+    if not args.check:
+        print(json.dumps(summary))
+        return 0
+    if not os.path.exists(golden_path):
+        summary["golden"] = "MISSING"
+        summary["hint"] = (
+            f"run: python -m k8s_tpu.tools.autotune --grid {grid_name} "
+            f"--write-golden")
+        print(json.dumps(summary))
+        return 1
+    with open(golden_path) as f:
+        golden = json.load(f)
+    diffs = check_artifact(artifact, golden)
+    summary["golden"] = "FAIL" if diffs else "ok"
+    summary["diffs"] = diffs
+    print(json.dumps(summary))
+    for d in diffs:
+        print(f"AUTOTUNE GOLDEN DIFF [{grid_name}]: {d}", file=sys.stderr)
+    return 1 if diffs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -584,7 +584,11 @@ def _standin_compile(strategy: str):
     from k8s_tpu.train import create_sharded_state, make_train_step
 
     devices = jax.devices()[:8]
-    zero1 = strategy.startswith("zero1")
+    zero_stage = 0
+    if strategy.startswith("zero"):
+        zero_stage = int(strategy[4])
+    accum_steps = 1
+    state_kwargs: dict = {}
     if strategy == "fsdp-tp-sp":
         mesh = build_mesh(MeshConfig(data=-1, fsdp=2, seq=2, tensor=2),
                           devices=devices)
@@ -613,6 +617,30 @@ def _standin_compile(strategy: str):
         rules = LogicalRules(LogicalRules.FSDP)
         cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=32,
                                attention="flash", mesh=mesh)
+    elif strategy == "zero2-dp":
+        # ZeRO-2 under gradient accumulation (the stage's whole point):
+        # the f32 accum carry is BORN in the 1/DP layout (the seed pins
+        # before the f32 cast) and the per-microbatch sync feeds the
+        # sharded accumulator inside the scan — the budget pins the
+        # accum-schedule collective counts so a replicated accumulator
+        # (an extra gather/slice pair at the optimizer boundary) or a
+        # backward all-gather fails CI
+        mesh = build_mesh(MeshConfig(data=8), devices=devices)
+        rules = LogicalRules(LogicalRules.DP)
+        cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=32,
+                               attention="flash")
+        accum_steps = 2
+    elif strategy == "zero3-dp":
+        # selective ZeRO-3: embedding + lm_head params live 1/DP — the
+        # budget pins EXACTLY one forward all-gather per sharded leaf
+        # (the just-in-time gather at first use; the epilogue gathers
+        # for those leaves disappear) and zero backward all-gathers (a
+        # backward gather = the remat'd forward re-gathering the leaf)
+        mesh = build_mesh(MeshConfig(data=8), devices=devices)
+        rules = LogicalRules(LogicalRules.DP)
+        cfg = LlamaConfig.tiny(num_heads=4, num_kv_heads=2, head_dim=32,
+                               attention="flash")
+        state_kwargs = {"zero3_leaves": ["embedding", "lm_head"]}
     else:
         raise ValueError(f"unknown stand-in strategy {strategy!r}")
 
@@ -621,7 +649,7 @@ def _standin_compile(strategy: str):
     example = jnp.zeros((batch, seq), jnp.int32)
     state = create_sharded_state(
         model, optax.adamw(1e-3), mesh, rules, jax.random.PRNGKey(0), example,
-        zero1=zero1,
+        zero_stage=zero_stage, **state_kwargs,
     )
 
     if strategy == "pp-fsdp":
@@ -641,7 +669,8 @@ def _standin_compile(strategy: str):
                 mesh=mesh,
             ), {}
 
-    step = make_train_step(loss_fn, mesh, rules, zero1=zero1)
+    step = make_train_step(loss_fn, mesh, rules, zero_stage=zero_stage,
+                           accum_steps=accum_steps)
     import flax.linen as nn
 
     from k8s_tpu.train import make_batch_sharder
@@ -672,6 +701,12 @@ STANDIN_CONFIGS = {
     # to the DP axis by the parser (aot_check --lint covers those).
     "standin-zero1-dp-cpu8": lambda: _standin_compile("zero1-dp"),
     "standin-zero1-fsdp-cpu8": lambda: _standin_compile("zero1-fsdp"),
+    # ZeRO-2/3 (ISSUE 17): stage 2 pins the accum_steps=2 schedule —
+    # the f32 carry sharded 1/DP from birth; stage 3 pins the
+    # just-in-time forward gathers of the selectively sharded
+    # embedding/lm_head leaves + zero backward all-gathers
+    "standin-zero2-dp-cpu8": lambda: _standin_compile("zero2-dp"),
+    "standin-zero3-dp-cpu8": lambda: _standin_compile("zero3-dp"),
 }
 
 

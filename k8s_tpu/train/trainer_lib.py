@@ -63,6 +63,21 @@ def init_sharded_variables(init_fn, mesh: Mesh, rules: LogicalRules):
     return variables, unboxed_shardings
 
 
+def _resolve_zero_stage(zero1: bool, zero_stage: Optional[int]) -> int:
+    """Normalize the (legacy ``zero1`` bool, ``zero_stage`` int) pair to
+    one stage 0..3. ``zero1=True`` alone means stage 1; an explicit
+    ``zero_stage`` wins (stages are cumulative: 2 and 3 imply the
+    sharded optimizer state of 1)."""
+    if zero_stage is None:
+        return 1 if zero1 else 0
+    stage = int(zero_stage)
+    if not 0 <= stage <= 3:
+        raise ValueError(f"zero_stage must be 0..3, got {zero_stage}")
+    if zero1 and stage == 0:
+        return 1
+    return stage
+
+
 def create_sharded_state(
     model: nn.Module,
     optimizer: optax.GradientTransformation,
@@ -72,6 +87,9 @@ def create_sharded_state(
     example_batch: Any,
     init_kwargs: Optional[dict] = None,
     zero1: bool = False,
+    zero_stage: Optional[int] = None,
+    zero3_min_leaf_size: int = 0,
+    zero3_leaves: Optional[Any] = None,
 ) -> TrainState:
     """Initialize a TrainState with every leaf placed per the rules.
 
@@ -79,13 +97,24 @@ def create_sharded_state(
     host-side full materialization); optimizer state inherits the
     params' layout through GSPMD propagation.
 
-    ``zero1=True`` lays the optimizer state out in the ZeRO-1 layout
-    instead: every params-shaped moment leaf additionally sharded over
-    the ``data`` mesh axis (parallel.sharding.zero1_shardings), 1/DP
-    bytes per device. Pair with ``make_train_step(zero1=True)`` — the
-    step keeps the layout through the update (docs/PERF.md).
+    ``zero1=True`` (equivalently ``zero_stage=1``) lays the optimizer
+    state out in the ZeRO-1 layout instead: every params-shaped moment
+    leaf additionally sharded over the ``data`` mesh axis
+    (parallel.sharding.zero1_shardings), 1/DP bytes per device. Pair
+    with ``make_train_step(zero1=True)`` — the step keeps the layout
+    through the update (docs/PERF.md).
+
+    ``zero_stage=2`` places state identically to stage 1 (the stage-2
+    delta — no replicated f32 gradient tree — lives in the train step).
+    ``zero_stage=3`` additionally shards the params THEMSELVES for the
+    selected leaves (``zero3_leaves`` path substrings and/or
+    ``zero3_min_leaf_size`` element-count threshold —
+    parallel.sharding.zero3_param_shardings): those leaves and their
+    moments live 1/DP per device and the step all-gathers them
+    just-in-time in the forward. Stages are cumulative.
     """
     init_kwargs = init_kwargs or {}
+    stage = _resolve_zero_stage(zero1, zero_stage)
 
     def boxed_init():
         return model.init(rng, example_batch, **init_kwargs)
@@ -96,10 +125,27 @@ def create_sharded_state(
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     param_shardings = unboxed_shardings["params"]
+    if stage >= 3:
+        from k8s_tpu.parallel.sharding import zero3_param_shardings
+
+        z3 = zero3_param_shardings(
+            params, mesh,
+            min_leaf_size=zero3_min_leaf_size, leaves=zero3_leaves,
+        )
+        # re-place the selected leaves into their sharded layout; the
+        # rest keep the rules placement (device_put of an
+        # already-placed leaf with its own sharding is a no-op)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s) if s is not None else x,
+            params, z3,
+        )
     opt_shardings = param_shardings
-    if zero1:
+    if stage >= 1:
         from k8s_tpu.parallel.sharding import zero1_shardings
 
+        # for a stage-3 sharded leaf the data axis is already consumed,
+        # so zero1_shardings falls back to the leaf's own (sharded)
+        # layout — moments live with their param shard in every stage
         opt_shardings = zero1_shardings(params, mesh)
 
     def build(params, batch_stats):
@@ -274,6 +320,7 @@ def make_train_step(
     donate: bool = True,
     accum_steps: int = 1,
     zero1: bool = False,
+    zero_stage: Optional[int] = None,
     latency_hiding: bool = False,
     compiler_options: Optional[Dict[str, str]] = None,
     health: bool = False,
@@ -319,6 +366,29 @@ def make_train_step(
     gather/scatter with compute (docs/PERF.md, "sharded weight
     update").
 
+    ``zero_stage`` generalizes ``zero1`` to the cumulative ZeRO ladder
+    (0 = off, 1 = ``zero1=True``; an explicit stage wins over the
+    legacy bool). **Stage 2** shards the f32 gradient-accumulation
+    carry AND the reduced gradients with no replicated f32 tree ever
+    materialized: the accumulator seed is pinned BEFORE the f32 cast
+    (stage 1 casts first, transiently materializing one full-size
+    replicated f32 gradient tree — real memory under bf16 params),
+    while the sync itself keeps the proven two-step pin: measured on
+    the zero2-dp stand-in, pinning the backward outputs straight to
+    the 1/DP layout repartitions the whole backward (11 backward
+    all-gathers + 12 all-to-alls appear), so the param-dtype grads pin
+    to the param layout first and the param→zero1 transition at the
+    optimizer boundary renders as reduce-scatter on TPU (CPU
+    stand-ins: all-reduce + slice) feeding the sharded accumulator.
+    **Stage 3** consumes params already selectively sharded by
+    ``create_sharded_state(zero_stage=3, ...)``: the step reads each
+    leaf's layout off the state argument, so sharded leaves keep their
+    1/DP placement through the update epilogue (no gather — the
+    epilogue re-pins params to their OWN layout) and the forward
+    all-gathers them just-in-time at first use; grad sync for those
+    leaves reduce-scatters into the shard. The HLO-budget goldens
+    (ci/hlo_budgets/standin-zero{2,3}-dp-cpu8.json) pin both schedules.
+
     ``health=True`` adds a fused on-device numerics-health block to the
     step's metrics (docs/OBSERVABILITY.md, "Training health"):
     ``grad_norm`` (global L2 of the final gradients, f32), ``nonfinite_grads``
@@ -342,6 +412,7 @@ def make_train_step(
     extra XLA options the same way.
     """
     shard_batch = make_batch_sharder(mesh, rules)
+    stage = _resolve_zero_stage(zero1, zero_stage)
     opts: Optional[Dict[str, str]] = None
     if latency_hiding or compiler_options:
         on_tpu = mesh.devices.flat[0].platform == "tpu"
@@ -390,6 +461,13 @@ def make_train_step(
                 return grads
             flat, treedef = jax.tree_util.tree_flatten(grads)
             if flat_param_shardings is not None:
+                # the param-layout pin stays in EVERY stage: measured on
+                # the zero2-dp stand-in, pinning backward outputs
+                # straight to the 1/DP layout repartitions the whole
+                # backward around it (11 backward all-gathers + 12
+                # all-to-alls vs zero) — stage 2's no-replicated-f32
+                # guarantee instead comes from pinning BEFORE the f32
+                # cast, so only the param-DTYPE sync tree is transient
                 flat = [_pin(g, s)
                         for g, s in zip(flat, flat_param_shardings)]
             flat = [_pin(g, s) for g, s in zip(flat, flat_grad_shardings)]
@@ -448,7 +526,15 @@ def make_train_step(
                 # can keep a ZeRO accumulator replicated through all
                 # accum_steps iterations — accum_steps× the memory and
                 # an involuntary reshard at the optimizer boundary
-                g0 = constrain_grads(to_f32(g_first))
+                if stage >= 2:
+                    # stage-2 contract: the f32 accumulator is BORN in
+                    # the 1/DP layout — pin the param-dtype grads
+                    # first, cast after (convert preserves the operand
+                    # sharding), so the replicated full-size f32 tree
+                    # of the cast-then-pin order never exists
+                    g0 = to_f32(constrain_grads(g_first))
+                else:
+                    g0 = constrain_grads(to_f32(g_first))
 
                 def body(carry, mb):
                     g_acc, l_acc, aux_acc, i = carry
@@ -570,7 +656,7 @@ def make_train_step(
         if key not in jit_cache:
             if not any(key):
                 jit_cache[key] = make_step(None)
-            elif zero1:
+            elif stage:
                 from k8s_tpu.parallel.sharding import zero1_sharding
 
                 z1 = tuple(

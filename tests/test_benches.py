@@ -317,5 +317,25 @@ class TestBenches:
         out = _last_json_line(capsys)
         assert out["value"] > 0 and out["mode"] == "smoke"
         assert out["zero1"] is True
+        # legacy bool normalizes to the stage ladder (ISSUE 17)
+        assert out["zero_stage"] == 1
         hbm = out["hbm_bytes_per_device"]
         assert hbm["params"] > 0 and hbm["opt_state"] > 0
+
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_llama_bench_smoke_zero_stage_shape(self, capsys, stage):
+        """--zero-stage {2,3} --smoke keeps the full JSON line shape
+        (the bench.py llama_zero2_*/llama_zero3_* rows parse the same
+        keys) on whatever CPU device count the session forced — the
+        stage must be reported and the hbm block must still price
+        params/grads/opt_state."""
+        from benches import llama_bench
+
+        assert llama_bench.main(["--smoke", "--zero-stage", str(stage)]) == 0
+        out = _last_json_line(capsys)
+        assert out["value"] > 0 and out["mode"] == "smoke"
+        assert out["zero_stage"] == stage and out["zero1"] is True
+        hbm = out["hbm_bytes_per_device"]
+        for k in ("params", "grads", "opt_state", "source"):
+            assert k in hbm, k
+        assert hbm["params"] > 0 and hbm["grads"] > 0

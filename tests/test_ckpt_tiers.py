@@ -540,6 +540,85 @@ class TestLayoutReshard:
         assert restored["mu"].sharding == z1["mu"].sharding
 
 
+class TestCrossStageReshard:
+    """Cross-ZeRO-stage restore matrix (ISSUE 17 satellite): a
+    checkpoint written by a run at one zeroStage restored into a
+    template of ANY other stage must land in the template's placement
+    via the same covering_plan/union_covering_plan geometry the zero1
+    tests above pin — no stage-specific restore code. The stage only
+    changes which leaves are sharded: stage >= 1 shards the opt moments
+    (the f32 accum carry is transient, so a stage-2 checkpoint is
+    byte-identical to a stage-1 one), stage 3 additionally shards the
+    selected PARAM leaves — the new direction this matrix covers."""
+
+    class FakePersistent:
+        def latest_step(self):
+            return None
+
+        def restore(self, template, step=None):
+            return None
+
+    def _stage_tree(self, mesh, stage):
+        """The smallest state tree whose layouts distinguish the
+        stages: one (selected) param leaf and one opt-moment leaf."""
+        p = (jnp.arange(16, dtype=jnp.float32) + 1.0).reshape(8, 2)
+        mu = (jnp.arange(16, dtype=jnp.float32) * 3.0).reshape(8, 2)
+        pspec = P("data", None) if stage >= 3 else P()
+        mspec = P("data", None) if stage >= 1 else P()
+        return {
+            "params": {"w": jax.device_put(
+                p, NamedSharding(mesh, pspec))},
+            "mu": {"w": jax.device_put(
+                mu, NamedSharding(mesh, mspec))},
+        }
+
+    @pytest.mark.parametrize(
+        "save_stage,restore_stage",
+        [(s, r) for s in range(4) for r in range(4) if s != r],
+    )
+    def test_cross_stage_restore_matrix(self, tmp_path, save_stage,
+                                        restore_stage):
+        mesh = small_mesh()
+        saved = self._stage_tree(mesh, save_stage)
+        target = self._stage_tree(mesh, restore_stage)
+        tier = LocalTier(str(tmp_path), host_id=0, sync=True)
+        tier.save(3, saved)
+        planner = RestorePlanner(tier, self.FakePersistent())
+        restored, plan = planner.restore(template_of(target))
+        assert plan.source == SOURCE_LOCAL and plan.step == 3
+        assert_tree_equal(restored, saved)
+        for got, want in zip(jax.tree_util.tree_leaves(restored),
+                             jax.tree_util.tree_leaves(target)):
+            assert got.sharding == want.sharding
+
+    def test_multihost_zero3_params_into_stage1_template(self, tmp_path):
+        """The multi-host direction stage 3 adds: each virtual host
+        checkpoints only ITS tile of the sharded param leaf, so a
+        stage-1 (replicated-params) restore needs the union of both
+        manifests — own tile + peer tile over the transport, exactly
+        the union_covering_plan path the zero1 opt-state reshard rides."""
+        mesh = small_mesh()
+        saved = self._stage_tree(mesh, 3)
+        target = self._stage_tree(mesh, 1)
+        devs = list(mesh.devices.flat)
+        LocalTier(str(tmp_path), host_id=0, sync=True,
+                  devices=devs[:2]).save(13, saved)
+        LocalTier(str(tmp_path), host_id=1, sync=True,
+                  devices=devs[2:]).save(13, saved)
+        planner = RestorePlanner(
+            LocalTier(str(tmp_path), host_id=0, sync=True),
+            self.FakePersistent(),
+            transport=FilesystemPeerTransport(str(tmp_path), self_host=0))
+        restored, plan = planner.restore(template_of(target))
+        assert plan.source == SOURCE_LOCAL_PEER and plan.step == 13
+        assert plan.tiled, "param leaf must be tiled across manifests"
+        assert plan.peer_fetches > 0
+        assert_tree_equal(restored, saved)
+        assert restored["params"]["w"].sharding == \
+            target["params"]["w"].sharding
+        assert restored["mu"]["w"].sharding == target["mu"]["w"].sharding
+
+
 class TestRestPeerWire:
     def test_steps_manifest_and_shard_roundtrip(self, tmp_path):
         mesh = small_mesh()

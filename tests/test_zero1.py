@@ -377,7 +377,9 @@ class TestZero1SpecPlumbing:
 
         spec = TrainingSpec(zero1=True, latency_hiding=True)
         spec.validate()
+        # the legacy bool resolves to stage 1 on the wire (ISSUE 17)
         assert spec.to_env() == {"KTPU_ZERO1": "1",
+                                 "KTPU_ZERO_STAGE": "1",
                                  "KTPU_LATENCY_HIDING": "1"}
         assert TrainingSpec().to_env() == {}
         with pytest.raises(ValidationError):
@@ -489,4 +491,7 @@ class TestZero1SpecPlumbing:
         job.spec.set_defaults()
         job.spec.validate()
         assert job.spec.training is not None
+        # the example declares zeroStage: 2; set_defaults keeps the
+        # legacy bool in sync for pre-zeroStage consumers
+        assert job.spec.training.zero_stage == 2
         assert job.spec.training.zero1 is True
