@@ -1,0 +1,523 @@
+"""sched_bench: deterministic cluster-scale control-plane simulator.
+
+A discrete-event simulator that replays thousand-job traces against
+the REAL control-plane code — :class:`k8s_tpu.sched.ClusterScheduler`
++ :class:`k8s_tpu.sched.SliceInventory` make every placement decision,
+and the event-driven arm drives the REAL
+:class:`k8s_tpu.controller.workqueue.CoalescingWorkQueue` (the
+reconciler core's spine) on a virtual clock via its non-blocking
+``pop_ready``/``next_ready_at`` surface. Nothing is mocked at the
+decision layer; only time and the data plane (pods actually running)
+are simulated.
+
+Headline A/B (docs/BENCHMARKS.md): control-plane work — reconcile
+invocations + worker-status HTTP calls + scheduler ticks per simulated
+minute — under two control planes over the SAME trace:
+
+- ``sweep``  : the pre-O(1000) design. One reconcile per live job per
+  ``reconcile_interval`` (8s) whether anything changed or not, a
+  scheduler pass every ``sched_interval`` (1s), and obs-enabled jobs
+  polled host-by-host each reconcile.
+- ``event``  : the event-driven core. Reconciles fire on informer
+  kicks (admission, gang finish) + the requeue policy
+  (:meth:`k8s_tpu.trainer.training.TrainingJob._requeue_delay` —
+  transitional phases 1s, obs/serving polling needs keep the interval,
+  quiescent RUNNING jobs only at the 300s resync backstop), scheduler
+  ticks on job/capacity kicks + a 30s backstop, and obs heartbeats are
+  PUSHED by workers instead of polled.
+
+Determinism is a hard contract: the trace generator is seeded, the
+virtual clock never reads wall time, and replay touches no RNG — same
+seed ⇒ byte-identical trace (sha256 digest) ⇒ identical summary
+(``tests/test_benches.py`` enforces it; CI replays the committed
+``ci/sched_bench/trace_200.json`` against golden budgets).
+
+Usage:
+  python benches/sched_bench.py                         # 1000 jobs
+  python benches/sched_bench.py --smoke                 # 200-job CI arm
+  python benches/sched_bench.py --make-trace t.json --jobs 200
+  python benches/sched_bench.py --trace t.json --golden golden.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import heapq
+import json
+import math
+import sys
+from typing import Dict, List, Optional
+
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from k8s_tpu.controller.workqueue import CoalescingWorkQueue
+from k8s_tpu.sched import ClusterScheduler, Footprint, JobRequest, SliceInventory
+
+ACCEL = "v5e-16"
+CHIPS_PER_SLICE = 4
+RECONCILE_INTERVAL = 8.0     # the sweep baseline's fixed ticker
+SCHED_INTERVAL = 1.0         # the sweep baseline's scheduler period
+SCHED_BACKSTOP = 30.0        # event mode: kicks carry the deltas
+RESYNC_SECONDS = 300.0       # event mode: quiescent-job backstop
+TRANSITIONAL_REQUEUE = 1.0   # event mode: CREATING poll cadence
+CKPT_PERIOD = 60.0           # progress checkpointed every 60s of run
+HEARTBEAT_PERIOD = 5.0       # pushed-heartbeat cadence per host
+PREEMPTION_COOLDOWN = 5.0
+
+
+# ---------------------------------------------------------------- trace
+
+def make_trace(jobs: int, seed: int, horizon_s: float,
+               arrival_s: float, obs_frac: float = 0.0) -> dict:
+    """Seeded trace: arrivals, footprints, priorities, durations. The
+    fleet is sized to ~35% of total demanded slices so a queue forms,
+    preemptions happen (10% of jobs are non-preemptible priority-1),
+    and admissions churn as gangs finish."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    total_slices = 0
+    for i in range(jobs):
+        slices = rng.choice((1, 1, 1, 2, 2, 4))
+        total_slices += slices
+        prio = 1 if rng.random() < 0.10 else 0
+        out.append({
+            "name": f"job-{i:04d}",
+            "arrival": round(rng.uniform(0.0, arrival_s), 3),
+            "slices": slices,
+            # long-lived gangs: after the arrival wave the fleet is a
+            # big, mostly-QUIESCENT running population — the regime
+            # where per-job polling burns the most for the least
+            "duration": round(rng.uniform(0.50, 1.20) * horizon_s, 3),
+            "creation": round(rng.uniform(5.0, 15.0), 3),
+            "priority": prio,
+            "queue": "prod" if prio else "default",
+            "preemptible": prio == 0,
+            "obs_hosts": slices if rng.random() < obs_frac else 0,
+        })
+    out.sort(key=lambda j: (j["arrival"], j["name"]))
+    fleet = max(4, int(math.ceil(0.75 * total_slices)))
+    return {"seed": seed, "horizon_s": horizon_s,
+            "fleet": {ACCEL: fleet}, "jobs": out}
+
+
+def trace_digest(trace: dict) -> str:
+    blob = json.dumps(trace, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ------------------------------------------------------------- simulator
+
+QUEUED, CREATING, RUNNING, DONE = "Queued", "Creating", "Running", "Done"
+
+
+class _Job:
+    __slots__ = ("name", "key", "arrival", "slices", "duration",
+                 "creation", "priority", "queue", "preemptible",
+                 "obs_hosts", "phase", "epoch", "remaining",
+                 "create_done_at", "run_started_at", "finish_at",
+                 "admitted_at", "useful_s", "preemptions")
+
+    def __init__(self, spec: dict):
+        self.name = spec["name"]
+        self.key = f"default/{spec['name']}"
+        self.arrival = float(spec["arrival"])
+        self.slices = int(spec["slices"])
+        self.duration = float(spec["duration"])
+        self.creation = float(spec["creation"])
+        self.priority = int(spec["priority"])
+        self.queue = spec["queue"]
+        self.preemptible = bool(spec["preemptible"])
+        self.obs_hosts = int(spec.get("obs_hosts", 0))
+        self.phase = QUEUED
+        self.epoch = 0            # invalidates stale finish/reconcile events
+        self.remaining = self.duration
+        self.create_done_at = 0.0
+        self.run_started_at = 0.0
+        self.finish_at = 0.0
+        self.admitted_at: Optional[float] = None
+        self.useful_s = 0.0
+        self.preemptions = 0
+
+
+class _Clock:
+    __slots__ = ("now",)
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _percentile(vals: List[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, int(math.ceil(p / 100.0 * len(s))) - 1)
+    return s[max(0, idx)]
+
+
+def simulate(trace: dict, mode: str) -> dict:
+    """Replay one trace under one control-plane mode. Fully
+    deterministic: no RNG, no wall clock."""
+    assert mode in ("sweep", "event")
+    event_mode = mode == "event"
+    horizon = float(trace["horizon_s"])
+    fleet = {k: int(v) for k, v in trace["fleet"].items()}
+    capacity = sum(fleet.values())
+    clock = _Clock()
+    jobs: Dict[str, _Job] = {}
+    for spec in trace["jobs"]:
+        j = _Job(spec)
+        jobs[j.key] = j
+
+    def cost_fn(key: str) -> int:
+        j = jobs.get(key)
+        if j is None or j.phase != RUNNING:
+            return 0
+        return int((clock.now - j.run_started_at) % CKPT_PERIOD)
+
+    sched = ClusterScheduler(
+        SliceInventory(fleet), clock=clock, cost_fn=cost_fn,
+        preemption_cooldown=PREEMPTION_COOLDOWN)
+    wq = CoalescingWorkQueue(clock=clock) if event_mode else None
+
+    # counters
+    c = {"reconciles": 0, "status_calls": 0, "sched_ticks": 0,
+         "heartbeats_in": 0, "preemptions": 0, "finished": 0,
+         "admitted": 0}
+    admission_lat: List[float] = []
+    util_area = 0.0
+    goodput_area = 0.0
+    used_slices = 0
+    last_change = 0.0
+
+    events: List[tuple] = []  # (time, seq, kind, payload)
+    seq = [0]
+
+    def push(t: float, kind: str, payload=None):
+        seq[0] += 1
+        heapq.heappush(events, (t, seq[0], kind, payload))
+
+    next_sched_at = [math.inf]
+
+    def schedule_sched(t: float):
+        if t < next_sched_at[0]:
+            next_sched_at[0] = t
+            push(t, "sched", None)
+
+    def account_used(delta: int):
+        nonlocal util_area, used_slices, last_change
+        util_area += used_slices * (clock.now - last_change)
+        last_change = clock.now
+        used_slices += delta
+
+    def request_of(j: _Job) -> JobRequest:
+        return JobRequest(
+            key=j.key,
+            footprint=Footprint(ACCEL, slices=j.slices,
+                                chips=j.slices * CHIPS_PER_SLICE),
+            priority=j.priority, queue=j.queue,
+            preemptible=j.preemptible)
+
+    def start_creating(j: _Job):
+        j.phase = CREATING
+        j.epoch += 1
+        if j.admitted_at is None:
+            j.admitted_at = clock.now
+            admission_lat.append(clock.now - j.arrival)
+        c["admitted"] += 1
+        j.create_done_at = clock.now + j.creation
+        account_used(j.slices)
+        if event_mode:
+            wq.add(j.key)  # the spawn's first kick
+        else:
+            push(clock.now, "reconcile", (j.key, j.epoch))
+
+    def preempt(j: _Job):
+        # the scheduler's tick already moved the charge; mirror the
+        # data-plane consequences: lose un-checkpointed progress
+        c["preemptions"] += 1
+        j.preemptions += 1
+        if j.phase == RUNNING:
+            elapsed = clock.now - j.run_started_at
+            lost = elapsed % CKPT_PERIOD
+            j.useful_s += elapsed - lost
+            j.remaining -= (elapsed - lost)
+        j.phase = QUEUED
+        j.epoch += 1  # cancels finish + periodic reconciles
+        account_used(-j.slices)
+        if event_mode:
+            wq.discard(j.key)
+
+    def reconcile(j: _Job) -> Optional[float]:
+        """One reconcile pass: observe the simulated data plane, drive
+        phase transitions, return the event-mode requeue delay (the
+        mirror of TrainingJob._requeue_delay)."""
+        c["reconciles"] += 1
+        if j.phase == CREATING and clock.now >= j.create_done_at:
+            j.phase = RUNNING
+            j.run_started_at = clock.now
+            j.finish_at = clock.now + j.remaining
+            push(j.finish_at, "finish", (j.key, j.epoch))
+        if j.phase == RUNNING and j.obs_hosts and not event_mode:
+            # the sweep controller polls every worker's /healthz each
+            # tick; event mode gets pushed heartbeats instead
+            c["status_calls"] += j.obs_hosts
+        if j.phase == RUNNING and clock.now >= j.finish_at - 1e-9:
+            j.phase = DONE
+            j.useful_s += clock.now - j.run_started_at
+            c["finished"] += 1
+            account_used(-j.slices)
+            sched.remove(j.key)
+            if event_mode:
+                schedule_sched(clock.now)  # terminal kick
+            return None
+        if j.phase in (DONE, QUEUED):
+            return None
+        if j.phase == CREATING:
+            return TRANSITIONAL_REQUEUE
+        if j.obs_hosts:
+            return RECONCILE_INTERVAL  # obs window processing cadence
+        return RESYNC_SECONDS  # quiescent RUNNING: backstop only
+
+    def sched_tick():
+        c["sched_ticks"] += 1
+        result = sched.tick()
+        for p in result.preempted:
+            preempt(jobs[p.victim])
+        for req in result.admitted:
+            start_creating(jobs[req.key])
+        next_sched_at[0] = math.inf
+        if event_mode:
+            nxt = clock.now + SCHED_BACKSTOP
+            exp = sched.next_holdoff_expiry()
+            if exp is not None:
+                nxt = min(nxt, exp + 0.01)
+            schedule_sched(nxt)
+        else:
+            schedule_sched(clock.now + SCHED_INTERVAL)
+
+    # seed the event stream
+    for j in jobs.values():
+        push(j.arrival, "arrive", j.key)
+    if not event_mode:
+        schedule_sched(0.0)
+
+    while True:
+        t_heap = events[0][0] if events else math.inf
+        t_q = math.inf
+        if event_mode:
+            nra = wq.next_ready_at()
+            if nra is not None:
+                t_q = nra
+        t = min(t_heap, t_q)
+        if t > horizon or t is math.inf:
+            break
+        clock.now = t
+        # heap events first (arrivals/finishes feed the queue), then
+        # drain every due workqueue key at this instant
+        while events and events[0][0] <= t + 1e-12:
+            _, _, kind, payload = heapq.heappop(events)
+            if kind == "arrive":
+                sched.submit(request_of(jobs[payload]))
+                if event_mode:
+                    schedule_sched(clock.now)  # submit kick
+            elif kind == "sched":
+                if clock.now >= next_sched_at[0] - 1e-12:
+                    sched_tick()
+                # else: a stale entry superseded by an earlier kick
+            elif kind == "finish":
+                key, epoch = payload
+                j = jobs[key]
+                if j.epoch != epoch or j.phase != RUNNING:
+                    continue  # preempted before finishing
+                if event_mode:
+                    # the informer-fed kick: the kubelet wrote the
+                    # gang's terminal pod status, the listener maps it
+                    # to this key — no polling involved
+                    wq.add(key)
+                # sweep mode: the next periodic reconcile discovers it
+            elif kind == "reconcile":  # sweep-mode periodic ticker
+                key, epoch = payload
+                j = jobs[key]
+                if j.epoch != epoch or j.phase in (DONE, QUEUED):
+                    continue
+                reconcile(j)
+                if j.phase in (CREATING, RUNNING):
+                    push(clock.now + RECONCILE_INTERVAL,
+                         "reconcile", (key, j.epoch))
+        if event_mode:
+            while True:
+                key = wq.pop_ready()
+                if key is None:
+                    break
+                j = jobs[key]
+                delay = reconcile(j)
+                wq.done(key)
+                if delay is not None:
+                    wq.add_after(key, delay)
+
+    clock.now = horizon
+    util_area += used_slices * (clock.now - last_change)
+    for j in jobs.values():
+        if j.phase == RUNNING:
+            j.useful_s += clock.now - j.run_started_at
+        goodput_area += j.useful_s * j.slices
+        if j.admitted_at is None:
+            # censored at the horizon: a job still queued records the
+            # full wait in BOTH modes, so a mode that admits MORE jobs
+            # is never penalized on p99 for its extra (long-queued)
+            # admissions
+            admission_lat.append(horizon - j.arrival)
+    if event_mode:
+        # pushed heartbeats: one inbound POST per host per period over
+        # each job's RUNNING span (inbound work, reported separately —
+        # it replaces the polled status_calls the sweep arm pays)
+        hb = 0.0
+        for j in jobs.values():
+            if j.obs_hosts:
+                hb += j.obs_hosts * (j.useful_s / HEARTBEAT_PERIOD)
+        c["heartbeats_in"] = int(hb)
+
+    minutes = horizon / 60.0
+    work = c["reconciles"] + c["status_calls"] + c["sched_ticks"]
+    summary = dict(c)
+    summary.update({
+        "work_per_min": round(work / minutes, 3),
+        "admission_p50_s": round(_percentile(admission_lat, 50), 3),
+        "admission_p99_s": round(_percentile(admission_lat, 99), 3),
+        "utilization": round(util_area / (capacity * horizon), 4),
+        "goodput_utilization": round(
+            goodput_area / (capacity * horizon), 4),
+    })
+    if event_mode:
+        summary["queue_adds"] = wq.added
+        summary["queue_coalesced"] = wq.coalesced
+        summary["queue_requeued"] = wq.requeued
+    return summary
+
+
+def run(trace: dict) -> dict:
+    sweep = simulate(trace, "sweep")
+    event = simulate(trace, "event")
+    ratio = (sweep["work_per_min"] / event["work_per_min"]
+             if event["work_per_min"] > 0 else math.inf)
+    return {
+        "bench": "sched",
+        "jobs": len(trace["jobs"]),
+        "seed": trace.get("seed"),
+        "horizon_s": trace["horizon_s"],
+        "fleet_slices": sum(trace["fleet"].values()),
+        "trace_digest": trace_digest(trace),
+        "sweep": sweep,
+        "event": event,
+        "ab": {
+            "work_ratio": round(ratio, 2),
+            "admission_p99_delta_s": round(
+                event["admission_p99_s"] - sweep["admission_p99_s"], 3),
+        },
+    }
+
+
+def check_golden(summary: dict, golden: dict) -> List[str]:
+    """Budget gates, not exact-value pins: the trace digest must match
+    (the committed trace IS the input contract), the A/B ratio must
+    clear its floor, and the event arm must stay under its absolute
+    work ceiling + admission budget."""
+    errs = []
+    b = golden.get("budgets", {})
+    want_digest = golden.get("trace_digest")
+    if want_digest and summary["trace_digest"] != want_digest:
+        errs.append(f"trace digest {summary['trace_digest'][:12]} != "
+                    f"golden {want_digest[:12]} (regenerate the golden "
+                    f"if the committed trace changed on purpose)")
+    ratio = summary["ab"]["work_ratio"]
+    if ratio < b.get("min_work_ratio", 10.0):
+        errs.append(f"A/B work ratio {ratio} < "
+                    f"{b.get('min_work_ratio', 10.0)} floor")
+    ceil = b.get("max_event_work_per_min")
+    if ceil is not None and summary["event"]["work_per_min"] > ceil:
+        errs.append(f"event work/min {summary['event']['work_per_min']}"
+                    f" > {ceil} ceiling")
+    p99_budget = b.get("max_admission_p99_slack_s", 2.0)
+    slack = summary["ab"]["admission_p99_delta_s"]
+    if slack > p99_budget:
+        errs.append(f"event admission p99 is {slack}s WORSE than the "
+                    f"sweep baseline (> {p99_budget}s budget)")
+    return errs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="sched_bench")
+    p.add_argument("--jobs", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--horizon-min", type=float, default=60.0)
+    p.add_argument("--arrival-min", type=float, default=10.0)
+    p.add_argument("--obs-frac", type=float, default=0.0,
+                   help="fraction of jobs with an observability block "
+                        "(sweep polls their hosts; event mode gets "
+                        "pushed heartbeats)")
+    p.add_argument("--smoke", action="store_true",
+                   help="200 jobs over 20 simulated minutes (CI arm)")
+    p.add_argument("--trace", default="",
+                   help="replay a committed trace JSON instead of "
+                        "generating one")
+    p.add_argument("--make-trace", default="",
+                   help="generate + write the trace JSON and exit")
+    p.add_argument("--golden", default="",
+                   help="golden budget file; violations exit 1")
+    p.add_argument("--out", default="", help="write the summary JSON")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        args.jobs = min(args.jobs, 200)
+        args.horizon_min = min(args.horizon_min, 20.0)
+        args.arrival_min = min(args.arrival_min, 5.0)
+
+    if args.trace:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    else:
+        trace = make_trace(args.jobs, args.seed,
+                           horizon_s=args.horizon_min * 60.0,
+                           arrival_s=args.arrival_min * 60.0,
+                           obs_frac=args.obs_frac)
+    if args.make_trace:
+        with open(args.make_trace, "w") as f:
+            json.dump(trace, f, sort_keys=True, indent=1)
+            f.write("\n")
+        print(json.dumps({"bench": "sched", "mode": "make-trace",
+                          "jobs": len(trace["jobs"]),
+                          "trace_digest": trace_digest(trace)}))
+        return 0
+
+    summary = run(trace)
+    print(json.dumps(summary))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.golden:
+        with open(args.golden) as f:
+            golden = json.load(f)
+        errs = check_golden(summary, golden)
+        for e in errs:
+            print(f"SCHED BENCH BUDGET: {e}", file=sys.stderr)
+        if errs:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

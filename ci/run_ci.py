@@ -205,6 +205,47 @@ def main(argv=None) -> int:
              f"--junitxml={args.artifacts_dir}/junit_sched.xml"],
             args.artifacts_dir, cases,
         )
+        # event-driven control-plane gate (ISSUE 18): the coalescing
+        # work queue's dirty/processing semantics, per-key backoff,
+        # informer material-change listeners + RESYNC, the idle-scaling
+        # regression (N quiescent jobs ⇒ O(1) reconcile work, asserted
+        # on the new counters), and the pushed-heartbeat path. Always
+        # on and fast: a coalescing bug (a lost kick, a key processed
+        # on two workers) fails in seconds.
+        ok = ok and stage(
+            "event-core",
+            [py, "-m", "pytest", "tests/test_event_core.py", "-q",
+             "-m", "not slow",
+             f"--junitxml={args.artifacts_dir}/junit_event_core.xml"],
+            args.artifacts_dir, cases,
+        )
+        # sched-bench smoke (ISSUE 18): replay the committed 200-job
+        # trace through the REAL scheduler/inventory/workqueue on the
+        # virtual clock and enforce the golden budgets — A/B work
+        # ratio floor, event-arm work ceiling, admission-p99 slack. A
+        # control-plane perf regression (a reconcile storm, a lost
+        # kick delaying admission) fails HERE with a readable
+        # SCHED BENCH BUDGET line, not in production at O(1000) jobs.
+        ok = ok and stage(
+            "sched-bench",
+            [py, "benches/sched_bench.py",
+             "--trace", "ci/sched_bench/trace_200.json",
+             "--golden", "ci/sched_bench/golden.json",
+             "--out", f"{args.artifacts_dir}/sched_bench_200.json"],
+            args.artifacts_dir, cases,
+        )
+        # ...and the 1000-job headline A/B (runs in ~4s): the ≥10x
+        # idle-control-plane-work floor at fleet scale, with admission
+        # p99 no worse than the sweep baseline. The summary JSON lands
+        # in the CI artifacts — the step-time-as-artifact idiom the
+        # autotune stage set, applied to control-plane work.
+        ok = ok and stage(
+            "sched-bench-1000",
+            [py, "benches/sched_bench.py", "--jobs", "1000",
+             "--golden", "ci/sched_bench/golden_1000.json",
+             "--out", f"{args.artifacts_dir}/sched_bench_1000.json"],
+            args.artifacts_dir, cases,
+        )
         # elastic-resize gate (ISSUE 12): the resize decision core's
         # full matrix (dead-heartbeat / inventory shrink triggers, grow
         # hold, clamps, cooldown, health-gated restore ceiling, budget
@@ -345,6 +386,7 @@ def main(argv=None) -> int:
                       "--ignore=tests/test_disagg.py",
                       "--ignore=tests/test_migration.py",
                       "--ignore=tests/test_autotune.py",
+                      "--ignore=tests/test_event_core.py",
                       "--deselect=tests/test_benches.py::TestBenches"
                       "::test_serving_bench_smoke",
                       "--deselect=tests/test_benches.py::TestBenches"

@@ -66,16 +66,36 @@ class _KindCache:
         except (TypeError, ValueError):
             return 0
 
-    def apply(self, ev: WatchEvent) -> None:
+    @staticmethod
+    def _materially_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+        """Equal modulo resourceVersion — a write that only bumped the
+        rv carries no information a reconciler could act on, and event
+        listeners must not be kicked for it."""
+        am = dict(a.get("metadata") or {})
+        bm = dict(b.get("metadata") or {})
+        am.pop("resourceVersion", None)
+        bm.pop("resourceVersion", None)
+        return am == bm and {k: v for k, v in a.items()
+                             if k != "metadata"} == \
+            {k: v for k, v in b.items() if k != "metadata"}
+
+    def apply(self, ev: WatchEvent) -> bool:
+        """Apply one event; True iff the cache *materially* changed
+        (the listener-notification gate)."""
         key = (ev.namespace or "default", ev.name)
         with self.lock:
             if ev.type == "DELETED":
-                self.objects.pop(key, None)
-            elif ev.type in ("ADDED", "MODIFIED"):
+                return self.objects.pop(key, None) is not None
+            if ev.type in ("ADDED", "MODIFIED"):
                 cur = self.objects.get(key)
                 # never regress to an older copy (initial-list overlap)
                 if cur is None or self._rv(ev.object) >= self._rv(cur):
+                    changed = (cur is None
+                               or not self._materially_equal(
+                                   cur, ev.object))
                     self.objects[key] = copy.deepcopy(ev.object)
+                    return changed
+        return False
 
     def replace(self, items: List[Dict[str, Any]]) -> None:
         """Relist: the list snapshot becomes the whole cache (objects
@@ -119,6 +139,28 @@ class Informer:
         self._threads: List[threading.Thread] = []
         self._hook = None
         self._started = False
+        # event listeners (the event-driven control plane's feed,
+        # docs/SCHEDULER.md "Event-driven core"): called with each
+        # WatchEvent that MATERIALLY changed the cache, plus a
+        # synthetic type="RESYNC" event after every reflector relist
+        # (anything could have changed while the watch was down).
+        # Listeners must be cheap and never raise.
+        self._listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(ev)
+            except Exception as e:  # a listener bug must not stall the feed
+                log.error("informer listener failed on %s %s/%s: %s",
+                          ev.type, ev.kind, ev.name, e)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -143,7 +185,8 @@ class Informer:
                 if state["priming"]:
                     state["buffer"].append(ev)
                     return
-                self.caches[ev.kind].apply(ev)
+                if self.caches[ev.kind].apply(ev):
+                    self._notify(ev)
 
             self._hook = hook
             self.cluster.hooks.append(hook)
@@ -213,6 +256,11 @@ class Informer:
                     rv = self.cluster.resource_version
                 cache.replace(items)
                 cache.synced.set()
+                # anything may have changed while the watch was down —
+                # one synthetic event lets listeners resync themselves
+                # (the controller re-kicks every job key on it)
+                self._notify(WatchEvent("RESYNC", kind, {
+                    "metadata": {"name": "", "namespace": ""}}))
                 watcher = self.cluster.watch(kind, self.namespace, rv)
             except Exception as e:
                 delay = bo.note_failure()
@@ -225,7 +273,8 @@ class Informer:
                     ev = watcher.next(timeout=0.2)
                     if ev is None:
                         continue
-                    cache.apply(ev)
+                    if cache.apply(ev):
+                        self._notify(ev)
             except errors.OutdatedVersionError:
                 # a 410 storm (chaos watch-drop, compacted history)
                 # relists through the same backoff as any other failure

@@ -20,7 +20,9 @@ from k8s_tpu.api import errors
 from k8s_tpu.api.client import KubeClient
 from k8s_tpu.api.crd_client import TpuJobClient
 from k8s_tpu import utils
+from k8s_tpu.controller.reconciler import ReconcilerCore
 from k8s_tpu.controller.watchdog import PanicTimer
+from k8s_tpu.trainer.labels import JOB_NAME_LABEL
 from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
 from k8s_tpu.sched import (
     ClusterScheduler,
@@ -36,6 +38,10 @@ log = logging.getLogger(__name__)
 
 INIT_RETRY_WAIT = 30.0  # reference controller.go:33
 WATCHDOG_DEADLINE = 60.0  # reference controller.go:110
+# Event-driven mode's scheduler-tick backstop: every job/capacity
+# delta kicks a tick explicitly, so the periodic pass is demoted from
+# sched_interval (1s) to a slow catch-all for anything a kick missed.
+SCHED_BACKSTOP_SECONDS = 30.0
 
 # Requeue schedule for the controller's outer loop: init failures,
 # relist-after-410, and pump crashes all hold off through this (capped
@@ -97,11 +103,32 @@ class Controller:
             self.scheduler.inventory.on_capacity(self._on_capacity_return)
         self._sched_lock = threading.RLock()
         self._sched_thread: Optional[threading.Thread] = None
-        # O(100) hygiene: one shared semaphore bounds concurrent
-        # reconcile ticks across every TrainingJob thread (0 = off)
+        # dedup "kick" for the event-driven scheduler tick: a burst of
+        # job deltas (N completions, a mass delete) wakes the tick loop
+        # ONCE instead of running N full scheduler passes
+        self._sched_kick_pending = threading.Event()
+        # Event-driven reconciler core (docs/SCHEDULER.md "Event-driven
+        # core", default ON): one informer-fed coalescing work queue
+        # drained by a bounded worker pool replaces the thread-per-job
+        # loops; reconciles fire on events + rate-limited requeues.
+        core_workers = self.config.reconcile_workers
+        if self.config.max_concurrent_reconciles:
+            # the legacy concurrency knob stays meaningful in event
+            # mode: it caps the worker pool
+            core_workers = min(core_workers,
+                               self.config.max_concurrent_reconciles)
+        self.core: Optional[ReconcilerCore] = (
+            ReconcilerCore(workers=core_workers)
+            if self.config.event_driven else None)
+        self._informer_listener = None
+        # O(100) hygiene (LEGACY threaded mode only): one shared
+        # semaphore bounds concurrent reconcile ticks across every
+        # TrainingJob thread (0 = off); the core's worker pool subsumes
+        # it in event-driven mode
         n = self.config.max_concurrent_reconciles
         self._reconcile_limiter = (
-            threading.BoundedSemaphore(n) if n and n > 0 else None)
+            threading.BoundedSemaphore(n)
+            if n and n > 0 and self.core is None else None)
         # test/e2e seam: build a per-job worker-stats fetcher (the
         # heartbeat source preemption pricing reads) for reconcilers
         # the CONTROLLER spawns — outside a cluster there is no
@@ -150,6 +177,15 @@ class Controller:
             self._informer_sampler_stopped = stopped
             self._informer_sampler_lock = sample_lock
             metrics.REGISTRY.on_collect(sample_informer)
+        if self.core is not None:
+            self.core.start()
+            inf = self.client.informer
+            if inf is not None and self._informer_listener is None:
+                # informer-fed kicks: a Pod/Job delta for an owned job
+                # wakes exactly that job's reconcile key — how a
+                # quiescent job learns its gang finished without a poll
+                self._informer_listener = self._on_informer_event
+                inf.add_listener(self._informer_listener)
         try:
             self.job_client.create_crd_definition()
         except errors.AlreadyExistsError:
@@ -198,7 +234,7 @@ class Controller:
             self._spawn_reconciler(job)
         else:  # terminal phases: reconciler handles bookkeeping, no charge
             self._spawn_reconciler(job)
-        self._sched_tick()
+        self._sched_kick()
 
     def _spawn_reconciler(self, job: TpuJob) -> bool:
         from k8s_tpu.controller import metrics
@@ -220,6 +256,8 @@ class Controller:
                 return False
         tj = TrainingJob(self.client, self.job_client, job)
         tj.reconcile_limiter = self._reconcile_limiter
+        if self.core is not None:
+            tj.attach_core(self.core, self.config.resync_seconds)
         if self.scheduler is not None:
             tj.on_terminal = self._on_job_terminal
             # elastic resize (docs/ELASTIC.md): the reconciler's
@@ -328,7 +366,7 @@ class Controller:
             return False
         self._export_sched_metrics()
         if new_dp < old_dp:
-            self._sched_tick()
+            self._sched_kick()
         return True
 
     def _on_capacity_return(self, accelerator: str) -> None:
@@ -387,10 +425,56 @@ class Controller:
             target=self._sched_loop, daemon=True, name="cluster-sched")
         self._sched_thread.start()
 
+    def _sched_kick(self) -> None:
+        """Coalesced request for a scheduler pass: job/capacity deltas
+        (submit, terminal, delete, resize, queued-edit) set ONE pending
+        flag the tick loop drains — a burst of N events runs one pass,
+        not N. Falls back to a synchronous tick when the loop is not
+        running (unit tests driving the controller by hand)."""
+        from k8s_tpu.controller import metrics
+
+        if self.scheduler is None:
+            return
+        t = self._sched_thread
+        if t is None or not t.is_alive():
+            self._sched_tick()
+            return
+        metrics.SCHED_KICKS.inc()
+        if self._sched_kick_pending.is_set():
+            metrics.SCHED_KICKS_COALESCED.inc()
+        else:
+            self._sched_kick_pending.set()
+
+    def _sched_backstop(self) -> float:
+        """How long the tick loop may sleep with no kicks. Legacy mode
+        keeps the configured interval (the tick IS the event source);
+        event-driven mode stretches it to the slow backstop (every
+        delta kicks explicitly), shortened to the next preemption-
+        cooldown expiry so a held victim is re-considered the moment
+        its hold-off ends, not one backstop later."""
+        base = self.sched_interval
+        if self.core is not None:
+            base = max(base, SCHED_BACKSTOP_SECONDS)
+        sched = self.scheduler
+        if sched is not None:
+            exp = sched.next_holdoff_expiry()
+            if exp is not None:
+                delta = exp - sched.clock()
+                if delta > 0:
+                    base = min(base, delta + 0.01)
+        return max(0.02, base)
+
     def _sched_loop(self) -> None:
+        """Event-driven tick loop: woken by :meth:`_sched_kick` (job or
+        capacity deltas), with the periodic interval demoted to a slow
+        backstop for anything a kick ever misses."""
         while not self._stop.is_set():
-            if self._stop.wait(self.sched_interval):
+            self._sched_kick_pending.wait(self._sched_backstop())
+            if self._stop.is_set():
                 return
+            # clear BEFORE ticking: a kick landing mid-pass re-arms the
+            # flag and the loop runs again immediately — never lost
+            self._sched_kick_pending.clear()
             try:
                 self._sched_tick()
             except Exception as e:  # a tick bug must not kill the loop
@@ -445,6 +529,7 @@ class Controller:
                         req.footprint, fresh.footprint)
             fresh.seq = req.seq  # keep its place in line
             self.scheduler.reinstate(fresh)
+            self._sched_kick()  # re-decide now, not at the backstop
             return
         metrics.SCHED_ADMITTED.inc({"queue": req.queue})
         job.status.append_condition(
@@ -460,8 +545,10 @@ class Controller:
         if not self._spawn_reconciler(job):
             # the previous reconciler is still winding down: give the
             # slices back and re-queue AT ITS ORIGINAL position; a
-            # later tick retries cleanly
+            # DELAYED kick retries (an immediate one would hot-loop
+            # against the still-draining reconciler)
             self.scheduler.reinstate(req)
+            threading.Timer(1.0, self._sched_kick).start()
 
     def _apply_preemption(self, p: Preemption) -> None:
         """Act on an eviction verdict: goodput + Events naming BOTH
@@ -524,7 +611,7 @@ class Controller:
         if self.scheduler is None:
             return
         self.scheduler.remove(tj.job.key)
-        self._sched_tick()
+        self._sched_kick()
 
     def _export_sched_metrics(self) -> None:
         from k8s_tpu.controller import metrics
@@ -540,6 +627,45 @@ class Controller:
         for accel, pool in stats["pools"].items():
             metrics.SCHED_SLICES_FREE.set(
                 float(pool["free"]), {"accelerator": accel})
+
+    # ---------------------------------------------------- event-driven feed
+
+    def _on_informer_event(self, ev) -> None:
+        """Informer listener (event-driven core): map a Pod/Job delta to
+        the owning TpuJob's reconcile key via the ``tpu_job_name`` label
+        and kick exactly that key. The informer only notifies on
+        MATERIAL cache changes, and the local kubelet writes pod status
+        once at launch and once at finish — so a quiescent 1000-job
+        fleet generates no kicks at all. A synthetic RESYNC event
+        (reflector relist: anything may have changed while the watch
+        was down) re-kicks every live job once."""
+        if self.core is None:
+            return
+        if ev.type == "RESYNC":
+            for key, tj in list(self.jobs.items()):
+                if tj.is_alive():
+                    tj.nudge()
+            return
+        labels = ((ev.object.get("metadata") or {}).get("labels") or {})
+        name = labels.get(JOB_NAME_LABEL)
+        if not name:
+            return
+        key = f"{ev.namespace or 'default'}/{name}"
+        tj = self.jobs.get(key)
+        if tj is not None and tj.is_alive():
+            tj.nudge()
+
+    def ingest_heartbeat(self, namespace: str, name: str, host: int,
+                         payload: dict) -> bool:
+        """Pushed obs heartbeat (POST /v1/heartbeat/<ns>/<name>/<host>
+        on the operator health server): route to the owning reconciler,
+        which caches the stats and kicks its key — replacing a poll.
+        Returns False for an unknown/dead job (HTTP 404)."""
+        tj = self.jobs.get(f"{namespace}/{name}")
+        if tj is None or not tj.is_alive():
+            return False
+        tj.ingest_heartbeat(host, payload)
+        return True
 
     def handle_event(self, ev_type: str, job: TpuJob) -> None:
         """Reference handleTfJobEvent (controller.go:123-170)."""
@@ -566,7 +692,7 @@ class Controller:
                 if not was_scheduled:
                     log.warning("unsafe state: %s deleted but not tracked",
                                 key)
-                self._sched_tick()
+                self._sched_kick()
                 return
             if tj.is_alive():
                 tj.delete()
@@ -579,7 +705,7 @@ class Controller:
                     tj.delete_resources()
                 except Exception as e:
                     log.error("job %s: queued-job delete: %s", key, e)
-            self._sched_tick()
+            self._sched_kick()
         elif ev_type == "MODIFIED":
             tj = self.jobs.get(key)
             if tj is not None and tj.is_alive():
@@ -590,7 +716,7 @@ class Controller:
                 # reconciler will materialize on admission, or the
                 # stale footprint breaks zero-oversubscription
                 if self.scheduler.update_pending(self._request_for(job)):
-                    self._sched_tick()
+                    self._sched_kick()
 
     # ------------------------------------------------------------ run loop
 
@@ -658,6 +784,8 @@ class Controller:
 
     def stop(self) -> None:
         self._stop.set()
+        # wake the sched loop out of its backstop sleep immediately
+        self._sched_kick_pending.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
         if self._sched_thread is not None:
@@ -667,10 +795,16 @@ class Controller:
         # find_all_jobs may still be adding jobs concurrently, and a job
         # added after an early stop loop would leak its thread. Join so
         # stop() really quiesces the process.
+        inf = self.client.informer
+        if self._informer_listener is not None and inf is not None:
+            inf.remove_listener(self._informer_listener)
+            self._informer_listener = None
         for tj in list(self.jobs.values()):
             tj.stop()
         for tj in list(self.jobs.values()):
             tj.join(timeout=5)
+        if self.core is not None:
+            self.core.stop()
         if self._owns_informer:
             if self._informer_sampler is not None:
                 from k8s_tpu.controller import metrics

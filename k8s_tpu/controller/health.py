@@ -23,6 +23,11 @@ Routes:
                    dir on trainer obs endpoints; 404 when no hook) and
                    return its JSON result — the on-demand profiling
                    surface (docs/OBSERVABILITY.md).
+  ``POST /v1/heartbeat/<ns>/<name>/<host>``
+                -> pushed obs heartbeat (the event-driven control
+                   plane's inbound path, docs/SCHEDULER.md): JSON body
+                   is routed to the owning reconciler via the attached
+                   ``heartbeat_sink``; 404 when no sink or unknown job.
 """
 
 from __future__ import annotations
@@ -125,6 +130,31 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(404)
             self.end_headers()
 
+    def do_POST(self):  # noqa: N802 (http.server API)
+        parts = self.path.strip("/").split("/")
+        # /v1/heartbeat/<ns>/<name>/<host>
+        if len(parts) == 5 and parts[:2] == ["v1", "heartbeat"]:
+            import json
+
+            sink = self.server.owner.heartbeat_sink
+            if sink is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                host = int(parts[4])
+                ok = bool(sink(parts[2], parts[3], host, payload))
+            except Exception as e:  # malformed push must not 500-loop
+                log.debug("heartbeat push rejected: %s", e)
+                ok = False
+            self.send_response(204 if ok else 404)
+            self.end_headers()
+        else:
+            self.send_response(404)
+            self.end_headers()
+
     def log_message(self, fmt, *args):  # kubelet probes every few seconds
         log.debug("health: " + fmt, *args)
 
@@ -163,6 +193,10 @@ class HealthServer:
         # the on-demand jax.profiler capture on trainer obs endpoints
         # (k8s_tpu.obs.health.capture_profile); None keeps the route 404
         self.profiler = profiler
+        # optional callable(ns, name, host, payload) -> bool behind
+        # POST /v1/heartbeat/... — Controller.ingest_heartbeat when the
+        # operator wires it; None keeps the route 404
+        self.heartbeat_sink = None
         self._server = _Server((host, port), _Handler)
         self._server.owner = self
         self.port = self._server.server_address[1]

@@ -87,6 +87,69 @@ class Gauge(_Metric):
         return self.values.get(self._key(labels), 0.0)
 
 
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram per the text exposition format:
+    ``<name>_bucket{le=...}`` (cumulative, ``+Inf`` last), ``_sum``,
+    ``_count``. One instance per labelset, like the other types."""
+
+    def __init__(self, name, help_text, buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, "histogram")
+        self.buckets = tuple(sorted(buckets))
+        # per-labelset: [counts per bucket] + sum + count
+        self._series: Dict[LabelKV, List[float]] = {}
+        self._sums: Dict[LabelKV, float] = {}
+        self._counts: Dict[LabelKV, float] = {}
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._series.setdefault(
+                key, [0.0] * len(self.buckets))
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    counts[i] += 1.0
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0.0) + 1.0
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._counts.get(self._key(labels), 0.0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._sums.clear()
+            self._counts.clear()
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            for key in sorted(self._series):
+                base = [f'{k}="{_escape_label_value(v)}"'
+                        for k, v in key]
+                cum = 0.0
+                for le, n in zip(self.buckets, self._series[key]):
+                    cum = n  # buckets are already cumulative
+                    lbl = ",".join(base + [f'le="{le:g}"'])
+                    out.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                lbl = ",".join(base + ['le="+Inf"'])
+                out.append(
+                    f"{self.name}_bucket{{{lbl}}} {self._counts[key]}")
+                suffix = f"{{{','.join(base)}}}" if base else ""
+                out.append(f"{self.name}_sum{suffix} {self._sums[key]}")
+                out.append(
+                    f"{self.name}_count{suffix} {self._counts[key]}")
+        return out
+
+
 class Registry:
     def __init__(self):
         self._metrics: List[_Metric] = []
@@ -114,6 +177,12 @@ class Registry:
 
     def gauge(self, name: str, help_text: str) -> Gauge:
         m = Gauge(name, help_text)
+        self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help_text: str,
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help_text, buckets)
         self._metrics.append(m)
         return m
 
@@ -411,4 +480,49 @@ SERVING_PREFIX_REMOTE_HITS = REGISTRY.counter(
     "ktpu_serving_prefix_remote_hits_total",
     "Shared-prefix snapshots fetched from a holding peer on a local "
     "LRU miss (the prefix directory's fleet-wide hit path)",
+)
+# Event-driven control plane (docs/SCHEDULER.md "Event-driven core"):
+# the shared reconciler core's own telemetry — how much work the queue
+# is doing, how much it avoided, and what each pass cost.
+RECONCILE_LATENCY = REGISTRY.histogram(
+    "ktpu_controller_reconcile_latency_seconds",
+    "Wall-clock duration of each reconcile pass through the shared "
+    "worker pool",
+)
+WORKQUEUE_DEPTH = REGISTRY.gauge(
+    "ktpu_controller_workqueue_depth",
+    "Keys waiting in the shared reconciler work queue (ready + "
+    "delayed requeues)",
+)
+WORKQUEUE_COALESCED = REGISTRY.counter(
+    "ktpu_controller_workqueue_coalesced_total",
+    "Queue adds merged into an already-queued or in-flight key — "
+    "reconcile passes the coalescing saved",
+)
+RECONCILE_REQUEUES = REGISTRY.counter(
+    "ktpu_controller_requeues_total",
+    "Keys re-queued after a pass, by reason (poll = periodic "
+    "obs/serving cadence, resync = slow backstop, error = exponential "
+    "failure backoff)",
+)
+CONTROLLER_HTTP_CALLS = REGISTRY.counter(
+    "ktpu_controller_http_calls_total",
+    "Status-poll HTTP calls issued by the shared connection-reusing "
+    "poller, by component (obs = worker heartbeat sweep, router = "
+    "serving stats)",
+)
+SCHED_KICKS = REGISTRY.counter(
+    "ktpu_sched_kicks_total",
+    "Scheduler-tick kicks requested by job/capacity deltas (each "
+    "wakes the event-driven tick loop at most once)",
+)
+SCHED_KICKS_COALESCED = REGISTRY.counter(
+    "ktpu_sched_kicks_coalesced_total",
+    "Scheduler kicks merged into an already-pending wakeup — full "
+    "scheduler passes the dedup kick saved",
+)
+HEARTBEATS_PUSHED = REGISTRY.counter(
+    "ktpu_controller_heartbeats_pushed_total",
+    "Worker obs heartbeats PUSHED into the control plane (the "
+    "/v1/heartbeat receiver) instead of polled",
 )

@@ -5,9 +5,11 @@ A metric nobody can find is dead weight and a documented metric that
 no longer exists is a debugging trap, so the CI ``obs`` stage (and a
 tier-1 test) fails on drift in EITHER direction. Registration sites
 are found syntactically — the first string argument of any
-``.counter(`` / ``.gauge(`` call under ``k8s_tpu/`` whose name starts
-with ``ktpu_`` — so a new series added anywhere in the package is
-caught without a central list to forget to update.
+``.counter(`` / ``.gauge(`` / ``.histogram(`` call under ``k8s_tpu/``
+whose name starts with ``ktpu_`` — so a new series added anywhere in
+the package is caught without a central list to forget to update.
+Histograms are cataloged by their base name (the ``_bucket``/``_sum``/
+``_count`` suffixes are exposition detail, not separate series).
 
 Run: ``python -m k8s_tpu.obs.lint`` (exit 1 + readable diff on drift).
 """
@@ -20,7 +22,7 @@ import sys
 from typing import List, Set
 
 _REGISTER_RE = re.compile(
-    r"\.(?:counter|gauge)\(\s*\n?\s*\"(ktpu_[a-z0-9_]*[a-z0-9])\"")
+    r"\.(?:counter|gauge|histogram)\(\s*\n?\s*\"(ktpu_[a-z0-9_]*[a-z0-9])\"")
 _DOC_RE = re.compile(r"\bktpu_[a-z0-9_]*[a-z0-9]\b")
 
 _REPO_ROOT = os.path.dirname(

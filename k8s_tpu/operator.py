@@ -134,6 +134,10 @@ def main(argv=None) -> int:
 
     def on_started_leading(lost: threading.Event):
         controller = Controller(client, job_client, config, args.namespace)
+        if health is not None:
+            # pushed obs heartbeats (POST /v1/heartbeat/...) route to
+            # the owning reconciler instead of waiting for a poll
+            health.heartbeat_sink = controller.ingest_heartbeat
         if args.chaos_level >= 0:
             from k8s_tpu.runtime.chaos import ChaosMonkey
 

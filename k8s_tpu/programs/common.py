@@ -265,6 +265,13 @@ def start_obs_server(rdzv, tracer, extra_stats=None):
         print(json.dumps({"event": "obs_error", "host": host_id,
                           "error": str(e)}), flush=True)
         return None
+    # pushed heartbeats (event-driven control plane): when the trainer
+    # set KTPU_OBS_PUSH_URL, this host POSTs its own stats block to the
+    # operator instead of waiting to be polled — best-effort, the pull
+    # path stays as the fallback
+    from k8s_tpu.obs.push import maybe_start_pusher
+
+    srv.heartbeat_pusher = maybe_start_pusher(stats)
     print(json.dumps({"event": "obs_ready", "host": host_id,
                       "port": srv.port}), flush=True)
     return srv

@@ -253,6 +253,17 @@ class ClusterScheduler:
         with self._lock:
             return sorted(self._pending)
 
+    def next_holdoff_expiry(self) -> Optional[float]:
+        """Clock time when the earliest pending job's preemption
+        cooldown expires (None = nothing held). The event-driven tick
+        loop wakes exactly then instead of discovering the expiry one
+        periodic backstop later (docs/SCHEDULER.md)."""
+        with self._lock:
+            now = self.clock()
+            expiries = [t for k, t in self._holdoff.items()
+                        if k in self._pending and t > now]
+            return min(expiries) if expiries else None
+
     def is_running(self, key: str) -> bool:
         with self._lock:
             return key in self._running

@@ -67,7 +67,21 @@ class ControllerConfig(K8sObject):
     # O(100) reconciler hygiene: bound CONCURRENT reconcile ticks
     # across all TrainingJob threads with a shared worker-pool
     # semaphore. 0 (default) = unbounded, today's behavior at small N.
+    # LEGACY-mode only (eventDriven: false) — the event-driven core's
+    # worker pool subsumes it.
     max_concurrent_reconciles: int = 0
+    # Event-driven control plane (docs/SCHEDULER.md "Event-driven
+    # core"): ON (default) = one shared coalescing work queue drained
+    # by reconcileWorkers threads, reconciles fire on watch/informer
+    # events + rate-limited requeues, and quiescent jobs cost nothing
+    # between resyncs. OFF = one thread per job ticking every
+    # reconcile_interval (the pre-O(1000) behavior).
+    event_driven: bool = True
+    reconcile_workers: int = 4
+    # Slow backstop: a quiescent job with no periodic polling needs
+    # (no serving/observability/elastic spec) is still reconciled at
+    # least this often, catching anything an event ever missed.
+    resync_seconds: float = 300.0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -94,4 +108,7 @@ class ControllerConfig(K8sObject):
                 raw.get("schedulerCooldownSeconds", 5.0)),
             max_concurrent_reconciles=int(
                 raw.get("maxConcurrentReconciles", 0)),
+            event_driven=bool(raw.get("eventDriven", True)),
+            reconcile_workers=int(raw.get("reconcileWorkers", 4)),
+            resync_seconds=float(raw.get("resyncSeconds", 300.0)),
         )

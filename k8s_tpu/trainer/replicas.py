@@ -595,6 +595,17 @@ class TpuReplicaSet:
             if obs.obs_port:
                 env["KTPU_OBS_ADVERTISE"] = \
                     f"{self.job_name(index)}:{obs.obs_port}"
+            import os
+
+            # event-driven heartbeats (docs/SCHEDULER.md): when the
+            # operator deployment advertises its health endpoint, each
+            # host pushes its own stats there instead of being polled
+            operator = os.environ.get("KTPU_OPERATOR_HEALTH", "")
+            if operator:
+                md = self.job.job.metadata
+                env["KTPU_OBS_PUSH_URL"] = (
+                    f"http://{operator}/v1/heartbeat/"
+                    f"{md.namespace}/{md.name}/{index}")
         return env
 
     def _checkpoint_env(self, workers) -> Optional[Dict[str, str]]:

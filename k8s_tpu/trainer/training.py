@@ -148,6 +148,21 @@ class TrainingJob:
         # triggered shrink against the live pool deficit
         self.on_resize: Optional[
             Callable[["TrainingJob", int, int, str], bool]] = None
+        # Event-driven mode (docs/SCHEDULER.md "Event-driven core"):
+        # instead of owning a thread, the job registers a handler with
+        # the controller's shared ReconcilerCore; events kick its key,
+        # _process() drains + reconciles once, and the returned delay
+        # is the requeue cadence (None = wait for the next event).
+        self._core = None
+        self._exited = False
+        self._config: Optional[ControllerConfig] = None
+        self._interval = RECONCILE_INTERVAL
+        self.resync_seconds = 300.0
+        # PUSHED heartbeats (the /v1/heartbeat receiver): host ->
+        # (recv_time, payload). When fresh they satisfy the obs sweep
+        # with zero HTTP polls from the control plane.
+        self._pushed: Dict[int, Tuple[float, dict]] = {}
+        self._pushed_lock = threading.Lock()
         # rv of the snapshot this reconciler was built from: watch
         # MODIFIED events at or below it carry no new information and
         # must not be diffed as user edits (see _handle_modify)
@@ -563,49 +578,74 @@ class TrainingJob:
 
     # ------------------------------------------------------------ stragglers
 
+    @staticmethod
+    def _graft_ckpt(payload: dict) -> Optional[dict]:
+        """Extract the obs heartbeat off a healthz payload, grafting
+        the sibling ckpt goodput block on so the scheduler's
+        preemption pricing (progress past ckpt.last_saved_step) sees
+        it (docs/SCHEDULER.md)."""
+        hb = payload.get("obs")
+        if not isinstance(hb, dict):
+            return None
+        ck = payload.get("ckpt")
+        if isinstance(ck, dict) and "ckpt" not in hb:
+            hb = {**hb, "ckpt": ck}
+        return hb
+
     def _http_worker_stats(self) -> Optional[Dict[int, dict]]:
         """Default per-host heartbeat source: GET each gang WORKER's
-        per-index Service obs endpoint concurrently (a serial sweep
-        would lag the tick by workers x timeout on a partially-up
-        gang). Any per-host failure is a miss — a host that answers
-        nothing is the gang-restart path's problem, not this one's."""
-        import json as _json
-        import urllib.request
+        per-index Service obs endpoint through the controller-wide
+        :func:`~k8s_tpu.controller.poller.shared_poller` — one batched
+        sweep on persistent connections, replacing the fresh thread
+        per replica per tick this used to spawn. Any per-host failure
+        is a miss — a host that answers nothing is the gang-restart
+        path's problem, not this one's."""
+        from k8s_tpu.controller.poller import shared_poller
 
         obs = self.job.spec.observability
         wset = self._worker_set()
         if obs is None or not obs.obs_port or wset is None:
             return None
-        out: Dict[int, dict] = {}
-
-        def poll(i: int) -> None:
-            url = (f"http://{wset.job_name(i)}:"
-                   f"{obs.obs_port}/healthz")
-            try:
-                with urllib.request.urlopen(url, timeout=2) as r:
-                    payload = _json.loads(r.read())
-                hb = payload.get("obs")
-                if isinstance(hb, dict):
-                    # the ckpt goodput block is a SIBLING of the
-                    # heartbeat in the healthz payload — graft it on so
-                    # the scheduler's preemption pricing (progress past
-                    # ckpt.last_saved_step) sees it (docs/SCHEDULER.md)
-                    ck = payload.get("ckpt")
-                    if isinstance(ck, dict) and "ckpt" not in hb:
-                        hb = {**hb, "ckpt": ck}
-                    out[i] = hb
-            except Exception:
-                pass
-
-        threads = [
-            threading.Thread(target=poll, args=(i,), daemon=True)
+        urls = {
+            i: f"http://{wset.job_name(i)}:{obs.obs_port}/healthz"
             for i in range(wset.spec.replicas or 0)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=3)
+        }
+        payloads = shared_poller().fetch_json_many(
+            urls, timeout=2.0, component="obs")
+        out: Dict[int, dict] = {}
+        for i, payload in payloads.items():
+            hb = self._graft_ckpt(payload)
+            if hb is not None:
+                out[i] = hb
         return out or None
+
+    # ------------------------------------------------------ pushed heartbeats
+
+    def ingest_heartbeat(self, host: int, payload: dict) -> None:
+        """A worker's obs heartbeat PUSHED into the control plane (the
+        operator's ``/v1/heartbeat`` receiver) instead of polled: store
+        it and kick this job's queue key — the obs sweep becomes an
+        event, and the reconciler fetches nothing."""
+        from k8s_tpu.controller import metrics
+
+        hb = self._graft_ckpt(payload) if "obs" in payload else payload
+        if not isinstance(hb, dict):
+            return
+        with self._pushed_lock:
+            self._pushed[int(host)] = (self.clock(), hb)
+        metrics.HEARTBEATS_PUSHED.inc()
+        self._kick()
+
+    def _pushed_worker_stats(self) -> Optional[Dict[int, dict]]:
+        """The pushed-heartbeat sweep, if fresh enough to stand in for
+        a poll (hosts pushed within ~2 intervals); None ⇒ fall back to
+        the pull path."""
+        window = max(2.0 * self._interval, 5.0)
+        now = self.clock()
+        with self._pushed_lock:
+            fresh = {h: hb for h, (t, hb) in self._pushed.items()
+                     if now - t <= window}
+        return fresh or None
 
     def _obs_tick(self) -> Optional[str]:
         """The reconciler's observability tick: ONE concurrent heartbeat
@@ -619,8 +659,12 @@ class TrainingJob:
             return None
         if obs is None and self.worker_stats_fetcher is None:
             return None
-        fetch = self.worker_stats_fetcher or self._http_worker_stats
-        stats = fetch()
+        if self.worker_stats_fetcher is not None:
+            stats = self.worker_stats_fetcher()
+        else:
+            # pushed heartbeats (fresh) satisfy the sweep with zero
+            # polls; the batched shared-poller pull is the fallback
+            stats = self._pushed_worker_stats() or self._http_worker_stats()
         if not stats:
             return None
         # freshest sweep kept for the cluster scheduler's preemption
@@ -1374,6 +1418,15 @@ class TrainingJob:
                 log.warning("job %s: event queue almost full", self.fullname)
         except queue.Full:
             log.error("job %s: event queue full, dropping %s", self.fullname, typ)
+        self._kick()
+
+    def _kick(self, delay: float = 0.0) -> None:
+        """Event-driven mode: wake the shared core for this job's key
+        (coalesced by the work queue). No-op in threaded mode — the
+        blocking event-queue get is the wakeup there."""
+        core = self._core
+        if core is not None and not self._exited:
+            core.kick(self.job.key, delay)
 
     def delete(self) -> None:
         """External request to delete (reference Delete, training.go:303-320):
@@ -1454,7 +1507,22 @@ class TrainingJob:
 
     # ------------------------------------------------------------ run loop
 
+    def attach_core(self, core, resync_seconds: float = 300.0) -> None:
+        """Switch this job to event-driven mode BEFORE start(): it will
+        register with the shared :class:`ReconcilerCore` instead of
+        spawning a thread (docs/SCHEDULER.md "Event-driven core")."""
+        self._core = core
+        self.resync_seconds = resync_seconds
+
     def start(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
+        self._config = config
+        self._interval = reconcile_interval
+        if self._core is not None:
+            # event-driven: no thread — register the handler and kick
+            # the first pass; the returned requeue delay paces the rest
+            self._core.register(self.job.key, self._process)
+            self._core.kick(self.job.key)
+            return None
         self._thread = threading.Thread(
             target=self.run, args=(config, reconcile_interval), daemon=True,
             name=f"trainingjob-{self.name}",
@@ -1464,16 +1532,117 @@ class TrainingJob:
 
     def stop(self) -> None:
         self._stop.set()
+        # event-driven: the next pass observes the flag and exits; kick
+        # so "the next pass" is now, not at the resync backstop
+        self._kick()
 
     def join(self, timeout: Optional[float] = None) -> None:
+        if self._core is not None:
+            # quiesce barrier: any in-flight pass for this key finishes
+            # (the respawn path's safety — no concurrent status writers)
+            self._core.wait_idle(self.job.key,
+                                 timeout if timeout is not None else 10.0)
+            return
         if self._thread is not None:
             self._thread.join(timeout)
 
     def is_alive(self) -> bool:
-        """True while the reconciler thread runs. False for a
-        preempted/queued job whose loop has exited — its events would
-        go nowhere, so callers must act inline instead."""
+        """True while the reconciler runs — a live thread (threaded
+        mode) or a registered, not-yet-exited core handler (event-
+        driven mode). False for a preempted/queued job whose loop has
+        exited — its events would go nowhere, so callers must act
+        inline instead."""
+        if self._core is not None:
+            return not self._exited
         return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------- event-driven mode
+
+    def _process(self) -> Optional[float]:
+        """One pass through the shared core: drain pending events, then
+        reconcile once; the return value is the requeue delay (None =
+        stay quiescent until the next event/kick). The work-queue's
+        processing set serializes passes per key, so this body needs no
+        more locking than the threaded loop had."""
+        config = self._config or ControllerConfig()
+        while True:
+            if self._stop.is_set():
+                self._finish_core()
+                return None
+            try:
+                typ, _new = self._events.get_nowait()
+            except queue.Empty:
+                break
+            if typ == _EVENT_DELETE:
+                log.info("TpuJob %s deleted by the user", self.fullname)
+                self.status.phase = TpuJobPhase.CLEANUP
+                self.update_crd_status()
+                try:
+                    self.delete_resources()
+                except Exception as e:
+                    log.error("job %s: deleteResources error: %s",
+                              self.fullname, e)
+                self._finish_core()
+                return None
+            if typ == _EVENT_PREEMPT:
+                # checkpoint-safe eviction: flush-teardown, park in
+                # QUEUED, and RETIRE the handler — the controller
+                # registers a fresh one on re-admission
+                self._handle_preempt()
+                self._finish_core()
+                return None
+            if typ == _EVENT_MODIFY and _new is not None:
+                self._handle_modify(_new)
+            # nudges fall through: the reconcile below is the response
+        self._safe_reconcile(config)
+        if self._stop.is_set():
+            self._finish_core()
+            return None
+        return self._requeue_delay()
+
+    def _finish_core(self) -> None:
+        self._exited = True
+        if self._core is not None:
+            self._core.deregister(self.job.key)
+
+    def _requeue_delay(self) -> Optional[float]:
+        """The event-driven requeue policy — what replaces the fixed
+        ticker. Transitional phases poll fast (pod transitions also
+        kick via the informer); jobs with genuine periodic needs
+        (serving SLO stats, obs sweeps, elastic windows) keep the
+        reconcile_interval cadence; a quiescent RUNNING job costs
+        nothing until the slow resync backstop. A restart held by the
+        gang backoff requeues exactly when the hold expires."""
+        if self._exited or self._stop.is_set():
+            return None
+        if self.finished:
+            return None  # terminal: events (delete) still kick the key
+        interval = self._interval
+        phase = self.status.phase
+        if phase in (TpuJobPhase.NONE, TpuJobPhase.QUEUED,
+                     TpuJobPhase.CREATING, TpuJobPhase.RESIZING):
+            return min(interval, 1.0)
+        if phase == TpuJobPhase.CLEANUP:
+            return interval
+        if self._backoff_waiting:
+            return min(interval,
+                       max(0.05, self.restart_backoff().remaining()))
+        if self.job.status.to_dict() != self.status.to_dict():
+            # a status write failed and rolled back: retry soon, not
+            # at the resync backstop
+            return min(interval, 1.0)
+        spec = self.job.spec
+        needs_poll = (spec.serving is not None
+                      or spec.observability is not None
+                      or spec.elastic is not None
+                      or self.worker_stats_fetcher is not None
+                      or self.router_stats_fetcher is not None)
+        if needs_poll:
+            return interval
+        informer = getattr(self.client, "informer", None)
+        if informer is None or not informer.synced:
+            return interval  # no event feed: keep the polling cadence
+        return max(interval, self.resync_seconds)
 
     def run(self, config: ControllerConfig, reconcile_interval: float = RECONCILE_INTERVAL):
         """Reference run loop (training.go:412-456): select over

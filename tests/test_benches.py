@@ -322,6 +322,75 @@ class TestBenches:
         hbm = out["hbm_bytes_per_device"]
         assert hbm["params"] > 0 and hbm["opt_state"] > 0
 
+    def test_sched_bench_smoke_shape(self, capsys):
+        """``--smoke`` must emit the full A/B JSON line (the CI
+        sched-bench stages and docs/BENCHMARKS.md parse these keys) AND
+        meet the headline direction: the event-driven control plane
+        does several-fold less work per minute than the 1s sweep on the
+        same trace with admission p99 no worse."""
+        from benches import sched_bench
+
+        assert sched_bench.main(["--smoke"]) == 0
+        out = _last_json_line(capsys)
+        assert out["bench"] == "sched"
+        for k in ("jobs", "seed", "trace_digest", "fleet_slices",
+                  "sweep", "event", "ab"):
+            assert k in out, k
+        for mode in ("sweep", "event"):
+            m = out[mode]
+            for k in ("work_per_min", "admission_p50_s",
+                      "admission_p99_s", "utilization",
+                      "goodput_utilization", "sched_ticks",
+                      "reconciles", "admitted", "finished",
+                      "preemptions"):
+                assert k in m, (mode, k)
+        # 200-job smoke regime floor (the 10x acceptance bar is the
+        # 1000-job CI stage; the smoke trace has proportionally more
+        # transitional work per idle job)
+        assert out["ab"]["work_ratio"] > 4.0, out["ab"]
+        # delta = event - sweep: must not be meaningfully WORSE (it is
+        # in fact ~9s better on this trace)
+        assert out["ab"]["admission_p99_delta_s"] <= 2.0, out["ab"]
+        # the event arm really ran through the coalescing queue
+        assert out["event"]["queue_adds"] > 0, out["event"]
+        assert out["event"]["queue_requeued"] >= 0
+        assert "queue_coalesced" in out["event"]
+        # ... and the sweep arm did not (it is the pure periodic
+        # baseline — no queue counters at all)
+        assert "queue_adds" not in out["sweep"]
+
+    def test_sched_bench_determinism(self):
+        """Same seed -> byte-identical trace (digest pinned by the
+        committed CI trace) and byte-identical replay summaries: the
+        simulator's virtual clock and seeded generator are the whole
+        reproducibility story, so any nondeterminism is a bug, not
+        noise."""
+        import json as _json
+        import pathlib
+
+        from benches import sched_bench
+
+        t1 = sched_bench.make_trace(jobs=200, seed=7, horizon_s=1200.0,
+                                    arrival_s=300.0)
+        t2 = sched_bench.make_trace(jobs=200, seed=7, horizon_s=1200.0,
+                                    arrival_s=300.0)
+        d1 = sched_bench.trace_digest(t1)
+        assert d1 == sched_bench.trace_digest(t2)
+        # the committed CI trace is this exact generation — regenerating
+        # it must reproduce the pinned digest bit-for-bit
+        committed = _json.loads(
+            pathlib.Path("ci/sched_bench/trace_200.json").read_text())
+        assert d1 == sched_bench.trace_digest(committed)
+        # replay determinism: two runs of the real scheduler + workqueue
+        # on the virtual clock produce identical summaries
+        s1 = sched_bench.run(t1)
+        s2 = sched_bench.run(t2)
+        assert s1 == s2
+        # and a different seed produces a different trace
+        t3 = sched_bench.make_trace(jobs=200, seed=8, horizon_s=1200.0,
+                                    arrival_s=300.0)
+        assert sched_bench.trace_digest(t3) != d1
+
     @pytest.mark.parametrize("stage", [2, 3])
     def test_llama_bench_smoke_zero_stage_shape(self, capsys, stage):
         """--zero-stage {2,3} --smoke keeps the full JSON line shape

@@ -745,7 +745,12 @@ class TestControllerScheduling:
             fleet={"cpu-1": 10}, quotas={"default": 5},
             max_concurrent_reconciles=4, cooldown=0.0,
             reconcile_interval=0.02, sched_interval=0.02)
-        assert controller._reconcile_limiter is not None
+        # the 4-wide bound: the event core's worker pool (capped by
+        # maxConcurrentReconciles), or the legacy shared semaphore
+        if controller.core is not None:
+            assert controller.core.workers == 4
+        else:
+            assert controller._reconcile_limiter is not None
         pre_admitted = M.SCHED_ADMITTED.get({"queue": "default"})
         kubelet.start()
         controller.start()
