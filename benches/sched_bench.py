@@ -32,11 +32,30 @@ seed ⇒ byte-identical trace (sha256 digest) ⇒ identical summary
 (``tests/test_benches.py`` enforces it; CI replays the committed
 ``ci/sched_bench/trace_200.json`` against golden budgets).
 
+Second axis (docs/SCHEDULER.md "Placement"): ``--policy`` replays the
+SAME committed trace under the placement/backfill policies —
+``fifo-reserve`` (the absolute head-of-line reservation), ``backfill``
+(EASY-style conservative backfill), ``backfill+pack`` (backfill + the
+topology-aware placement scorer) — and ``--policy ab`` runs all three
+and gates the deltas against a policy golden: backfill+pack must
+strictly improve chip-utilization and queue-wait p50 at
+equal-or-better admission p99, with ZERO reserved-job starvation (the
+scheduler additionally asserts the per-round starvation invariant
+internally — a violation raises and fails the bench). ``--fleet-scale``
+shrinks the trace's fleet to create the contention regime the policies
+exist for; the scale is pinned in the golden alongside the digest.
+Policy arms derive each job's ``runtimeEstimateSeconds`` from the
+trace deterministically (duration rounded UP to the next minute — a
+coarse, conservative operator estimate), so the digest-pinned traces
+need no new fields.
+
 Usage:
   python benches/sched_bench.py                         # 1000 jobs
   python benches/sched_bench.py --smoke                 # 200-job CI arm
   python benches/sched_bench.py --make-trace t.json --jobs 200
   python benches/sched_bench.py --trace t.json --golden golden.json
+  python benches/sched_bench.py --trace t.json --policy ab \
+      --fleet-scale 0.5 --golden golden_policy.json
 """
 
 from __future__ import annotations
@@ -56,10 +75,21 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 from k8s_tpu.controller.workqueue import CoalescingWorkQueue
-from k8s_tpu.sched import ClusterScheduler, Footprint, JobRequest, SliceInventory
+from k8s_tpu.sched import (
+    ClusterScheduler,
+    Footprint,
+    JobRequest,
+    PoolTopology,
+    SliceInventory,
+)
 
 ACCEL = "v5e-16"
 CHIPS_PER_SLICE = 4
+POLICIES = ("fifo-reserve", "backfill", "backfill+pack")
+# ICI-pod shape the policy arms lay the trace fleet out on: 8-slice
+# pods (the pool capacity rounds up to whole pods; the inventory
+# revokes the overhang positions)
+POLICY_SLICES_PER_POD = 8
 RECONCILE_INTERVAL = 8.0     # the sweep baseline's fixed ticker
 SCHED_INTERVAL = 1.0         # the sweep baseline's scheduler period
 SCHED_BACKSTOP = 30.0        # event mode: kicks carry the deltas
@@ -165,13 +195,29 @@ def _percentile(vals: List[float], p: float) -> float:
     return s[max(0, idx)]
 
 
-def simulate(trace: dict, mode: str) -> dict:
+def simulate(trace: dict, mode: str, policy: Optional[str] = None,
+             fleet_scale: float = 1.0,
+             _detail: Optional[dict] = None) -> dict:
     """Replay one trace under one control-plane mode. Fully
-    deterministic: no RNG, no wall clock."""
+    deterministic: no RNG, no wall clock.
+
+    ``policy`` (None = the original control-plane A/B, bit-identical
+    to before the axis existed) selects the placement/backfill policy:
+    the fleet is laid out on an ICI-pod topology grid so fragmentation
+    and contiguity are measurable for EVERY arm, the scorer packs only
+    under ``backfill+pack``, and runtime estimates (duration rounded
+    up to the minute) are attached so backfill has a horizon currency.
+    ``fleet_scale`` shrinks the trace fleet into the contention regime.
+    ``_detail``, when given, receives per-job admission times and the
+    reserved-job set for the cross-policy starvation audit."""
     assert mode in ("sweep", "event")
+    assert policy is None or policy in POLICIES
     event_mode = mode == "event"
     horizon = float(trace["horizon_s"])
     fleet = {k: int(v) for k, v in trace["fleet"].items()}
+    if policy is not None and fleet_scale != 1.0:
+        fleet = {k: max(1, int(round(v * fleet_scale)))
+                 for k, v in fleet.items()}
     capacity = sum(fleet.values())
     clock = _Clock()
     jobs: Dict[str, _Job] = {}
@@ -185,9 +231,20 @@ def simulate(trace: dict, mode: str) -> dict:
             return 0
         return int((clock.now - j.run_started_at) % CKPT_PERIOD)
 
+    topology = None
+    if policy is not None:
+        topology = {
+            a: PoolTopology(
+                pods=int(math.ceil(n / POLICY_SLICES_PER_POD)),
+                slices_per_pod=POLICY_SLICES_PER_POD)
+            for a, n in fleet.items()
+        }
     sched = ClusterScheduler(
-        SliceInventory(fleet), clock=clock, cost_fn=cost_fn,
-        preemption_cooldown=PREEMPTION_COOLDOWN)
+        SliceInventory(fleet, topology=topology,
+                       packing=policy == "backfill+pack"),
+        clock=clock, cost_fn=cost_fn,
+        preemption_cooldown=PREEMPTION_COOLDOWN,
+        backfill=policy in ("backfill", "backfill+pack"))
     wq = CoalescingWorkQueue(clock=clock) if event_mode else None
 
     # counters
@@ -221,12 +278,20 @@ def simulate(trace: dict, mode: str) -> dict:
         used_slices += delta
 
     def request_of(j: _Job) -> JobRequest:
+        est = 0.0
+        if policy is not None:
+            # the deterministic stand-in for runtimeEstimateSeconds:
+            # the job's full occupancy span (gang creation + run time)
+            # rounded UP to the next minute — coarse the way an
+            # operator's guess is, and never an UNDER-estimate, so
+            # conservative backfill stays conservative against truth
+            est = math.ceil((j.creation + j.duration) / 60.0) * 60.0
         return JobRequest(
             key=j.key,
             footprint=Footprint(ACCEL, slices=j.slices,
                                 chips=j.slices * CHIPS_PER_SLICE),
             priority=j.priority, queue=j.queue,
-            preemptible=j.preemptible)
+            preemptible=j.preemptible, runtime_estimate_s=est)
 
     def start_creating(j: _Job):
         j.phase = CREATING
@@ -289,6 +354,16 @@ def simulate(trace: dict, mode: str) -> dict:
             return RECONCILE_INTERVAL  # obs window processing cadence
         return RESYNC_SECONDS  # quiescent RUNNING: backstop only
 
+    # time-weighted fragmentation: the post-tick value holds until the
+    # next decision pass (policy arms only; 0-weight otherwise)
+    frag_state = [0.0, 0.0]  # (area, last value)
+    last_frag_at = [0.0]
+
+    def sample_frag():
+        frag_state[0] += frag_state[1] * (clock.now - last_frag_at[0])
+        last_frag_at[0] = clock.now
+        frag_state[1] = sched.inventory.fragmentation(ACCEL)
+
     def sched_tick():
         c["sched_ticks"] += 1
         result = sched.tick()
@@ -296,6 +371,8 @@ def simulate(trace: dict, mode: str) -> dict:
             preempt(jobs[p.victim])
         for req in result.admitted:
             start_creating(jobs[req.key])
+        if policy is not None:
+            sample_frag()
         next_sched_at[0] = math.inf
         if event_mode:
             nxt = clock.now + SCHED_BACKSTOP
@@ -403,6 +480,23 @@ def simulate(trace: dict, mode: str) -> dict:
         summary["queue_adds"] = wq.added
         summary["queue_coalesced"] = wq.coalesced
         summary["queue_requeued"] = wq.requeued
+    if policy is not None:
+        # close the fragmentation integral at the horizon
+        frag_state[0] += frag_state[1] * (horizon - last_frag_at[0])
+        hit = sched.inventory.contiguity_hit_rate(ACCEL)
+        summary.update({
+            "policy": policy,
+            "fleet_slices": capacity,
+            "fragmentation_mean": round(frag_state[0] / horizon, 4),
+            "contiguity_hit_rate": (round(hit, 4)
+                                    if hit is not None else None),
+            "backfills": sched.backfills_total,
+            "reserved_jobs": len(sched.reserved_ever),
+        })
+        if _detail is not None:
+            _detail["admitted_at"] = {
+                k: j.admitted_at for k, j in jobs.items()}
+            _detail["reserved_ever"] = set(sched.reserved_ever)
     return summary
 
 
@@ -456,6 +550,131 @@ def check_golden(summary: dict, golden: dict) -> List[str]:
     return errs
 
 
+def run_policies(trace: dict, fleet_scale: float) -> dict:
+    """The policy A/B: replay the SAME trace under all three
+    placement/backfill arms (event-driven control plane; the fleet
+    scaled into contention), then audit zero reserved-job starvation —
+    every job the backfill arms ever RESERVED and fifo-reserve
+    admitted must ALSO admit under backfill (zero tolerance), and any
+    admission delay vs the fifo-reserve baseline stays under the
+    golden's cap (EASY promises the reservation horizon, which the
+    scheduler asserts per round; the cross-arm delta only bounds the
+    residual preemption/cooldown noise)."""
+    horizon = float(trace["horizon_s"])
+    arms: Dict[str, dict] = {}
+    details: Dict[str, dict] = {}
+    for pol in POLICIES:
+        d: dict = {}
+        arms[pol] = simulate(trace, "event", policy=pol,
+                             fleet_scale=fleet_scale, _detail=d)
+        details[pol] = d
+    base = details["fifo-reserve"]["admitted_at"]
+
+    def audit(pol: str) -> dict:
+        """STARVED (zero-tolerance): fifo-reserve admitted the
+        reserved job but this arm never did — backfill denied it
+        service outright. DELAYED (budgeted): admitted, but later
+        than under fifo-reserve; EASY's guarantee is admission by
+        the RESERVATION horizon (the scheduler asserts that one
+        per round), not by the counterfactual fifo time, so small
+        bounded deltas from preemption-cooldown/victim dynamics
+        are expected — the golden caps their magnitude."""
+        d = details[pol]
+        starved = 0
+        delayed = 0
+        max_delay = 0.0
+        for key in d["reserved_ever"]:
+            tb = base.get(key)
+            tp = d["admitted_at"].get(key)
+            if tp is None:
+                if tb is not None:
+                    starved += 1
+                continue
+            tb = horizon if tb is None else tb
+            if tp > tb + 1e-6:
+                delayed += 1
+                max_delay = max(max_delay, tp - tb)
+        return {"reserved_jobs": len(d["reserved_ever"]),
+                "starved": starved,
+                "delayed_jobs": delayed,
+                "max_reserved_delay_s": round(max_delay, 3)}
+
+    fifo, pack = arms["fifo-reserve"], arms["backfill+pack"]
+    return {
+        "bench": "sched-policy",
+        "jobs": len(trace["jobs"]),
+        "seed": trace.get("seed"),
+        "horizon_s": horizon,
+        "fleet_scale": fleet_scale,
+        "fleet_slices": pack["fleet_slices"],
+        "trace_digest": trace_digest(trace),
+        "arms": arms,
+        "starvation_audit": {
+            p: audit(p) for p in ("backfill", "backfill+pack")},
+        "ab": {
+            "utilization_gain": round(
+                pack["utilization"] - fifo["utilization"], 4),
+            "wait_p50_gain_s": round(
+                fifo["admission_p50_s"] - pack["admission_p50_s"], 3),
+            "admission_p99_delta_s": round(
+                pack["admission_p99_s"] - fifo["admission_p99_s"], 3),
+        },
+    }
+
+
+def check_policy_golden(summary: dict, golden: dict) -> List[str]:
+    """The policy gates (ISSUE acceptance shape): same digest + pinned
+    fleet scale; backfill+pack STRICTLY improves utilization and wait
+    p50 over fifo-reserve at equal-or-better admission p99; ZERO
+    reserved-job starvation in both backfill arms; the contiguity
+    scorer actually lands contiguous blocks."""
+    errs = []
+    b = golden.get("budgets", {})
+    want_digest = golden.get("trace_digest")
+    if want_digest and summary["trace_digest"] != want_digest:
+        errs.append(f"trace digest {summary['trace_digest'][:12]} != "
+                    f"golden {want_digest[:12]}")
+    want_scale = golden.get("fleet_scale")
+    if want_scale is not None and summary["fleet_scale"] != want_scale:
+        errs.append(f"fleet scale {summary['fleet_scale']} != pinned "
+                    f"{want_scale}")
+    ab = summary["ab"]
+    util_floor = b.get("min_utilization_gain", 0.0)
+    if ab["utilization_gain"] <= util_floor:
+        errs.append(f"backfill+pack utilization gain "
+                    f"{ab['utilization_gain']} not STRICTLY above "
+                    f"{util_floor}")
+    p50_floor = b.get("min_wait_p50_gain_s", 0.0)
+    if ab["wait_p50_gain_s"] <= p50_floor:
+        errs.append(f"backfill+pack wait p50 gain "
+                    f"{ab['wait_p50_gain_s']}s not STRICTLY above "
+                    f"{p50_floor}s")
+    p99_slack = b.get("max_admission_p99_slack_s", 0.0)
+    if ab["admission_p99_delta_s"] > p99_slack:
+        errs.append(f"backfill+pack admission p99 is "
+                    f"{ab['admission_p99_delta_s']}s worse than "
+                    f"fifo-reserve (> {p99_slack}s budget)")
+    delay_cap = b.get("max_reserved_delay_s", 60.0)
+    for pol, audit in summary["starvation_audit"].items():
+        if audit["starved"]:
+            errs.append(
+                f"{pol}: {audit['starved']} reserved job(s) admitted "
+                f"under fifo-reserve but NEVER under {pol} — "
+                f"starvation")
+        if audit["max_reserved_delay_s"] > delay_cap:
+            errs.append(
+                f"{pol}: reserved-job admission delayed "
+                f"{audit['max_reserved_delay_s']}s past the "
+                f"fifo-reserve baseline (> {delay_cap}s cap)")
+    hit_floor = b.get("min_contiguity_hit_rate")
+    if hit_floor is not None:
+        hit = summary["arms"]["backfill+pack"]["contiguity_hit_rate"]
+        if hit is None or hit < hit_floor:
+            errs.append(f"backfill+pack contiguity hit-rate {hit} < "
+                        f"{hit_floor} floor")
+    return errs
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="sched_bench")
     p.add_argument("--jobs", type=int, default=1000)
@@ -476,6 +695,16 @@ def main(argv=None) -> int:
     p.add_argument("--golden", default="",
                    help="golden budget file; violations exit 1")
     p.add_argument("--out", default="", help="write the summary JSON")
+    p.add_argument("--policy", default="",
+                   choices=("",) + POLICIES + ("ab",),
+                   help="placement/backfill policy axis: run ONE arm, "
+                        "or 'ab' for the fifo-reserve vs backfill vs "
+                        "backfill+pack comparison with the starvation "
+                        "audit (goldens gate the ab form)")
+    p.add_argument("--fleet-scale", type=float, default=1.0,
+                   help="scale the trace fleet (policy runs only) "
+                        "into the contention regime; pinned in the "
+                        "policy golden")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -500,7 +729,14 @@ def main(argv=None) -> int:
                           "trace_digest": trace_digest(trace)}))
         return 0
 
-    summary = run(trace)
+    if args.policy == "ab":
+        summary = run_policies(trace, args.fleet_scale)
+    elif args.policy:
+        summary = simulate(trace, "event", policy=args.policy,
+                           fleet_scale=args.fleet_scale)
+        summary["trace_digest"] = trace_digest(trace)
+    else:
+        summary = run(trace)
     print(json.dumps(summary))
     if args.out:
         os.makedirs(os.path.dirname(os.path.abspath(args.out)),
@@ -511,7 +747,14 @@ def main(argv=None) -> int:
     if args.golden:
         with open(args.golden) as f:
             golden = json.load(f)
-        errs = check_golden(summary, golden)
+        if args.policy == "ab":
+            errs = check_policy_golden(summary, golden)
+        elif args.policy:
+            print("--golden with a single --policy arm is not gated; "
+                  "use --policy ab", file=sys.stderr)
+            return 2
+        else:
+            errs = check_golden(summary, golden)
         for e in errs:
             print(f"SCHED BENCH BUDGET: {e}", file=sys.stderr)
         if errs:

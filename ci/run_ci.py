@@ -246,6 +246,35 @@ def main(argv=None) -> int:
              "--out", f"{args.artifacts_dir}/sched_bench_1000.json"],
             args.artifacts_dir, cases,
         )
+        # placement/backfill policy A/B (ISSUE 20): the SAME committed
+        # 200-job trace, fleet scaled into contention (pinned in the
+        # golden), replayed under fifo-reserve vs backfill vs
+        # backfill+pack. The golden gates that backfill+pack STRICTLY
+        # improves chip-utilization and queue-wait p50 at
+        # equal-or-better admission p99, with zero reserved-job
+        # starvation (any backfill that delayed a reservation past
+        # its horizon would additionally raise StarvationError inside
+        # the scheduler and fail the run outright).
+        ok = ok and stage(
+            "sched-policy",
+            [py, "benches/sched_bench.py",
+             "--trace", "ci/sched_bench/trace_200.json",
+             "--policy", "ab", "--fleet-scale", "0.5",
+             "--golden", "ci/sched_bench/golden_policy.json",
+             "--out", f"{args.artifacts_dir}/sched_policy_200.json"],
+            args.artifacts_dir, cases,
+        )
+        # ...and the 1000-job policy A/B at fleet scale 0.55 — the
+        # contention knee where the queue is real but the median job
+        # is not horizon-censored, so the wait-p50 gate has signal.
+        ok = ok and stage(
+            "sched-policy-1000",
+            [py, "benches/sched_bench.py", "--jobs", "1000",
+             "--policy", "ab", "--fleet-scale", "0.55",
+             "--golden", "ci/sched_bench/golden_policy_1000.json",
+             "--out", f"{args.artifacts_dir}/sched_policy_1000.json"],
+            args.artifacts_dir, cases,
+        )
         # elastic-resize gate (ISSUE 12): the resize decision core's
         # full matrix (dead-heartbeat / inventory shrink triggers, grow
         # hold, clamps, cooldown, health-gated restore ceiling, budget

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, Optional
 
 from k8s_tpu.api import errors
@@ -27,8 +28,10 @@ from k8s_tpu.robustness.backoff import Backoff, BackoffPolicy
 from k8s_tpu.sched import (
     ClusterScheduler,
     JobRequest,
+    PoolTopology,
     Preemption,
     SliceInventory,
+    TickResult,
     footprint_of,
 )
 from k8s_tpu.spec import ControllerConfig, TpuJob, TpuJobPhase
@@ -91,11 +94,27 @@ class Controller:
         self.sched_interval = sched_interval
         self.scheduler: Optional[ClusterScheduler] = None
         if self.config.fleet:
+            # fleet entries with a topology block get named slices on
+            # the ICI-pod grid; the placement scorer packs them only
+            # under the backfill+pack policy (docs/SCHEDULER.md
+            # "Placement" — every policy is A/B-proven on sched_bench
+            # before it runs a real fleet)
+            policy = getattr(
+                self.config, "scheduler_policy", "fifo-reserve")
+            topology = {
+                accel: PoolTopology(int(shape[0]), int(shape[1]))
+                for accel, shape in (
+                    getattr(self.config, "fleet_topology", None)
+                    or {}).items()
+            }
             self.scheduler = ClusterScheduler(
-                SliceInventory(self.config.fleet),
+                SliceInventory(self.config.fleet,
+                               topology=topology,
+                               packing=policy == "backfill+pack"),
                 quotas=self.config.scheduler_quotas,
                 cost_fn=self._preemption_cost,
                 preemption_cooldown=self.config.scheduler_cooldown_seconds,
+                backfill=policy in ("backfill", "backfill+pack"),
             )
             # capacity-return tick (docs/ELASTIC.md): a freed slice
             # nudges every elastic gang's reconciler so grow decisions
@@ -103,6 +122,11 @@ class Controller:
             self.scheduler.inventory.on_capacity(self._on_capacity_return)
         self._sched_lock = threading.RLock()
         self._sched_thread: Optional[threading.Thread] = None
+        # key → blocked category last written into its Queued condition
+        # (diagnosability): a condition is appended only when the WHY
+        # changes, never per tick — a parked job must not accrete a
+        # thousand identical conditions
+        self._blocked_surfaced: Dict[str, str] = {}
         # dedup "kick" for the event-driven scheduler tick: a burst of
         # job deltas (N completions, a mass delete) wakes the tick loop
         # ONCE instead of running N full scheduler passes
@@ -291,6 +315,7 @@ class Controller:
         priority = 0
         queue = "default"
         preemptible = True
+        estimate = 0.0
         if s is not None:
             try:
                 priority = int(s.priority)
@@ -298,6 +323,11 @@ class Controller:
                 priority = 0  # validation rejects it properly at setup
             queue = s.queue or "default"
             preemptible = bool(s.preemptible)
+            try:
+                estimate = max(
+                    0.0, float(s.runtime_estimate_seconds or 0.0))
+            except (TypeError, ValueError):
+                estimate = 0.0
         fp = footprint_of(job.spec)
         dp = getattr(job.status, "dp_degree", 0) or 0
         if (dp > 0 and job.spec.elastic is not None
@@ -310,6 +340,7 @@ class Controller:
         return JobRequest(
             key=job.key, footprint=fp,
             priority=priority, queue=queue, preemptible=preemptible,
+            runtime_estimate_s=estimate,
         )
 
     def _preemption_cost(self, key: str) -> int:
@@ -490,16 +521,58 @@ class Controller:
         decision in ``result`` belongs to exactly this caller (tick()
         already moved the jobs, so a concurrent tick cannot re-decide
         them)."""
+        from k8s_tpu.controller import metrics
+
         sched = self.scheduler
         if sched is None:
             return
+        t0 = time.monotonic()
         with self._sched_lock:
             result = sched.tick()
+        # placement-scoring cost at O(1000) jobs is a measured quantity
+        # (the reconcile-latency idiom): only the pure decision pass is
+        # timed — acting on the verdicts does I/O and is not the
+        # scheduler's cost
+        metrics.SCHED_TICK_SECONDS.observe(time.monotonic() - t0)
+        for key in result.backfilled:
+            req = sched.running_request(key)
+            metrics.SCHED_BACKFILLS.inc(
+                {"queue": req.queue if req is not None else "unknown"})
         for p in result.preempted:
             self._apply_preemption(p)
         for req in result.admitted:
             self._admit_job(req)
+        self._surface_blocked(result)
         self._export_sched_metrics()
+
+    def _surface_blocked(self, result: TickResult) -> None:
+        """Queued-phase diagnosability: write each parked job's blocked
+        WHY (capacity / quota / cooldown / reservation /
+        backfill-refused) into its Queued condition — but only when the
+        category CHANGES, so a job parked behind capacity for an hour
+        carries one condition, not 3600. A key that leaves the blocked
+        set is forgotten, so re-parking later re-surfaces."""
+        for key in list(self._blocked_surfaced):
+            if key not in result.blocked:
+                self._blocked_surfaced.pop(key, None)
+        for key, reason in result.blocked.items():
+            category = result.blocked_category.get(key, "")
+            if self._blocked_surfaced.get(key) == category:
+                continue
+            self._blocked_surfaced[key] = category
+            ns, name = key.split("/", 1)
+            # Some scheduler messages already lead with the category
+            # word ("capacity: 2 × ..."); don't double the prefix.
+            text = reason if reason.startswith(f"{category}:") \
+                else f"{category}: {reason}"
+            try:
+                job = self.job_client.get(ns, name)
+                if job.status.phase != TpuJobPhase.QUEUED:
+                    continue
+                job.status.append_condition("Queued", reason=text)
+                self.job_client.update(job)
+            except Exception as e:  # diagnosability is best-effort
+                log.debug("job %s: blocked-reason write: %s", key, e)
 
     def _admit_job(self, req: JobRequest) -> None:
         from k8s_tpu.controller import metrics
@@ -627,6 +700,14 @@ class Controller:
         for accel, pool in stats["pools"].items():
             metrics.SCHED_SLICES_FREE.set(
                 float(pool["free"]), {"accelerator": accel})
+        # placement scoring (pools with a fleet topology block only)
+        for accel, p in stats.get("placement", {}).items():
+            metrics.SCHED_FRAGMENTATION.set(
+                p["fragmentation"], {"accelerator": accel})
+            if p["contiguity_requests"] > 0:
+                metrics.SCHED_CONTIGUITY_HIT_RATE.set(
+                    p["contiguity_hits"] / p["contiguity_requests"],
+                    {"accelerator": accel})
 
     # ---------------------------------------------------- event-driven feed
 

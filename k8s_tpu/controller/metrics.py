@@ -405,6 +405,28 @@ SCHED_PREEMPT_LOST_STEPS = REGISTRY.counter(
     "flush usually reduces the realized loss, visible in "
     "ktpu_ckpt_lost_steps_total), by victim job",
 )
+SCHED_TICK_SECONDS = REGISTRY.histogram(
+    "ktpu_sched_tick_seconds",
+    "Wall-clock duration of each pure scheduler decision pass "
+    "(placement scoring + backfill pricing included; acting on the "
+    "verdicts is reconcile work and is not counted)",
+)
+SCHED_BACKFILLS = REGISTRY.counter(
+    "ktpu_sched_backfill_total",
+    "Jobs admitted through a head-of-line reservation gap by "
+    "conservative backfill, by queue",
+)
+SCHED_FRAGMENTATION = REGISTRY.gauge(
+    "ktpu_sched_fragmentation",
+    "Free-space fragmentation of each topology pool (1 − largest free "
+    "ICI-contiguous block / total free slices; 0 = one whole block), "
+    "by accelerator",
+)
+SCHED_CONTIGUITY_HIT_RATE = REGISTRY.gauge(
+    "ktpu_sched_contiguity_hit_rate",
+    "Fraction of multi-slice gang placements that landed on an "
+    "ICI-contiguous block since operator start, by accelerator",
+)
 # Elastic gang resize (k8s_tpu/resize, docs/ELASTIC.md): the
 # re-partitioning loop's own telemetry — how often gangs change shape,
 # what each shrink put at stake, and the live DP degree per job.

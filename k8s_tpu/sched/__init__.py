@@ -2,21 +2,28 @@
 
 The layer the Controller consults before any reconciler materializes
 resources: a slice inventory model derived from the controller-config
-accelerator fleet (:mod:`k8s_tpu.sched.inventory`) and a pure,
-clock-injected decision core (:mod:`k8s_tpu.sched.scheduler`)
-implementing per-queue quota admission, priority ordering, gang
-bin-packing onto slices, and checkpoint-cost-aware preemption.
+accelerator fleet (:mod:`k8s_tpu.sched.inventory`) — optionally with
+named slices on an ICI-pod topology grid and a pure placement scorer —
+and a pure, clock-injected decision core
+(:mod:`k8s_tpu.sched.scheduler`) implementing per-queue quota
+admission, priority ordering, gang bin-packing onto slices,
+checkpoint-cost-aware preemption, and EASY-style conservative backfill
+behind the head-of-line reservation.
 """
 
 from k8s_tpu.sched.inventory import (  # noqa: F401
     Footprint,
     OversubscriptionError,
+    PoolTopology,
+    SliceAssignment,
     SliceInventory,
     footprint_of,
+    plan_placement,
 )
 from k8s_tpu.sched.scheduler import (  # noqa: F401
     ClusterScheduler,
     JobRequest,
     Preemption,
+    StarvationError,
     TickResult,
 )

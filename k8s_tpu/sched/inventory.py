@@ -20,13 +20,29 @@ charge site — :class:`OversubscriptionError` is a scheduler bug, not a
 recoverable condition — and keeps a high-water mark per accelerator so
 tests can assert the invariant held across a whole run, not just at
 the end.
+
+Placement (docs/SCHEDULER.md "Placement"): a pool may additionally
+declare a :class:`PoolTopology` — its slices become NAMED positions on
+a grid of ICI pods (each pod a linear chain of ``slicesPerPod``
+positions; ICI contiguity exists only WITHIN a pod, pods talk over
+DCN). ``charge`` then also plans and returns a
+:class:`SliceAssignment` — which concrete positions the gang holds —
+via the pure scorer :func:`plan_placement`: multi-slice gangs prefer
+an ICI-contiguous block, single slices best-fit into the smallest free
+block so the large contiguous blocks future gangs need stay whole.
+The counting ledger stays the ONLY admission authority: with no
+topology configured nothing below changes at all, and even with one,
+placement annotates decisions but never vetoes them (a gang that fits
+by count but not contiguously is placed fragmented, not refused —
+multislice runs over DCN).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 from k8s_tpu.spec import topology as topo
 
@@ -87,13 +103,140 @@ def footprint_of(spec) -> Footprint:
     return Footprint(tpu.accelerator, slices=n, chips=n * t.chips)
 
 
+# ---------------------------------------------------------------------------
+# Named slices: pool topology + assignments + the pure placement scorer
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PoolTopology:
+    """ICI shape of one pool: ``pods`` independent ICI domains, each a
+    linear chain of ``slices_per_pod`` slice positions. Position ``p``
+    on the global grid lives in pod ``p // slices_per_pod``; two
+    positions are ICI-adjacent iff they are in the same pod at
+    consecutive indices. (A linear chain is deliberately the whole
+    model: it captures the thing the scorer must protect — contiguous
+    blocks are scarce and fragmentation destroys them — without
+    modeling torus wraparound the fleet config cannot express yet.)"""
+
+    pods: int
+    slices_per_pod: int
+
+    @property
+    def positions(self) -> int:
+        return self.pods * self.slices_per_pod
+
+    def validate(self) -> None:
+        if self.pods <= 0 or self.slices_per_pod <= 0:
+            raise ValueError(
+                f"pool topology needs positive pods/slicesPerPod, got "
+                f"{self.pods}×{self.slices_per_pod}")
+
+
+@dataclass(frozen=True)
+class SliceAssignment:
+    """Which concrete grid positions one admitted gang holds.
+    ``contiguous`` is True when the whole gang sits on ICI-adjacent
+    positions inside one pod (the multislice fast path); single-slice
+    jobs are trivially contiguous."""
+
+    accelerator: str
+    positions: Tuple[int, ...]
+    slices_per_pod: int
+    contiguous: bool
+
+    def pods(self) -> Tuple[int, ...]:
+        return tuple(sorted({p // self.slices_per_pod
+                             for p in self.positions}))
+
+    def __str__(self) -> str:
+        coords = ",".join(
+            f"{p // self.slices_per_pod}.{p % self.slices_per_pod}"
+            for p in self.positions)
+        kind = "ici-contiguous" if self.contiguous else "dcn-spanning"
+        return f"{self.accelerator}[{coords}] ({kind})"
+
+
+def _free_runs(free: Set[int], t: PoolTopology) -> List[Tuple[int, int]]:
+    """Maximal runs of free positions that do not cross a pod boundary,
+    as ``(start, length)`` ascending by start. Pure."""
+    runs: List[Tuple[int, int]] = []
+    start = None
+    for p in range(t.positions):
+        boundary = p % t.slices_per_pod == 0
+        if p in free and not (boundary and start is not None):
+            if start is None:
+                start = p
+            continue
+        if start is not None:
+            runs.append((start, p - start))
+            start = None
+        if p in free:  # run ended exactly at a pod boundary
+            start = p
+    if start is not None:
+        runs.append((start, t.positions - start))
+    return runs
+
+
+def plan_placement(free: Set[int], t: PoolTopology, slices: int,
+                   packing: bool = True) -> Tuple[Tuple[int, ...], bool]:
+    """The pure placement scorer. Given the free positions of one pool,
+    pick ``slices`` of them. Returns ``(positions, contiguous)``;
+    callers guarantee ``len(free) >= slices`` (admission is counting).
+
+    ``packing=True`` (the backfill+pack policy):
+
+    - a multi-slice gang takes the SMALLEST free in-pod run that still
+      holds it whole (best-fit: exact fits are consumed first, the big
+      contiguous blocks survive for bigger gangs); when no single run
+      fits, it falls back to consuming the smallest runs first — the
+      fragments — so the spill costs the least future contiguity;
+    - a single slice best-fits the same way: into the smallest run,
+      never splitting a large block a gang could have used.
+
+    ``packing=False`` models a topology-blind ledger: first-fit at the
+    lowest free positions, whatever that does to the blocks."""
+    if slices <= 0:
+        return (), True
+    runs = _free_runs(free, t)
+    if not packing:
+        chosen = sorted(free)[:slices]
+        contiguous = any(
+            s <= chosen[0] and chosen[-1] < s + ln
+            for s, ln in runs) and (
+            chosen[-1] - chosen[0] + 1 == slices)
+        return tuple(chosen), contiguous
+    fitting = [(ln, s) for s, ln in runs if ln >= slices]
+    if fitting:
+        ln, s = min(fitting)
+        return tuple(range(s, s + slices)), True
+    # no single in-pod run holds the gang: spend the smallest fragments
+    # first so the largest surviving block stays as large as possible
+    chosen: List[int] = []
+    for ln, s in sorted((ln, s) for s, ln in runs):
+        take = min(ln, slices - len(chosen))
+        chosen.extend(range(s, s + take))
+        if len(chosen) >= slices:
+            break
+    return tuple(sorted(chosen)), False
+
+
 class SliceInventory:
     """The fleet ledger: capacity per accelerator type, charges per job.
 
     Thread-safe (the scheduler mutates it under its own lock, but
-    metrics exporters and tests read it from other threads)."""
+    metrics exporters and tests read it from other threads).
 
-    def __init__(self, fleet: Dict[str, int]):
+    ``topology`` optionally names the slices of some pools (see
+    :class:`PoolTopology`); those pools additionally track WHICH
+    positions each holder owns and ``charge``/``recharge`` return the
+    planned :class:`SliceAssignment`. ``packing`` selects the scorer
+    policy (:func:`plan_placement`); it changes assignments only,
+    never admission counts."""
+
+    def __init__(self, fleet: Dict[str, int],
+                 topology: Optional[Dict[str, PoolTopology]] = None,
+                 packing: bool = True):
         self._capacity: Dict[str, int] = {
             a: int(n) for a, n in (fleet or {}).items() if int(n) > 0
         }
@@ -109,6 +252,29 @@ class SliceInventory:
         # Called OUTSIDE the lock: a listener that re-enters the
         # inventory (or nudges a reconciler) must never deadlock it.
         self._capacity_listeners: list = []
+        # ------------------------------------------------ named slices
+        self.packing = bool(packing)
+        self._topology: Dict[str, PoolTopology] = {}
+        # accelerator → position → holder key (occupied positions only)
+        self._grid: Dict[str, Dict[int, str]] = {}
+        # positions administratively off after a capacity shrink —
+        # they stay on the grid (coordinates are physical) but the
+        # scorer may not place on them
+        self._revoked: Dict[str, Set[int]] = {}
+        self._assignments: Dict[str, SliceAssignment] = {}
+        # contiguity hit-rate inputs (multi-slice placements only)
+        self.contiguity_requests: Dict[str, int] = {}
+        self.contiguity_hits: Dict[str, int] = {}
+        for a, t in (topology or {}).items():
+            if a not in self._capacity:
+                continue
+            t.validate()
+            self._topology[a] = t
+            self._grid[a] = {}
+            self._revoked[a] = set()
+            self.contiguity_requests[a] = 0
+            self.contiguity_hits[a] = 0
+            self._sync_topology_locked(a)
 
     # ------------------------------------------------------------- reads
 
@@ -154,32 +320,94 @@ class SliceInventory:
                 for a, c in self._capacity.items()
             }
 
+    def topology(self, accelerator: str) -> Optional[PoolTopology]:
+        with self._lock:
+            return self._topology.get(accelerator)
+
+    def assignment(self, key: str) -> Optional[SliceAssignment]:
+        with self._lock:
+            return self._assignments.get(key)
+
+    def fragmentation(self, accelerator: str) -> float:
+        """How broken the pool's free space is: ``1 − largest free
+        in-pod run / total free positions`` (0 = every free slice sits
+        in one contiguous block, →1 = pure confetti; 0 when the pool
+        is full or has no topology)."""
+        with self._lock:
+            t = self._topology.get(accelerator)
+            if t is None:
+                return 0.0
+            free = self._free_positions_locked(accelerator)
+            if not free:
+                return 0.0
+            runs = _free_runs(free, t)
+            return 1.0 - max(ln for _s, ln in runs) / len(free)
+
+    def contiguity_hit_rate(self, accelerator: str) -> Optional[float]:
+        """Fraction of multi-slice placements that landed ICI-contiguous
+        (None until the pool has seen one)."""
+        with self._lock:
+            n = self.contiguity_requests.get(accelerator, 0)
+            if n == 0:
+                return None
+            return self.contiguity_hits.get(accelerator, 0) / n
+
+    def placement_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-topology-pool scoring feed (the ktpu_sched_fragmentation
+        / contiguity gauges): empty when no pool declares a topology."""
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {}
+            for a, t in self._topology.items():
+                free = self._free_positions_locked(a)
+                runs = _free_runs(free, t) if free else []
+                out[a] = {
+                    "fragmentation": self.fragmentation(a),
+                    "largest_free_block": float(
+                        max((ln for _s, ln in runs), default=0)),
+                    "contiguity_requests": float(
+                        self.contiguity_requests.get(a, 0)),
+                    "contiguity_hits": float(
+                        self.contiguity_hits.get(a, 0)),
+                }
+            return out
+
     # ------------------------------------------------------------- writes
 
-    def charge(self, key: str, fp: Footprint, force: bool = False) -> None:
+    def charge(self, key: str, fp: Footprint,
+               force: bool = False) -> Optional[SliceAssignment]:
         """Charge ``key``'s whole footprint atomically. ``force`` is the
         adoption path ONLY (an operator restart re-adopting a gang that
         is already physically running must never kill it over a ledger
         it cannot have corrupted) — everywhere else an over-capacity
         charge raises, because admitting past capacity is exactly the
-        two-jobs-own-one-slice bug this subsystem exists to end."""
+        two-jobs-own-one-slice bug this subsystem exists to end.
+
+        Returns the planned :class:`SliceAssignment` when the pool has
+        a topology (None otherwise, and None for a force-charge the
+        grid has no room for — the counting ledger still holds the
+        charge; placement never overrules it)."""
         if fp.empty:
-            return
+            return None
         with self._lock:
-            if key in self._holders:
-                raise ValueError(f"{key} is already charged")
-            if not force and not self.fits(fp):
-                raise OversubscriptionError(
-                    f"charging {key} ({fp}) would oversubscribe "
-                    f"{fp.accelerator}: used {self.used(fp.accelerator)}"
-                    f"/{self.capacity(fp.accelerator)} slices")
-            self._used[fp.accelerator] = (
-                self._used.get(fp.accelerator, 0) + fp.slices)
-            self._capacity.setdefault(fp.accelerator, 0)
-            self._holders[key] = fp
-            self.max_used[fp.accelerator] = max(
-                self.max_used.get(fp.accelerator, 0),
-                self._used[fp.accelerator])
+            self._charge_count_locked(key, fp, force)
+            return self._place_locked(key, fp)
+
+    def _charge_count_locked(self, key: str, fp: Footprint,
+                             force: bool) -> None:
+        if key in self._holders:
+            raise ValueError(f"{key} is already charged")
+        if not force and not self.fits(fp):
+            raise OversubscriptionError(
+                f"charging {key} ({fp}) would oversubscribe "
+                f"{fp.accelerator}: used {self.used(fp.accelerator)}"
+                f"/{self.capacity(fp.accelerator)} slices")
+        self._used[fp.accelerator] = (
+            self._used.get(fp.accelerator, 0) + fp.slices)
+        self._capacity.setdefault(fp.accelerator, 0)
+        self._holders[key] = fp
+        self.max_used[fp.accelerator] = max(
+            self.max_used.get(fp.accelerator, 0),
+            self._used[fp.accelerator])
 
     def release(self, key: str) -> Optional[Footprint]:
         with self._lock:
@@ -187,37 +415,51 @@ class SliceInventory:
             if fp is not None:
                 self._used[fp.accelerator] = max(
                     0, self._used.get(fp.accelerator, 0) - fp.slices)
+                self._unplace_locked(key, fp.accelerator)
         if fp is not None and not fp.empty:
             self._notify_capacity(fp.accelerator)
         return fp
 
-    def recharge(self, key: str, fp: Footprint) -> None:
+    def recharge(self, key: str, fp: Footprint) -> Optional[SliceAssignment]:
         """Atomically replace ``key``'s charge with ``fp`` — the
         elastic-resize ledger move (docs/ELASTIC.md): a shrink frees
         slices and a grow re-charges them in ONE critical section, so
         no observer (and no high-water mark) ever sees the job owning
         both shapes at once, and a grow that would oversubscribe raises
         WITHOUT losing the old charge (the gang still physically holds
-        its current slices)."""
+        its current slices). On a topology pool the gang resizes IN
+        PLACE: a shrink surrenders its highest positions, a grow
+        extends from its existing ones — a resize is a re-partition of
+        the same hardware, not a move."""
         freed = False
         with self._lock:
             old = self._holders.pop(key, None)
+            old_asg = self._assignments.get(key)
             if old is not None:
                 self._used[old.accelerator] = max(
                     0, self._used.get(old.accelerator, 0) - old.slices)
+                self._unplace_locked(key, old.accelerator, sync=False)
             try:
-                self.charge(key, fp)
+                if not fp.empty:
+                    self._charge_count_locked(key, fp, force=False)
             except Exception:
                 if old is not None:  # restore the old charge untouched
                     self._used[old.accelerator] = (
                         self._used.get(old.accelerator, 0) + old.slices)
                     self._holders[key] = old
+                    if old_asg is not None:
+                        self._restore_locked(key, old_asg)
                 raise
+            asg = (self._place_locked(key, fp, prefer=old_asg)
+                   if not fp.empty else None)
+            if old is not None:
+                self._sync_topology_locked(old.accelerator)
             freed = (old is not None and not old.empty
                      and (fp.empty or fp.slices < old.slices
                           or fp.accelerator != old.accelerator))
         if freed:
             self._notify_capacity(old.accelerator)
+        return asg
 
     def set_capacity(self, accelerator: str, slices: int) -> None:
         """Resize one pool (node-pool scale events, the
@@ -226,7 +468,11 @@ class SliceInventory:
         pool simply admits nothing until it drains back under the new
         capacity (the no-flap rule: inventory flaps must not translate
         into admission/preemption churn). Growing the pool notifies the
-        capacity-return listeners (the elastic grow tick)."""
+        capacity-return listeners (the elastic grow tick). On a
+        topology pool a shrink revokes concrete FREE positions (highest
+        first); when usage exceeds the new capacity the revocation debt
+        is collected from future releases instead — same no-flap rule,
+        expressed in named slices."""
         grew = False
         with self._lock:
             if slices <= 0:
@@ -234,8 +480,111 @@ class SliceInventory:
             else:
                 grew = int(slices) > self._capacity.get(accelerator, 0)
                 self._capacity[accelerator] = int(slices)
+            self._sync_topology_locked(accelerator)
         if grew:
             self._notify_capacity(accelerator)
+
+    # --------------------------------------------------- placement (locked)
+
+    def _free_positions_locked(self, accelerator: str) -> Set[int]:
+        t = self._topology[accelerator]
+        taken = set(self._grid[accelerator]) | self._revoked[accelerator]
+        return {p for p in range(t.positions) if p not in taken}
+
+    def _place_locked(self, key: str, fp: Footprint,
+                      prefer: Optional[SliceAssignment] = None
+                      ) -> Optional[SliceAssignment]:
+        t = self._topology.get(fp.accelerator)
+        if t is None:
+            return None
+        free = self._free_positions_locked(fp.accelerator)
+        keep: Tuple[int, ...] = ()
+        if (prefer is not None
+                and prefer.accelerator == fp.accelerator):
+            # in-place resize: retain the (lowest) positions the gang
+            # already physically holds, plan only the delta
+            keep = tuple(sorted(prefer.positions))[:fp.slices]
+            free -= set(keep)
+        needed = fp.slices - len(keep)
+        if len(free) < needed:
+            # force-charge past capacity (adoption over a shrunken
+            # fleet): the annotation cannot name slices that do not
+            # exist — the counting ledger still records the deficit
+            return None
+        extra, _ = plan_placement(free, t, needed, self.packing)
+        positions = tuple(sorted(keep + extra))
+        contiguous = self._contiguous(positions, t)
+        asg = SliceAssignment(fp.accelerator, positions,
+                              t.slices_per_pod, contiguous)
+        grid = self._grid[fp.accelerator]
+        for p in positions:
+            grid[p] = key
+        self._assignments[key] = asg
+        if fp.slices > 1:
+            self.contiguity_requests[fp.accelerator] = (
+                self.contiguity_requests.get(fp.accelerator, 0) + 1)
+            if contiguous:
+                self.contiguity_hits[fp.accelerator] = (
+                    self.contiguity_hits.get(fp.accelerator, 0) + 1)
+        return asg
+
+    @staticmethod
+    def _contiguous(positions: Tuple[int, ...], t: PoolTopology) -> bool:
+        if len(positions) <= 1:
+            return True
+        lo, hi = positions[0], positions[-1]
+        return (hi - lo + 1 == len(positions)
+                and lo // t.slices_per_pod == hi // t.slices_per_pod)
+
+    def _unplace_locked(self, key: str, accelerator: str,
+                        sync: bool = True) -> None:
+        asg = self._assignments.pop(key, None)
+        if asg is None or accelerator not in self._grid:
+            return
+        grid = self._grid[accelerator]
+        for p in asg.positions:
+            if grid.get(p) == key:
+                del grid[p]
+        if sync:
+            # a shrink may be waiting on this release to collect its
+            # revocation debt (set_capacity below usage never preempts)
+            self._sync_topology_locked(accelerator)
+
+    def _restore_locked(self, key: str, asg: SliceAssignment) -> None:
+        grid = self._grid.get(asg.accelerator)
+        if grid is None:
+            return
+        for p in asg.positions:
+            grid[p] = key
+        self._revoked[asg.accelerator] -= set(asg.positions)
+        self._assignments[key] = asg
+
+    def _sync_topology_locked(self, accelerator: str) -> None:
+        """Reconcile the revoked-position set with the counting
+        capacity: grid positions beyond capacity are revoked (highest
+        FREE positions first — never an occupied one), and a grow
+        un-revokes (lowest first) or extends the grid by whole pods."""
+        t = self._topology.get(accelerator)
+        if t is None:
+            return
+        cap = self._capacity.get(accelerator, 0)
+        if cap > t.positions:
+            pods = math.ceil(cap / t.slices_per_pod)
+            t = PoolTopology(pods, t.slices_per_pod)
+            self._topology[accelerator] = t
+        revoked = self._revoked[accelerator]
+        target = t.positions - cap
+        while len(revoked) > target:
+            revoked.discard(min(revoked))
+        if len(revoked) < target:
+            occupied = set(self._grid[accelerator])
+            for p in range(t.positions - 1, -1, -1):
+                if len(revoked) >= target:
+                    break
+                if p not in occupied:
+                    revoked.add(p)
+            # any remaining debt is held by running gangs; collected
+            # as they release (no retro-preemption)
 
     # --------------------------------------------------------- listeners
 

@@ -27,15 +27,27 @@ Decision rules, in order, per tick (full table in docs/SCHEDULER.md):
    preemptor (never preempt uselessly);
 6. a capacity-blocked job RESERVES its accelerator for the rest of the
    scan: nothing behind it in the order may backfill onto that pool
-   (starvation protection for big gangs — head-of-line reservation).
+   (starvation protection for big gangs — head-of-line reservation);
+7. with ``backfill=True`` (docs/SCHEDULER.md "Placement"), the
+   reservation is priced instead of absolute: the reserved job gets an
+   expected-start horizon (free slices + slices it could preempt at
+   any moment + declared ``runtimeEstimateSeconds`` finish times of
+   the jobs it is waiting out), and a strictly-smaller job behind it
+   may slot into the gap ONLY when it provably cannot move that
+   horizon — it finishes before the horizon, or the pool still holds
+   the reserved gang's slices at the horizon even with it running.
+   Zero starvation is asserted per round: after the scan the horizon
+   is recomputed and must not have regressed (:class:`StarvationError`
+   is a scheduler bug, exactly like OversubscriptionError).
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from k8s_tpu.sched.inventory import Footprint, SliceInventory
 
@@ -49,16 +61,28 @@ DEFAULT_QUEUE = "default"
 DEFAULT_PREEMPTION_COOLDOWN = 5.0
 
 
+class StarvationError(RuntimeError):
+    """A backfill admission moved a reserved job's expected-start
+    horizon later (scheduler invariant bug — backfill must be free)."""
+
+
 @dataclass
 class JobRequest:
     """One job as the scheduler sees it (derived from spec.scheduling
-    + the footprint lookup; the scheduler never reads a CRD)."""
+    + the footprint lookup; the scheduler never reads a CRD).
+
+    ``runtime_estimate_s`` is the operator-declared expected runtime
+    (``scheduling.runtimeEstimateSeconds``; 0 = undeclared). It is
+    advisory and only ever used by conservative backfill — a job is
+    never killed for outliving its estimate, it just stops being
+    eligible to slot into reservation gaps."""
 
     key: str
     footprint: Footprint = field(default_factory=Footprint)
     priority: int = 0
     queue: str = DEFAULT_QUEUE
     preemptible: bool = True
+    runtime_estimate_s: float = 0.0
     seq: int = 0  # submit order, assigned by the scheduler
 
     def sort_key(self):
@@ -83,6 +107,40 @@ class TickResult:
     preempted: List[Preemption] = field(default_factory=list)
     # key → human-readable reason the job stayed queued this tick
     blocked: Dict[str, str] = field(default_factory=dict)
+    # key → machine-readable WHY for the same jobs, one of BLOCKED_*:
+    # the reconciler surfaces this in the Queued condition so a parked
+    # job tells the operator which lever (quota? capacity? estimate?)
+    # would move it
+    blocked_category: Dict[str, str] = field(default_factory=dict)
+    # the admitted keys that entered through a reservation gap
+    backfilled: List[str] = field(default_factory=list)
+
+
+# blocked_category vocabulary (stable strings — surfaced in conditions)
+BLOCKED_COOLDOWN = "cooldown"
+BLOCKED_QUOTA = "quota"
+BLOCKED_NO_POOL = "no-pool"
+BLOCKED_CAPACITY = "capacity"
+BLOCKED_RESERVATION = "reservation"
+BLOCKED_BACKFILL_REFUSED = "backfill-refused"
+
+
+@dataclass
+class _Reservation:
+    """Head-of-line reservation, priced: ``horizon`` is the absolute
+    clock time the reserved gang can expect to start (``math.inf``
+    when the jobs it waits on declared no runtime estimate), and
+    ``avail_at_horizon`` the slices projected free at that instant —
+    current free + slices held by jobs the reserved gang may preempt
+    whenever it likes (the victim-pricing input: their eviction is
+    already paid for by priority) + slices returned by declared-
+    estimate finishes. Slack-based backfill draws this balance down;
+    it must never dip below the reserved gang's own need."""
+
+    key: str
+    slices: int
+    horizon: float
+    avail_at_horizon: int
 
 
 class ClusterScheduler:
@@ -91,7 +149,11 @@ class ClusterScheduler:
     ``quotas`` meters chips per queue (absent queue = unlimited).
     ``cost_fn(key) -> int`` prices a running job's eviction (steps at
     risk since its last healthy checkpoint — the operator wires it to
-    the goodput telemetry; defaults to 0 = cheapest)."""
+    the goodput telemetry; defaults to 0 = cheapest).
+    ``backfill`` turns the head-of-line reservation from an absolute
+    wall into a priced one (decision rule 7; default off — the
+    decision table is bit-identical to the pre-backfill scheduler
+    until the operator opts in)."""
 
     def __init__(
         self,
@@ -100,15 +162,26 @@ class ClusterScheduler:
         clock: Callable[[], float] = time.monotonic,
         cost_fn: Optional[Callable[[str], int]] = None,
         preemption_cooldown: float = DEFAULT_PREEMPTION_COOLDOWN,
+        backfill: bool = False,
     ):
         self.inventory = inventory
         self.quotas = dict(quotas or {})
         self.clock = clock
         self.cost_fn = cost_fn
         self.preemption_cooldown = preemption_cooldown
+        self.backfill = backfill
         self._pending: Dict[str, JobRequest] = {}
         self._running: Dict[str, JobRequest] = {}
         self._holdoff: Dict[str, float] = {}
+        # when each running job was (re-)admitted: remaining-runtime
+        # estimates for the backfill horizon count from here
+        self._admitted_at: Dict[str, float] = {}
+        # every key that has ever held a head-of-line reservation, and
+        # the cumulative backfill count — the bench's starvation audit
+        # and the ktpu_sched_backfill_total counter feed
+        self.reserved_ever: set = set()
+        self.backfills_total = 0
+        self._last_blocked: Dict[str, Tuple[str, str]] = {}
         self._seq = 0
         import threading
 
@@ -161,12 +234,17 @@ class ClusterScheduler:
                     req.key, req.footprint)
             self.inventory.charge(req.key, req.footprint, force=True)
             self._running[req.key] = req
+            # estimates restart from adoption time: conservative (an
+            # adopted gang mid-run looks LONGER than it is, never
+            # shorter — backfill horizons may only be pessimistic)
+            self._admitted_at[req.key] = self.clock()
 
     def remove(self, key: str) -> bool:
         """The job is gone (terminal or deleted): drop it from wherever
         it is and free its slices."""
         with self._lock:
             self._holdoff.pop(key, None)
+            self._admitted_at.pop(key, None)
             if self._pending.pop(key, None) is not None:
                 return True
             if self._running.pop(key, None) is not None:
@@ -185,6 +263,7 @@ class ClusterScheduler:
         with self._lock:
             if self._running.pop(req.key, None) is not None:
                 self.inventory.release(req.key)
+            self._admitted_at.pop(req.key, None)
             if req.seq <= 0:
                 self._seq += 1
                 req.seq = self._seq
@@ -235,6 +314,7 @@ class ClusterScheduler:
             if req is None:
                 return False
             self.inventory.release(key)
+            self._admitted_at.pop(key, None)
             self._pending[key] = req
             cd = self.preemption_cooldown if cooldown is None else cooldown
             self._holdoff[key] = self.clock() + cd
@@ -281,7 +361,9 @@ class ClusterScheduler:
 
     def stats(self) -> Dict[str, Dict]:
         """The gauge feed (ktpu_sched_*): queue depths, quota usage,
-        free slices per pool."""
+        free slices per pool, per-pool placement scoring, and the last
+        tick's per-job blocked verdicts (category + readable reason —
+        the Queued-condition diagnosability feed)."""
         with self._lock:
             depth: Dict[str, int] = {}
             for r in self._pending.values():
@@ -290,8 +372,15 @@ class ClusterScheduler:
                 "queue_depth": depth,
                 "quota_used_chips": self.queue_used_chips(),
                 "pools": self.inventory.snapshot(),
+                "placement": self.inventory.placement_stats(),
                 "running": len(self._running),
                 "pending": len(self._pending),
+                "backfills_total": self.backfills_total,
+                "blocked": {
+                    k: {"category": c, "reason": r}
+                    for k, (c, r) in self._last_blocked.items()
+                    if k in self._pending
+                },
             }
 
     # ------------------------------------------------------------- decide
@@ -304,15 +393,16 @@ class ClusterScheduler:
         with self._lock:
             now = self.clock()
             result = TickResult()
-            reserved: Dict[str, str] = {}  # accelerator → blocked job key
+            reserved: Dict[str, _Reservation] = {}  # accelerator → head
             quota_used = self.queue_used_chips()
             for req in sorted(self._pending.values(),
                               key=JobRequest.sort_key):
                 fp = req.footprint
                 hold = self._holdoff.get(req.key, 0.0)
                 if now < hold:
-                    result.blocked[req.key] = (
-                        f"preemption cooldown ({hold - now:.1f}s left)")
+                    self._block(result, req, BLOCKED_COOLDOWN,
+                                f"preemption cooldown "
+                                f"({hold - now:.1f}s left)")
                     continue
                 if fp.empty:
                     self._admit(req, result, quota_used)
@@ -320,36 +410,53 @@ class ClusterScheduler:
                 quota = self.quotas.get(req.queue)
                 used = quota_used.get(req.queue, 0)
                 if quota is not None and used + fp.chips > quota:
-                    result.blocked[req.key] = (
-                        f"queue '{req.queue}' quota: {used}+{fp.chips} "
-                        f"> {quota} chips")
+                    self._block(result, req, BLOCKED_QUOTA,
+                                f"queue '{req.queue}' quota: "
+                                f"{used}+{fp.chips} > {quota} chips")
                     continue
                 if not self.inventory.knows(fp.accelerator):
-                    result.blocked[req.key] = (
-                        f"fleet has no '{fp.accelerator}' pool")
+                    self._block(result, req, BLOCKED_NO_POOL,
+                                f"fleet has no '{fp.accelerator}' pool")
                     continue
                 if fp.accelerator in reserved:
-                    result.blocked[req.key] = (
-                        f"held behind higher-priority "
-                        f"{reserved[fp.accelerator]} waiting on "
-                        f"{fp.accelerator}")
+                    res = reserved[fp.accelerator]
+                    if not self.backfill:
+                        self._block(result, req, BLOCKED_RESERVATION,
+                                    f"held behind higher-priority "
+                                    f"{res.key} waiting on "
+                                    f"{fp.accelerator}")
+                        continue
+                    ok, why = self._backfill_check(req, res, now)
+                    if ok:
+                        self._admit(req, result, quota_used)
+                        result.backfilled.append(req.key)
+                        self.backfills_total += 1
+                        continue
+                    self._block(result, req, BLOCKED_BACKFILL_REFUSED,
+                                f"backfill behind {res.key} refused: "
+                                f"{why}")
                     continue
                 if self.inventory.fits(fp):
                     self._admit(req, result, quota_used)
                     continue
                 victims = self._select_victims(req)
                 if victims is None:
-                    result.blocked[req.key] = (
-                        f"capacity: {fp} > "
-                        f"{self.inventory.available(fp.accelerator)} "
-                        f"free {fp.accelerator} slices")
+                    self._block(result, req, BLOCKED_CAPACITY,
+                                f"capacity: {fp} > "
+                                f"{self.inventory.available(fp.accelerator)} "
+                                f"free {fp.accelerator} slices")
                     # head-of-line reservation: nothing behind this job
-                    # may backfill onto the pool it is waiting for
-                    reserved[fp.accelerator] = req.key
+                    # may take the pool it is waiting for — except,
+                    # under rule 7, a backfill that provably cannot
+                    # delay it
+                    reserved[fp.accelerator] = self._reservation_for(
+                        req, now)
+                    self.reserved_ever.add(req.key)
                     continue
                 for victim, cost in victims:
                     self._running.pop(victim.key, None)
                     self.inventory.release(victim.key)
+                    self._admitted_at.pop(victim.key, None)
                     self._pending[victim.key] = victim
                     self._holdoff[victim.key] = (
                         now + self.preemption_cooldown)
@@ -360,7 +467,122 @@ class ClusterScheduler:
                         victim=victim.key, preemptor=req.key,
                         queue=victim.queue, cost=cost))
                 self._admit(req, result, quota_used)
+            # zero-starvation invariant, asserted every round exactly
+            # like the oversubscription high-water mark: whatever this
+            # round backfilled, no reservation's expected start may
+            # have moved later. A violation is a bug in the safety
+            # rules, not an operational condition.
+            if self.backfill:
+                for accel, res in reserved.items():
+                    head = self._pending.get(res.key)
+                    if head is None:
+                        continue
+                    fresh = self._reservation_for(head, now)
+                    if fresh.horizon > res.horizon + 1e-6:
+                        raise StarvationError(
+                            f"backfill delayed reserved {res.key} on "
+                            f"{accel}: expected start moved "
+                            f"{res.horizon:.1f} → {fresh.horizon:.1f}")
+            self._last_blocked = {
+                k: (result.blocked_category[k], r)
+                for k, r in result.blocked.items()
+            }
             return result
+
+    @staticmethod
+    def _block(result: TickResult, req: JobRequest, category: str,
+               reason: str) -> None:
+        result.blocked[req.key] = reason
+        result.blocked_category[req.key] = category
+
+    def _remaining_estimate(self, req: JobRequest,
+                            now: float) -> Optional[float]:
+        """Declared-estimate remaining runtime of a RUNNING job (None
+        when it declared nothing — an unbounded job for horizon math)."""
+        est = req.runtime_estimate_s or 0.0
+        if est <= 0:
+            return None
+        started = self._admitted_at.get(req.key, now)
+        return max(0.0, est - (now - started))
+
+    def _reservation_for(self, req: JobRequest,
+                         now: float) -> _Reservation:
+        """Price the head-of-line reservation: walk the pool's running
+        jobs; slices held by jobs ``req`` may preempt at will (its
+        priced victims) count as available immediately, declared-
+        estimate jobs return their slices at their expected finish,
+        undeclared jobs never (math.inf — conservative). The horizon is
+        the earliest instant the cumulative balance covers the gang."""
+        fp = req.footprint
+        free = max(0, self.inventory.available(fp.accelerator))
+        victim_slices = 0
+        finishers: List[Tuple[float, int]] = []  # (remaining_s, slices)
+        for r in self._running.values():
+            if (r.footprint.empty
+                    or r.footprint.accelerator != fp.accelerator):
+                continue
+            if r.preemptible and r.priority < req.priority:
+                victim_slices += r.footprint.slices
+                continue
+            rem = self._remaining_estimate(r, now)
+            if rem is not None:
+                finishers.append((rem, r.footprint.slices))
+        finishers.sort()
+        have = free + victim_slices
+        horizon = math.inf
+        if have >= fp.slices:  # races only: tick would have admitted
+            horizon = now
+        else:
+            for rem, s in finishers:
+                have += s
+                if have >= fp.slices:
+                    horizon = now + rem
+                    break
+        if math.isinf(horizon):
+            avail = free + victim_slices
+        else:
+            avail = free + victim_slices + sum(
+                s for rem, s in finishers
+                if now + rem <= horizon + 1e-9)
+        return _Reservation(req.key, fp.slices, horizon, avail)
+
+    def _backfill_check(self, req: JobRequest, res: _Reservation,
+                        now: float) -> Tuple[bool, str]:
+        """Decision rule 7's safety proof, per candidate. A backfill is
+        admitted only on one of two grounds, both of which keep the
+        reservation horizon fixed by construction:
+
+        - **gap-fit**: the candidate declared a runtime estimate and
+          finishes before the horizon — the slices it borrows are back
+          before the reserved gang can use them;
+        - **slack**: even if the candidate runs forever, the pool still
+          holds the reserved gang's slices at the horizon
+          (``avail_at_horizon`` is drawn down so stacked backfills
+          share one slack budget, not each the whole of it).
+
+        Everything else — bigger-than-the-gang, no free slices, no
+        declared estimates to price the horizon with — is refused with
+        the reason in hand."""
+        fp = req.footprint
+        if fp.slices >= res.slices:
+            return False, (
+                f"{fp.slices} slices is not strictly smaller than the "
+                f"reserved gang's {res.slices}")
+        if not self.inventory.fits(fp):
+            return False, "no free slices to backfill into"
+        if math.isinf(res.horizon):
+            return False, (
+                "reservation has no expected-start horizon (running "
+                "jobs declared no runtimeEstimateSeconds)")
+        est = req.runtime_estimate_s or 0.0
+        if est > 0 and now + est <= res.horizon + 1e-9:
+            return True, "fits inside the reservation gap"
+        if res.avail_at_horizon - fp.slices >= res.slices:
+            res.avail_at_horizon -= fp.slices
+            return True, "leaves slack at the reservation horizon"
+        return False, (
+            f"would hold slices the reserved gang needs at its "
+            f"expected start (in {res.horizon - now:.1f}s)")
 
     def _admit(self, req: JobRequest, result: TickResult,
                quota_used: Dict[str, int]) -> None:
@@ -368,6 +590,7 @@ class ClusterScheduler:
         self._holdoff.pop(req.key, None)
         self.inventory.charge(req.key, req.footprint)  # raises on bug
         self._running[req.key] = req
+        self._admitted_at[req.key] = self.clock()
         quota_used[req.queue] = (
             quota_used.get(req.queue, 0) + req.footprint.chips)
         result.admitted.append(req)

@@ -56,8 +56,22 @@ class ControllerConfig(K8sObject):
     # operator owns, accelerator type → number of slices of that shape.
     # NON-EMPTY turns the scheduler ON: jobs enter a Queued phase and a
     # reconciler only spawns on admission. Empty (default) preserves
-    # per-job placement exactly as before.
+    # per-job placement exactly as before. A fleet entry may also be a
+    # topology block — `{pods: P, slicesPerPod: S}` — which names the
+    # pool's P×S slice positions on an ICI-pod grid (capacity P×S) and
+    # turns on placement scoring for it; `fleet_topology` carries the
+    # parsed shapes, `fleet` always holds the plain counts.
     fleet: Dict[str, int] = field(default_factory=dict)
+    # accelerator → (pods, slicesPerPod) for fleet entries that
+    # declared a topology block (docs/SCHEDULER.md "Placement").
+    fleet_topology: Dict[str, Any] = field(default_factory=dict)
+    # Placement/backfill policy (A/B-proven on benches/sched_bench.py
+    # before it touches a real fleet): "fifo-reserve" (default — the
+    # absolute head-of-line reservation), "backfill" (EASY-style
+    # conservative backfill into reservation gaps), or "backfill+pack"
+    # (backfill + the topology-aware placement scorer on pools that
+    # declare a topology block).
+    scheduler_policy: str = "fifo-reserve"
     # Per-queue admission quota in CHIPS (spec.scheduling.queue →
     # chips); a queue missing from the map is unlimited.
     scheduler_quotas: Dict[str, int] = field(default_factory=dict)
@@ -93,14 +107,34 @@ class ControllerConfig(K8sObject):
             name: AcceleratorConfig.from_dict(cfg)
             for name, cfg in (raw.get("accelerators") or {}).items()
         }
+        fleet: Dict[str, int] = {}
+        fleet_topology: Dict[str, Any] = {}
+        for k, v in (raw.get("fleet") or {}).items():
+            if isinstance(v, dict):
+                pods = int(v.get("pods", 1))
+                spp = int(v.get("slicesPerPod", 0))
+                if pods <= 0 or spp <= 0:
+                    raise ValueError(
+                        f"fleet.{k}: topology block needs positive "
+                        f"pods and slicesPerPod, got {v!r}")
+                fleet[str(k)] = pods * spp
+                fleet_topology[str(k)] = (pods, spp)
+            else:
+                fleet[str(k)] = int(v)
+        policy = str(raw.get("schedulerPolicy", "fifo-reserve"))
+        if policy not in ("fifo-reserve", "backfill", "backfill+pack"):
+            raise ValueError(
+                f"schedulerPolicy {policy!r} is not one of "
+                f"fifo-reserve | backfill | backfill+pack")
         return cls(
             accelerators=accels,
             launcher_module=raw.get("launcherModule", cls.launcher_module),
             use_native_supervisor=raw.get("useNativeSupervisor", False),
             supervisor_path=raw.get("supervisorPath", cls.supervisor_path),
             health_port=raw.get("healthPort", cls.health_port),
-            fleet={str(k): int(v)
-                   for k, v in (raw.get("fleet") or {}).items()},
+            fleet=fleet,
+            fleet_topology=fleet_topology,
+            scheduler_policy=policy,
             scheduler_quotas={
                 str(k): int(v)
                 for k, v in (raw.get("schedulerQuotas") or {}).items()},

@@ -409,6 +409,12 @@ class SchedulingSpec(K8sObject):
     ``preemptible: false`` exempts the job from victim selection — it
     can still be queued behind capacity, it just never loses a slice
     it holds.
+    ``runtimeEstimateSeconds`` (0 = undeclared) is the operator's
+    expected runtime, the currency of conservative backfill
+    (docs/SCHEDULER.md "Placement"): declaring one lets THIS job slot
+    into a head-of-line reservation gap, and lets jobs queued behind
+    this one backfill around it while it runs. Advisory only — a job
+    is never killed for outliving its estimate.
 
     The block round-trips through the operator env like
     ``checkpointPolicy`` (``KTPU_SCHED_*``), so a program can see the
@@ -418,6 +424,7 @@ class SchedulingSpec(K8sObject):
     priority: int = 0
     queue: str = "default"
     preemptible: bool = True
+    runtime_estimate_seconds: float = 0.0
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -437,14 +444,31 @@ class SchedulingSpec(K8sObject):
         if not isinstance(self.preemptible, bool):
             raise ValidationError(
                 "scheduling: preemptible must be a boolean")
+        est = self.runtime_estimate_seconds
+        if (isinstance(est, bool)
+                or not isinstance(est, (int, float))
+                or est != est or est < 0):
+            raise ValidationError(
+                "scheduling: runtimeEstimateSeconds must be a "
+                "non-negative number of seconds (0 = undeclared)")
+        if est > 365 * 24 * 3600:
+            raise ValidationError(
+                "scheduling: runtimeEstimateSeconds over a year is "
+                "surely a unit mistake")
 
     def to_env(self) -> Dict[str, str]:
         """The launcher/program contract, mirroring checkpointPolicy."""
-        return {
+        env = {
             "KTPU_SCHED_QUEUE": self.queue,
             "KTPU_SCHED_PRIORITY": str(self.priority),
             "KTPU_SCHED_PREEMPTIBLE": "1" if self.preemptible else "0",
         }
+        if self.runtime_estimate_seconds > 0:
+            # only when declared: undeclared must look identical to the
+            # pre-backfill contract
+            env["KTPU_SCHED_RUNTIME_ESTIMATE_S"] = str(
+                self.runtime_estimate_seconds)
+        return env
 
 
 @register_type

@@ -1,0 +1,553 @@
+"""Tier-1 tests for topology-aware placement + conservative backfill
+(k8s_tpu/sched, docs/SCHEDULER.md "Placement"): the named-slice pool
+model (PoolTopology grid, SliceAssignment coordinates, revocation
+debt), the pure placement scorer (ICI-contiguous best-fit vs
+first-fit), the EASY-style backfill decision table (gap-fit, slack,
+refusals, the per-round zero-starvation assertion), the blocked-WHY
+diagnosability categories, the set_capacity-shrink-vs-reservation
+race, the ``scheduling.runtimeEstimateSeconds`` round trip, and the
+controller-config policy/topology knobs. test_sched.py remains the
+regression guard that NONE of this changes behavior when no topology
+is configured and backfill is off.
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+from k8s_tpu.sched import (
+    ClusterScheduler,
+    Footprint,
+    JobRequest,
+    PoolTopology,
+    SliceInventory,
+    StarvationError,
+    plan_placement,
+)
+from k8s_tpu import spec as S
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def fp(slices, accel="v5e-16"):
+    return Footprint(accel, slices=slices, chips=slices * 16)
+
+
+def req(key, slices, priority=0, queue="default", preemptible=True,
+        est=0.0, accel="v5e-16"):
+    return JobRequest(key=key, footprint=fp(slices, accel),
+                      priority=priority, queue=queue,
+                      preemptible=preemptible, runtime_estimate_s=est)
+
+
+def topo_inv(cap=8, packing=True, pods=2, spp=4):
+    return SliceInventory(
+        {"v5e-16": cap},
+        topology={"v5e-16": PoolTopology(pods=pods, slices_per_pod=spp)},
+        packing=packing)
+
+
+# ---------------------------------------------------------------------------
+# the pure scorer
+# ---------------------------------------------------------------------------
+
+
+class TestPlanPlacement:
+    T = PoolTopology(pods=2, slices_per_pod=4)  # positions 0..7
+
+    def test_topology_validation(self):
+        with pytest.raises(ValueError):
+            PoolTopology(pods=0, slices_per_pod=4).validate()
+        with pytest.raises(ValueError):
+            PoolTopology(pods=2, slices_per_pod=-1).validate()
+        assert PoolTopology(pods=3, slices_per_pod=8).positions == 24
+
+    def test_gang_best_fits_smallest_sufficient_run(self):
+        # runs: (0,2) and (4,3) — a 2-gang takes the EXACT fit, leaving
+        # the bigger run whole for a bigger gang
+        free = {0, 1, 4, 5, 6}
+        pos, contig = plan_placement(free, self.T, 2, packing=True)
+        assert pos == (0, 1) and contig
+
+    def test_gang_falls_back_to_smallest_fragments(self):
+        # runs: (0,1), (2,1), (4,2) — no run holds 3, so the fragments
+        # are consumed smallest-first and the placement is DCN-spanning
+        free = {0, 2, 4, 5}
+        pos, contig = plan_placement(free, self.T, 3, packing=True)
+        assert pos == (0, 2, 4) and not contig
+
+    def test_single_slice_best_fits_into_fragment(self):
+        # runs: (0,4) and (7,1) — packing spends the 1-fragment, the
+        # naive policy splits the big block at its lowest position
+        free = {0, 1, 2, 3, 7}
+        assert plan_placement(free, self.T, 1, packing=True) == ((7,), True)
+        assert plan_placement(free, self.T, 1, packing=False) == ((0,), True)
+
+    def test_first_fit_never_claims_contiguity_across_pods(self):
+        free = {2, 3, 4, 5}
+        pos, contig = plan_placement(free, self.T, 2, packing=False)
+        assert pos == (2, 3) and contig
+        pos, contig = plan_placement(free, self.T, 3, packing=False)
+        assert pos == (2, 3, 4) and not contig  # 3→4 crosses the pod
+
+    def test_runs_never_cross_pod_boundaries(self):
+        # positions 2..5 all free, but 2-3 and 4-5 are different pods:
+        # a 4-gang cannot sit contiguously even though the span is 4
+        free = {2, 3, 4, 5}
+        pos, contig = plan_placement(free, self.T, 4, packing=True)
+        assert set(pos) == free and not contig
+
+
+# ---------------------------------------------------------------------------
+# the inventory grid
+# ---------------------------------------------------------------------------
+
+
+class TestInventoryPlacement:
+    def test_no_topology_is_annotation_free(self):
+        inv = SliceInventory({"v5e-16": 4})
+        assert inv.topology("v5e-16") is None
+        assert inv.charge("j", fp(2)) is None
+        assert inv.assignment("j") is None
+        assert inv.fragmentation("v5e-16") == 0.0
+        assert inv.placement_stats() == {}
+        assert inv.used("v5e-16") == 2  # counting untouched
+
+    def test_charge_returns_contiguous_assignment(self):
+        inv = topo_inv()
+        asg = inv.charge("a", fp(3))
+        assert asg is not None and asg.contiguous
+        assert asg.positions == (0, 1, 2)
+        assert asg.pods() == (0,)
+        assert "ici-contiguous" in str(asg) and "0.0" in str(asg)
+        assert inv.assignment("a") == asg
+
+    def test_contiguity_hit_rate_counts_multislice_only(self):
+        inv = topo_inv()
+        assert inv.contiguity_hit_rate("v5e-16") is None
+        inv.charge("s", fp(1))  # single slice: not a contiguity request
+        assert inv.contiguity_hit_rate("v5e-16") is None
+        inv.release("s")
+        inv.charge("x", fp(3))  # (0,1,2) contiguous
+        inv.charge("y", fp(3))  # (4,5,6) contiguous
+        assert inv.contiguity_hit_rate("v5e-16") == 1.0
+        # free is two lone positions: a 2-gang must span DCN
+        asg = inv.charge("z", fp(2))
+        assert asg.positions == (3, 7) and not asg.contiguous
+        assert inv.contiguity_hit_rate("v5e-16") == pytest.approx(2 / 3)
+
+    def test_fragmentation_metric(self):
+        inv = topo_inv()
+        # pods bound runs: even an EMPTY 2-pod pool's largest run is
+        # one pod, so its floor fragmentation is 1 − 4/8
+        assert inv.fragmentation("v5e-16") == pytest.approx(0.5)
+        inv.charge("a", fp(3))  # free: (3,1) + (4,4) → 1 - 4/5
+        assert inv.fragmentation("v5e-16") == pytest.approx(1 - 4 / 5)
+        stats = inv.placement_stats()["v5e-16"]
+        assert stats["largest_free_block"] == 4.0
+        inv.release("a")
+        assert inv.fragmentation("v5e-16") == pytest.approx(0.5)
+
+    def test_release_returns_positions_to_the_grid(self):
+        inv = topo_inv()
+        inv.charge("a", fp(2))
+        inv.charge("b", fp(2))
+        inv.release("a")
+        assert inv.assignment("a") is None
+        asg = inv.charge("c", fp(2))
+        assert asg.positions == (0, 1)  # freed block reused
+
+    def test_force_charge_past_capacity_carries_no_assignment(self):
+        inv = topo_inv(cap=2, pods=1, spp=2)
+        inv.charge("a", fp(2))
+        asg = inv.charge("adopted", fp(2), force=True)
+        assert asg is None
+        assert inv.assignment("adopted") is None
+        assert inv.used("v5e-16") == 4  # the count still records reality
+        assert inv.max_used["v5e-16"] == 4
+
+    def test_recharge_resizes_in_place(self):
+        inv = topo_inv()
+        assert inv.charge("a", fp(3)).positions == (0, 1, 2)
+        shrunk = inv.recharge("a", fp(2))
+        assert shrunk.positions == (0, 1)  # keeps its lowest positions
+        grown = inv.recharge("a", fp(4))
+        assert grown.positions == (0, 1, 2, 3) and grown.contiguous
+
+    def test_set_capacity_shrink_revokes_highest_free_positions(self):
+        inv = topo_inv()
+        inv.charge("a", fp(2))  # (0,1)
+        inv.set_capacity("v5e-16", 4)
+        # free space is only (2,3): positions 4..7 are revoked
+        asg = inv.charge("b", fp(2))
+        assert asg.positions == (2, 3)
+        assert inv.placement_stats()["v5e-16"]["largest_free_block"] == 0.0
+        inv.release("a")
+        inv.release("b")
+        inv.set_capacity("v5e-16", 8)  # grow un-revokes
+        assert inv.placement_stats()["v5e-16"]["largest_free_block"] == 4.0
+
+    def test_grow_past_grid_extends_by_whole_pods(self):
+        inv = topo_inv(cap=8, pods=2, spp=4)
+        inv.set_capacity("v5e-16", 10)
+        t = inv.topology("v5e-16")
+        assert t.pods == 3 and t.positions == 12
+        # 12 grid positions, capacity 10: two stay revoked
+        inv.charge("big", fp(10))
+        assert inv.available("v5e-16") == 0
+
+
+# ---------------------------------------------------------------------------
+# conservative backfill
+# ---------------------------------------------------------------------------
+
+
+def sched_world(backfill=True, cap=8, quotas=None, cooldown=5.0):
+    clock = FakeClock(100.0)
+    sched = ClusterScheduler(
+        topo_inv(cap=cap), quotas=quotas, clock=clock,
+        preemption_cooldown=cooldown, backfill=backfill)
+    return sched, clock
+
+
+class TestBackfill:
+    def _reserve_head(self, sched, clock, head_slices=6, est=100.0):
+        """Admit a 4-slice estimate-declared job, then park a 6-slice
+        head behind it: capacity-blocked, pool reserved, horizon =
+        admit time + estimate."""
+        sched.submit(req("ns/r1", 4, est=est))
+        r = sched.tick()
+        assert [a.key for a in r.admitted] == ["ns/r1"]
+        clock.advance(10)
+        sched.submit(req("ns/head", head_slices))
+        return sched.tick()
+
+    def test_reservation_absolute_without_backfill(self):
+        sched, clock = sched_world(backfill=False)
+        self._reserve_head(sched, clock)
+        sched.submit(req("ns/small", 2, est=10.0))
+        r = sched.tick()
+        assert r.admitted == [] and r.backfilled == []
+        assert r.blocked_category["ns/small"] == "reservation"
+        assert "held behind" in r.blocked["ns/small"]
+
+    def test_gap_fit_backfill_admits(self):
+        sched, clock = sched_world()
+        r = self._reserve_head(sched, clock)  # horizon = 110 + 90 = 200
+        assert r.blocked_category["ns/head"] == "capacity"
+        sched.submit(req("ns/small", 2, est=50.0))  # 110+50 ≤ 200
+        r = sched.tick()
+        assert [a.key for a in r.admitted] == ["ns/small"]
+        assert r.backfilled == ["ns/small"]
+        assert sched.backfills_total == 1
+        assert "ns/head" in sched.reserved_ever
+
+    def test_slack_backfill_shares_one_budget(self):
+        sched, clock = sched_world()
+        self._reserve_head(sched, clock)
+        # no estimate → no gap-fit; but avail_at_horizon (8) − 2 still
+        # covers the reserved 6 → admitted on slack
+        sched.submit(req("ns/forever", 2))
+        r = sched.tick()
+        assert r.backfilled == ["ns/forever"]
+        # the slack budget is spent: 6 − 1 < 6 refuses the next one
+        sched.submit(req("ns/straw", 1))
+        r = sched.tick()
+        assert r.backfilled == []
+        assert r.blocked_category["ns/straw"] == "backfill-refused"
+        assert "expected start" in r.blocked["ns/straw"]
+
+    def test_undeclared_runtimes_give_no_horizon(self):
+        sched, clock = sched_world()
+        self._reserve_head(sched, clock, est=0.0)  # r1 declared nothing
+        sched.submit(req("ns/small", 2, est=10.0))
+        r = sched.tick()
+        assert r.backfilled == []
+        assert r.blocked_category["ns/small"] == "backfill-refused"
+        assert "no expected-start horizon" in r.blocked["ns/small"]
+
+    def test_backfill_must_be_strictly_smaller(self):
+        sched, clock = sched_world()
+        self._reserve_head(sched, clock)
+        sched.submit(req("ns/peer", 6, est=1.0))
+        r = sched.tick()
+        assert r.blocked_category["ns/peer"] == "backfill-refused"
+        assert "strictly smaller" in r.blocked["ns/peer"]
+
+    def test_estimate_counts_down_from_admission(self):
+        sched, clock = sched_world()
+        self._reserve_head(sched, clock)  # horizon 200
+        clock.advance(80)  # now=190: a 15s job no longer fits the gap
+        sched.submit(req("ns/late", 2, est=15.0))
+        r = sched.tick()
+        # gap-fit fails (190+15 > 200) but slack still covers it
+        assert r.backfilled == ["ns/late"]
+        # the head admits once r1's slices free
+        sched.remove("ns/r1")
+        sched.remove("ns/late")
+        r = sched.tick()
+        assert [a.key for a in r.admitted] == ["ns/head"]
+
+    def test_blocked_categories_and_stats(self):
+        sched, clock = sched_world(
+            backfill=False, quotas={"capped": 16}, cooldown=5.0)
+        sched.submit(req("ns/q", 2, queue="capped"))  # 32 chips > 16
+        sched.submit(req("ns/ghost", 1, accel="v9-unicorn"))
+        sched.submit(req("ns/big", 9))
+        r = sched.tick()
+        assert r.blocked_category == {
+            "ns/q": "quota", "ns/ghost": "no-pool", "ns/big": "capacity"}
+        blocked = sched.stats()["blocked"]
+        assert blocked["ns/big"]["category"] == "capacity"
+        assert "free" in blocked["ns/big"]["reason"]
+        # a requeued victim reports its cooldown
+        sched.tick()
+        assert sched.stats()["backfills_total"] == 0
+
+    def test_starvation_invariant_holds_over_churny_rounds(self):
+        """A busy mixed sequence — reservations, gap-fits, slack
+        backfills, finishes — must never trip the per-round horizon
+        assertion (StarvationError is a scheduler bug)."""
+        sched, clock = sched_world()
+        sched.submit(req("ns/r1", 4, est=100.0))
+        sched.tick()
+        for i in range(20):
+            clock.advance(3)
+            if i == 2:
+                sched.submit(req("ns/head", 6))
+            if i in (4, 7, 10):
+                sched.submit(req(f"ns/bf{i}", 1, est=10.0))
+            if i == 12:
+                sched.remove("ns/bf4")
+            sched.tick()  # raises StarvationError on any regression
+
+
+# ---------------------------------------------------------------------------
+# the shrink-vs-reservation race (set_capacity under a live backfill)
+# ---------------------------------------------------------------------------
+
+
+class TestShrinkRace:
+    def test_shrink_races_reservation_and_backfill(self):
+        """A pool shrink landing while a head-of-line job is reserved
+        AND a backfill was just admitted: nobody is retro-preempted,
+        the over-capacity pool admits nothing until it drains, the
+        revocation debt is collected from the releases, and the head
+        finally admits when capacity returns — with the per-round
+        starvation assertion live through every tick."""
+        sched, clock = sched_world()
+        inv = sched.inventory
+        sched.submit(req("ns/r1", 4, est=100.0))
+        sched.tick()
+        clock.advance(1)
+        sched.submit(req("ns/head", 6))
+        sched.tick()  # reserved: horizon = 101 + 99 = 200
+        sched.submit(req("ns/bf", 2, est=50.0))
+        r = sched.tick()
+        assert r.backfilled == ["ns/bf"]  # 101+50 ≤ 200
+
+        inv.set_capacity("v5e-16", 4)  # shrink UNDER the 6 used slices
+        assert inv.available("v5e-16") == -2
+        assert inv.snapshot()["v5e-16"]["free"] == 0  # gauge stays sane
+        assert sched.is_running("ns/r1") and sched.is_running("ns/bf")
+
+        clock.advance(1)
+        r = sched.tick()  # no starvation raise, no admission
+        assert r.admitted == [] and r.backfilled == []
+        assert r.blocked_category["ns/head"] == "capacity"
+
+        # drain: the releases pay the revocation debt, the pool ends
+        # at 4 usable positions — still too small for the head
+        sched.remove("ns/bf")
+        sched.remove("ns/r1")
+        assert inv.available("v5e-16") == 4
+        assert inv.placement_stats()["v5e-16"]["largest_free_block"] == 4.0
+        clock.advance(1)
+        r = sched.tick()
+        assert r.admitted == []
+        assert r.blocked_category["ns/head"] == "capacity"
+
+        inv.set_capacity("v5e-16", 8)  # capacity returns
+        clock.advance(1)
+        r = sched.tick()
+        assert [a.key for a in r.admitted] == ["ns/head"]
+        asg = inv.assignment("ns/head")
+        assert asg is not None and len(asg.positions) == 6
+        assert max(inv.max_used.values()) <= 8
+
+    def test_shrink_never_unplaces_running_gangs(self):
+        inv = topo_inv()
+        asg = inv.charge("a", fp(4))
+        inv.set_capacity("v5e-16", 2)  # below usage
+        assert inv.assignment("a") == asg  # untouched
+        inv.release("a")
+        # debt collected: only 2 usable positions remain
+        assert inv.placement_stats()["v5e-16"]["largest_free_block"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# spec + config round trips
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimeEstimateSpec:
+    def test_validation(self):
+        for bad in (-1, float("nan"), True, "4h", 366 * 24 * 3600):
+            s = S.SchedulingSpec(runtime_estimate_seconds=bad)
+            with pytest.raises(S.ValidationError):
+                s.validate()
+        S.SchedulingSpec(runtime_estimate_seconds=0).validate()
+        S.SchedulingSpec(runtime_estimate_seconds=14400.0).validate()
+
+    def test_env_only_when_declared(self):
+        env = S.SchedulingSpec().to_env()
+        assert "KTPU_SCHED_RUNTIME_ESTIMATE_S" not in env
+        env = S.SchedulingSpec(runtime_estimate_seconds=600).to_env()
+        assert env["KTPU_SCHED_RUNTIME_ESTIMATE_S"] == "600"
+
+    def test_camel_case_round_trip(self):
+        s = S.SchedulingSpec.from_dict({"runtimeEstimateSeconds": 120,
+                                        "priority": 3})
+        assert s.runtime_estimate_seconds == 120
+        d = s.to_dict()
+        assert d["runtimeEstimateSeconds"] == 120
+        assert S.SchedulingSpec.from_dict(d) == s
+
+    def test_example_yaml_declares_estimate(self):
+        import os
+
+        from k8s_tpu.tools.kubectl_local import load_tpu_job_yaml
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "tpu_job_multislice_llama.yaml")
+        with open(path) as f:
+            job = load_tpu_job_yaml(f.read())
+        job.spec.set_defaults()
+        job.spec.validate()
+        assert job.spec.scheduling.runtime_estimate_seconds == 14400
+        assert (job.spec.scheduling.to_dict()["runtimeEstimateSeconds"]
+                == 14400)
+
+
+class TestControllerConfigPlacement:
+    def test_fleet_topology_block(self):
+        cfg = S.ControllerConfig.from_yaml(
+            "fleet:\n"
+            "  v5e-16: {pods: 2, slicesPerPod: 4}\n"
+            "  cpu-1: 3\n"
+            "schedulerPolicy: backfill+pack\n")
+        assert cfg.fleet == {"v5e-16": 8, "cpu-1": 3}
+        assert cfg.fleet_topology == {"v5e-16": (2, 4)}
+        assert cfg.scheduler_policy == "backfill+pack"
+
+    def test_bad_topology_and_policy_rejected(self):
+        with pytest.raises(ValueError):
+            S.ControllerConfig.from_yaml(
+                "fleet:\n  v5e-16: {pods: 0, slicesPerPod: 4}\n")
+        with pytest.raises(ValueError):
+            S.ControllerConfig.from_yaml("schedulerPolicy: lottery\n")
+
+    def test_controller_wires_policy_into_scheduler(self):
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.controller.controller import Controller
+
+        cluster = InMemoryCluster()
+        cfg = S.ControllerConfig.from_yaml(
+            "fleet:\n  v5e-16: {pods: 2, slicesPerPod: 4}\n"
+            "schedulerPolicy: backfill+pack\n")
+        c = Controller(KubeClient(cluster), TpuJobClient(cluster), cfg)
+        assert c.scheduler.backfill is True
+        assert c.scheduler.inventory.packing is True
+        t = c.scheduler.inventory.topology("v5e-16")
+        assert t is not None and (t.pods, t.slices_per_pod) == (2, 4)
+        # default policy: counting-only scheduler, backfill off
+        cfg2 = S.ControllerConfig(fleet={"v5e-16": 8})
+        c2 = Controller(KubeClient(cluster), TpuJobClient(cluster), cfg2)
+        assert c2.scheduler.backfill is False
+        assert c2.scheduler.inventory.topology("v5e-16") is None
+
+
+# ---------------------------------------------------------------------------
+# controller integration: the Queued-WHY condition
+# ---------------------------------------------------------------------------
+
+
+class TestQueuedDiagnosability:
+    def test_blocked_reason_lands_in_queued_condition_once(self):
+        """The parked job's Queued condition carries the blocked
+        category + reason, written ONCE per category change — not once
+        per tick (the condition ring must not fill with duplicates)."""
+        from k8s_tpu.api.client import KubeClient
+        from k8s_tpu.api.cluster import InMemoryCluster
+        from k8s_tpu.api.crd_client import TpuJobClient
+        from k8s_tpu.controller.controller import Controller
+        from k8s_tpu.runtime.kubelet import (
+            LocalKubelet,
+            SimulatedExecutor,
+        )
+
+        cluster = InMemoryCluster()
+        client = KubeClient(cluster)
+        jc = TpuJobClient(cluster)
+        config = S.ControllerConfig(fleet={"cpu-1": 1},
+                                    scheduler_cooldown_seconds=0.0)
+        controller = Controller(client, jc, config,
+                                reconcile_interval=0.02,
+                                sched_interval=0.03)
+        kubelet = LocalKubelet(client, SimulatedExecutor(0, delay=1.0))
+
+        def job(name):
+            j = S.TpuJob()
+            j.metadata.name = name
+            j.metadata.namespace = "default"
+            j.spec.tpu = S.TpuSpec(accelerator="cpu-1")
+            j.spec.replica_specs = [
+                S.TpuReplicaSpec(replica_type="WORKER", replicas=None)]
+            return j
+
+        kubelet.start()
+        controller.start()
+        try:
+            jc.create(job("holder"))
+            jc.create(job("parked"))
+            deadline = time.monotonic() + 15
+            reasons = []
+            while time.monotonic() < deadline:
+                parked = next(
+                    (jc.get("default", n) for n in ("holder", "parked")
+                     if jc.get("default", n).status.phase
+                     == S.TpuJobPhase.QUEUED), None)
+                if parked is not None:
+                    reasons = [
+                        c.reason for c in parked.status.conditions
+                        if c.type == "Queued"
+                        and (c.reason or "").startswith("capacity:")]
+                    if reasons:
+                        break
+                time.sleep(0.02)
+            assert reasons, "no capacity-categorized Queued condition"
+            # many sched ticks have run by now (interval 0.03s); the
+            # category-dedup must have kept it to ONE condition
+            time.sleep(0.3)
+            parked2 = jc.get("default", parked.metadata.name)
+            dups = [c.reason for c in parked2.status.conditions
+                    if c.type == "Queued"
+                    and (c.reason or "").startswith("capacity:")]
+            assert len(dups) == 1, dups
+        finally:
+            controller.stop()
+            kubelet.stop()
